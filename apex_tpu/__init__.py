@@ -1,0 +1,93 @@
+"""apex_tpu — a TPU-native training-acceleration framework.
+
+A from-scratch JAX/XLA/Pallas/pjit framework with the capability set of NVIDIA
+Apex (reference: /root/reference, see SURVEY.md): mixed-precision policies with
+dynamic loss scaling, fused multi-tensor optimizers, fused normalization /
+softmax / dense / cross-entropy ops, data-parallel training over the ICI/DCN
+mesh, and a Megatron-style tensor/sequence/pipeline-parallel runtime.
+
+Where Apex monkey-patches torch (``apex/amp/amp.py:74-183``), apex_tpu provides
+explicit functional APIs; where Apex hand-buckets NCCL all-reduce
+(``apex/parallel/distributed.py:429``), apex_tpu declares shardings on a
+``jax.sharding.Mesh``; where Apex writes CUDA (``csrc/``), apex_tpu relies on
+XLA fusion and writes Pallas kernels only where profiling says XLA is not
+enough.
+
+Top-level layout (mirrors the reference export list ``apex/__init__.py:9``):
+
+- :mod:`apex_tpu.amp`            — precision policies + loss scaling (O0-O3 analog)
+- :mod:`apex_tpu.optimizers`     — fused optimizer family (Adam, LAMB, SGD, ...)
+- :mod:`apex_tpu.normalization`  — fused LayerNorm / RMSNorm
+- :mod:`apex_tpu.ops`            — fused functional ops (softmax, dense, xentropy, ...)
+- :mod:`apex_tpu.parallel`       — mesh builder, collectives, DDP analog, SyncBN
+- :mod:`apex_tpu.transformer`    — tensor/sequence/pipeline-parallel runtime
+- :mod:`apex_tpu.models`         — reference models (MLP, ResNet, GPT, BERT)
+- :mod:`apex_tpu.contrib`        — optional extensions (group_norm, sparsity, ...)
+- :mod:`apex_tpu.utils`          — logging, timers, tree utilities
+"""
+
+import logging as _logging
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "amp",
+    "optimizers",
+    "normalization",
+    "ops",
+    "parallel",
+    "transformer",
+    "models",
+    "contrib",
+    "utils",
+]
+
+
+class RankInfoFormatter(_logging.Formatter):
+    """Per-process log formatter carrying mesh-rank info.
+
+    Analog of the reference's ``RankInfoFormatter`` (``apex/__init__.py:31-43``)
+    which prepends NCCL rank info; under SPMD JAX there is one process per host,
+    so we carry ``jax.process_index`` instead of a device rank.
+    """
+
+    _cached = None
+
+    def format(self, record):
+        if RankInfoFormatter._cached is None:
+            rank, world = 0, 1
+            try:
+                # Only read rank info if a backend already exists — calling
+                # jax.process_index() would *initialize* the backend as a side
+                # effect of logging, breaking later jax.distributed.initialize
+                # or platform/flag configuration.
+                from jax._src import xla_bridge
+
+                if xla_bridge._backends:
+                    import jax
+
+                    rank, world = jax.process_index(), jax.process_count()
+                    RankInfoFormatter._cached = (rank, world)
+            except Exception:  # pragma: no cover - private API moved
+                RankInfoFormatter._cached = (0, 1)
+        else:
+            rank, world = RankInfoFormatter._cached
+        record.rank_info = f"[{rank}/{world}]"
+        return super().format(record)
+
+
+def _get_logger() -> _logging.Logger:
+    logger = _logging.getLogger("apex_tpu")
+    if not logger.handlers:
+        handler = _logging.StreamHandler()
+        handler.setFormatter(
+            RankInfoFormatter(
+                "%(asctime)s %(rank_info)s %(name)s %(levelname)s: %(message)s"
+            )
+        )
+        logger.addHandler(handler)
+        logger.propagate = False
+    return logger
+
+
+logger = _get_logger()

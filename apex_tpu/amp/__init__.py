@@ -1,0 +1,67 @@
+"""apex_tpu.amp — mixed-precision policies and loss scaling.
+
+TPU-native replacement for ``apex/amp`` (reference entry
+``apex/amp/frontend.py:197`` ``amp.initialize``).  Apex works by mutating a
+torch model in place: casting parameters, monkey-patching ``torch.*``
+functions with cast wrappers (``apex/amp/amp.py:74-183``), and patching
+optimizer ``step`` for master-weight copies
+(``apex/amp/_process_optimizer.py:321``).  None of that has a JAX analog —
+and none of it is needed: JAX programs are functional, so mixed precision is
+expressed as an explicit :class:`Policy` that the user applies at three
+well-defined points (params at init, inputs at the top of ``apply``, loss at
+the end), plus a :class:`GradScaler`-style state threaded through the train
+step.  This is the deliberate API divergence documented in SURVEY.md §7(d).
+
+The O0–O3 opt levels (``apex/amp/frontend.py:104-193``) map to:
+
+========  =======================  =========================================
+ref       apex_tpu policy          meaning on TPU
+========  =======================  =========================================
+``O0``    ``policy("O0")``         pure fp32 (accuracy baseline)
+``O1``    ``policy("O1")``         fp32 params, bf16 compute at op boundaries
+``O2``    ``policy("O2")``         bf16 params + fp32 master weights,
+                                   norms in fp32, dynamic loss scale
+``O3``    ``policy("O3")``         pure bf16 ("speed of light")
+========  =======================  =========================================
+
+bf16 on TPU has fp32's exponent range, so loss scaling is rarely *needed* —
+but fp16 policies (``half_dtype=jnp.float16``) are fully supported for
+parity, and :class:`DynamicLossScale` reproduces the reference scaler
+semantics (init 2^16, x2 every 2000 good steps, /2 on overflow, hysteresis;
+``apex/amp/scaler.py:33-217``, ``csrc/update_scale_hysteresis.cu:5``)
+entirely inside jit via ``lax.cond`` — no device-to-host sync per step,
+unlike the reference's ``_overflow_buf.item()`` (``scaler.py:200``).
+"""
+
+from apex_tpu.amp.policy import (  # noqa: F401
+    Policy,
+    policy,
+    O0,
+    O1,
+    O2,
+    O3,
+    cast_to_compute,
+    cast_to_param,
+    cast_to_output,
+    cast_floating,
+)
+from apex_tpu.amp.scaler import (  # noqa: F401
+    LossScaleState,
+    DynamicLossScale,
+    StaticLossScale,
+    NoOpLossScale,
+    all_finite,
+    scale_loss,
+)
+from apex_tpu.amp.master import (  # noqa: F401
+    MasterWeights,
+    make_master,
+    master_to_model,
+)
+from apex_tpu.amp.frontend import (  # noqa: F401
+    AmpConfig,
+    AmpState,
+    initialize,
+    state_dict,
+    load_state_dict,
+)

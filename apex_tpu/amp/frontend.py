@@ -1,0 +1,125 @@
+"""amp frontend — the ``amp.initialize`` analog, functional style.
+
+``amp.initialize(model, optimizer, opt_level=...)``
+(``apex/amp/frontend.py:197``) returns mutated model+optimizer.  The
+functional equivalent bundles the pieces a train step needs — policy, scaler,
+master weights — into an :class:`AmpState` the user threads through jit.
+
+Typical use::
+
+    amp_conf, amp_state = amp.initialize(params, opt_level="O2",
+                                         half_dtype=jnp.float16)
+
+    @jax.jit
+    def train_step(amp_state, batch):
+        model_params = amp.master_to_model(amp_state.master)  # half params
+        def loss_fn(p):
+            out = model.apply(amp_conf.policy.cast_to_compute(p), batch)
+            return loss(out)
+        scaled = lambda p: amp.scale_loss(loss_fn(p), amp_state.scaler)
+        grads = jax.grad(scaled)(model_params)
+        finite = amp.all_finite(grads)
+        grads32 = amp_conf.loss_scaler.unscale(grads, amp_state.scaler)
+        ... optimizer step on amp_state.master.params with grads32,
+            predicated on `finite` ...
+        new_scaler = amp_conf.loss_scaler.update(amp_state.scaler, finite)
+
+State-dict helpers mirror ``amp.state_dict/load_state_dict``
+(``apex/amp/frontend.py:365-404``) for checkpoint parity.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, NamedTuple, Optional, Union
+
+import jax.numpy as jnp
+
+from apex_tpu.amp.master import MasterWeights, make_master
+from apex_tpu.amp.policy import Policy, policy as make_policy
+from apex_tpu.amp.scaler import (
+    DynamicLossScale,
+    LossScaleState,
+    NoOpLossScale,
+    StaticLossScale,
+)
+
+__all__ = ["AmpConfig", "AmpState", "initialize", "state_dict", "load_state_dict"]
+
+
+@dataclasses.dataclass(frozen=True)
+class AmpConfig:
+    """Static (non-pytree) side of amp: the policy and scaler algorithm."""
+
+    policy: Policy
+    loss_scaler: Union[DynamicLossScale, StaticLossScale, NoOpLossScale]
+
+
+class AmpState(NamedTuple):
+    """Dynamic (pytree) side: scaler counters and optional master weights."""
+
+    scaler: LossScaleState
+    master: Optional[MasterWeights]
+
+
+def initialize(
+    params=None,
+    opt_level: str = "O1",
+    half_dtype=jnp.bfloat16,
+    *,
+    loss_scale: Union[str, float, None] = None,
+    **policy_overrides,
+):
+    """Build amp config+state from an opt level.
+
+    Mirrors ``amp.initialize`` keyword semantics
+    (``apex/amp/frontend.py:197-264``): ``loss_scale`` overrides the preset
+    ("dynamic" or a float); other :class:`Policy` fields can be overridden by
+    keyword.  Returns ``(AmpConfig, AmpState)``; if ``params`` is given and
+    the policy uses master weights, ``AmpState.master`` holds fp32 masters
+    and the caller should derive model params via
+    :func:`apex_tpu.amp.master_to_model`.
+    """
+    pol = make_policy(opt_level, half_dtype)
+    if loss_scale is not None:
+        pol = pol.with_options(loss_scale=loss_scale)
+    if policy_overrides:
+        pol = pol.with_options(**policy_overrides)
+
+    if pol.loss_scale == "dynamic":
+        scaler_algo: Any = DynamicLossScale()
+    elif pol.loss_scale is None:
+        scaler_algo = NoOpLossScale()
+    else:
+        scaler_algo = StaticLossScale(float(pol.loss_scale))
+
+    master = None
+    if params is not None and pol.master_weights:
+        master = make_master(pol.cast_to_param(params))
+
+    return AmpConfig(policy=pol, loss_scaler=scaler_algo), AmpState(
+        scaler=scaler_algo.init(), master=master
+    )
+
+
+def state_dict(state: AmpState) -> dict:
+    """Checkpointable scaler state (``amp.state_dict``,
+    ``apex/amp/frontend.py:365-375``)."""
+    return {
+        "loss_scale": state.scaler.scale,
+        "growth_tracker": state.scaler.growth_tracker,
+        "hysteresis_tracker": state.scaler.hysteresis_tracker,
+        "found_inf": state.scaler.found_inf,
+    }
+
+
+def load_state_dict(state: AmpState, sd: dict) -> AmpState:
+    """Restore scaler state (``amp.load_state_dict``,
+    ``apex/amp/frontend.py:377-404``)."""
+    scaler = LossScaleState(
+        scale=jnp.float32(sd["loss_scale"]),
+        growth_tracker=jnp.int32(sd["growth_tracker"]),
+        hysteresis_tracker=jnp.int32(sd["hysteresis_tracker"]),
+        found_inf=jnp.asarray(sd["found_inf"]),
+    )
+    return state._replace(scaler=scaler)

@@ -1,0 +1,83 @@
+"""FP32 master weights for half-precision training (the O2 mechanism).
+
+Reference: amp lazily builds fp32 master copies of every fp16 param and
+rewires the optimizer to step on the masters, then copies master→model after
+each step (``apex/amp/_process_optimizer.py:28-159``,
+``lazy_init_with_master_weights``; copy-back ``:349-364`` via
+``multi_tensor_scale``).  The legacy path is ``FP16_Optimizer``
+(``apex/fp16_utils/fp16_optimizer.py:13``) with ``prep_param_lists``
+(``fp16util.py:92``).
+
+JAX redesign: masters are just another pytree.  The train step computes grads
+w.r.t. the half *model* params, unscales them to fp32, steps the optimizer on
+the fp32 *master* params, and re-derives the model params by casting.  XLA
+fuses the cast into the update; with buffer donation the half params are
+updated in place, so the memory cost is the same as the reference's
+(half model + fp32 master + fp32 optimizer state).
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["MasterWeights", "make_master", "master_to_model"]
+
+
+class MasterWeights(NamedTuple):
+    """fp32 master params paired with the dtype to derive model params in.
+
+    Registered as a pytree with ``model_dtype`` as static aux data so the
+    whole structure can be carried through jit (a dtype is not an array
+    leaf).
+    """
+
+    params: Any  # fp32 pytree
+    model_dtype: Any
+
+
+jax.tree_util.register_pytree_node(
+    MasterWeights,
+    lambda mw: ((mw.params,), jnp.dtype(mw.model_dtype)),
+    lambda aux, children: MasterWeights(params=children[0], model_dtype=aux),
+)
+
+
+def make_master(model_params) -> MasterWeights:
+    """Create fp32 masters from (possibly half) model params.
+
+    Analog of ``prep_param_lists`` (``apex/fp16_utils/fp16util.py:92-135``):
+    every float leaf gets an fp32 clone; the model dtype is remembered for the
+    copy-back direction.
+    """
+    leaves = jax.tree_util.tree_leaves(model_params)
+    float_leaves = [
+        x for x in leaves if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
+    ]
+    model_dtype = (
+        jnp.asarray(float_leaves[0]).dtype if float_leaves else jnp.float32
+    )
+    masters = jax.tree_util.tree_map(
+        lambda x: (
+            jnp.asarray(x, jnp.float32)
+            if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
+            else x
+        ),
+        model_params,
+    )
+    return MasterWeights(params=masters, model_dtype=model_dtype)
+
+
+def master_to_model(master: MasterWeights):
+    """Derive model params from masters (``_master_params_to_model_params``,
+    ``apex/amp/_process_optimizer.py:14-25``)."""
+    return jax.tree_util.tree_map(
+        lambda x: (
+            jnp.asarray(x, master.model_dtype)
+            if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
+            else x
+        ),
+        master.params,
+    )

@@ -1,0 +1,195 @@
+"""Precision policies — the functional O0-O3 analog.
+
+Reference semantics being reproduced (``apex/amp/frontend.py:104-193``):
+
+- ``O0``: everything fp32.
+- ``O1``: params fp32; a *cast list* decides which ops run in half precision
+  (GEMMs/convs on the fp16 list ``apex/amp/lists/torch_overrides.py:7-28``,
+  reductions/norms/losses on the fp32 list ``:30-68``).  JAX cannot
+  monkey-patch ``jnp.*`` (and should not); instead the policy is applied at
+  module boundaries: ``cast_to_compute`` on inputs of matmul-heavy modules,
+  with norm/softmax/loss modules keeping fp32 internally — which is exactly
+  what the cast lists achieve in practice.
+- ``O2``: params cast to half except norms (``BN_convert_float``
+  ``apex/fp16_utils/fp16util.py:22``), fp32 master weights held by the
+  optimizer, dynamic loss scaling.
+- ``O3``: params and compute all half, no master weights.
+
+On TPU the default half dtype is bfloat16 (MXU-native); fp16 is supported for
+reference parity (and needs the loss scaler to be meaningful).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "Policy",
+    "policy",
+    "O0",
+    "O1",
+    "O2",
+    "O3",
+    "cast_floating",
+    "cast_to_compute",
+    "cast_to_param",
+    "cast_to_output",
+]
+
+DTypeLike = Any
+
+
+# Parameter-collection names treated as "norm" params for the
+# keep_batchnorm_fp32 exemption.  Matched as case-insensitive substrings of
+# any key on the leaf's pytree path — covers flax's ``batch_stats``
+# collection and conventional module names (``LayerNorm_0``, ``bn1``, ...).
+NORM_PATH_PATTERNS = (
+    "batchnorm",
+    "batch_stats",
+    "layernorm",
+    "layer_norm",
+    "rmsnorm",
+    "rms_norm",
+    "groupnorm",
+    "group_norm",
+    "_bn",
+    "bn_",
+    "norm",
+)
+
+
+def _path_is_norm(path) -> bool:
+    for entry in path:
+        name = getattr(entry, "key", getattr(entry, "name", None))
+        if isinstance(name, str):
+            low = name.lower()
+            if any(pat in low for pat in NORM_PATH_PATTERNS):
+                return True
+    return False
+
+
+def cast_floating(tree, dtype: DTypeLike, *, except_norms_to: DTypeLike = None):
+    """Cast every floating-point leaf of a pytree to ``dtype``.
+
+    Non-float leaves (int labels, bool masks, PRNG keys) pass through, the
+    same way the reference's input caster only touches float tensors
+    (``apex/amp/_initialize.py:53-63`` casts only ``is_floating_point``).
+
+    ``except_norms_to``: if set, leaves whose pytree path mentions a norm
+    module (see :data:`NORM_PATH_PATTERNS`) are cast to that dtype instead —
+    the ``keep_batchnorm_fp32`` / ``BN_convert_float`` exemption
+    (``apex/fp16_utils/fp16util.py:22-33``).
+    """
+
+    def _cast(path, x):
+        target = dtype
+        if except_norms_to is not None and _path_is_norm(path):
+            target = except_norms_to
+        if isinstance(x, (jax.Array, jnp.ndarray)) and jnp.issubdtype(
+            x.dtype, jnp.floating
+        ):
+            return x.astype(target)
+        if isinstance(x, float):
+            return jnp.asarray(x, target)
+        return x
+
+    return jax.tree_util.tree_map_with_path(_cast, tree)
+
+
+@dataclasses.dataclass(frozen=True)
+class Policy:
+    """A mixed-precision policy: where each dtype is used.
+
+    Functional analog of amp ``Properties`` (``apex/amp/frontend.py:9-101``):
+    ``cast_model_type``→``param_dtype``, ``opt_level`` compute behavior→
+    ``compute_dtype``, ``keep_batchnorm_fp32``→``norm_dtype``,
+    ``master_weights``→``master_weights``, ``loss_scale``→``loss_scale``.
+    """
+
+    name: str
+    param_dtype: DTypeLike
+    compute_dtype: DTypeLike
+    output_dtype: DTypeLike
+    norm_dtype: DTypeLike  # dtype for norm params/statistics (keep_batchnorm_fp32)
+    master_weights: bool
+    loss_scale: Union[str, float, None]  # "dynamic", a static float, or None
+
+    # -- casting helpers ---------------------------------------------------
+    def cast_to_compute(self, tree):
+        return cast_floating(tree, self.compute_dtype)
+
+    def cast_to_param(self, tree):
+        """Cast params to ``param_dtype``, keeping norm-module params at
+        ``norm_dtype`` (the ``keep_batchnorm_fp32`` O2 behavior,
+        ``apex/amp/frontend.py:126-146`` + ``fp16util.py:22``)."""
+        if jnp.dtype(self.norm_dtype) != jnp.dtype(self.param_dtype):
+            return cast_floating(
+                tree, self.param_dtype, except_norms_to=self.norm_dtype
+            )
+        return cast_floating(tree, self.param_dtype)
+
+    def cast_to_output(self, tree):
+        return cast_floating(tree, self.output_dtype)
+
+    def with_options(self, **kw) -> "Policy":
+        """Override fields, mirroring ``amp.initialize``'s keyword overrides
+        (``apex/amp/frontend.py:197-264`` ``cast_model_type=``, ``loss_scale=``...)."""
+        return dataclasses.replace(self, **kw)
+
+    @property
+    def uses_half_params(self) -> bool:
+        return jnp.dtype(self.param_dtype) != jnp.dtype(jnp.float32)
+
+
+def _make(name: str, half) -> Policy:
+    f32 = jnp.float32
+    overrides = {
+        "O0": dict(param_dtype=f32, compute_dtype=f32, output_dtype=f32,
+                   norm_dtype=f32, master_weights=False, loss_scale=None),
+        "O1": dict(param_dtype=f32, compute_dtype=half, output_dtype=f32,
+                   norm_dtype=f32, master_weights=False,
+                   loss_scale="dynamic" if half == jnp.float16 else None),
+        "O2": dict(param_dtype=half, compute_dtype=half, output_dtype=f32,
+                   norm_dtype=f32, master_weights=True, loss_scale="dynamic"),
+        "O3": dict(param_dtype=half, compute_dtype=half, output_dtype=half,
+                   norm_dtype=half, master_weights=False, loss_scale=1.0),
+    }[name]
+    return Policy(name=name, **overrides)
+
+
+def policy(opt_level: str = "O1", half_dtype: DTypeLike = jnp.bfloat16) -> Policy:
+    """Construct a policy from an Apex-style opt level.
+
+    ``half_dtype=jnp.bfloat16`` (default, MXU-native) or ``jnp.float16``
+    (reference-parity; activates dynamic loss scaling in O1).
+    Reference preset table: ``apex/amp/frontend.py:104-193``.
+    """
+    if opt_level not in ("O0", "O1", "O2", "O3"):
+        raise ValueError(
+            f"unknown opt_level {opt_level!r}; expected one of O0, O1, O2, O3 "
+            "(reference: apex/amp/frontend.py:104)"
+        )
+    return _make(opt_level, jnp.dtype(half_dtype).type)
+
+
+# Default bf16 presets, importable directly.
+O0 = policy("O0")
+O1 = policy("O1")
+O2 = policy("O2")
+O3 = policy("O3")
+
+
+def cast_to_compute(tree, p: Policy):
+    return p.cast_to_compute(tree)
+
+
+def cast_to_param(tree, p: Policy):
+    return p.cast_to_param(tree)
+
+
+def cast_to_output(tree, p: Policy):
+    return p.cast_to_output(tree)

@@ -1,0 +1,225 @@
+"""Loss scaling — dynamic/static scalers as pure jit-safe state machines.
+
+Reference semantics (``apex/amp/scaler.py``):
+
+- ``LossScaler`` holds ``_loss_scale``; dynamic mode starts at 2**16
+  (``scaler.py:38``), multiplies by 2 every 2000 overflow-free steps
+  (``_scale_seq_len``, ``:42``), halves on overflow with ``_min_loss_scale``
+  clamp (``update_scale`` ``:197-217``).
+- Overflow detection is fused into the unscale kernel via a ``noop_flag``
+  buffer (``csrc/multi_tensor_scale_kernel.cu``); the python fallback checks
+  isnan/isinf (``scaler.py:16-30``).
+- The hysteresis variant tolerates N consecutive overflows before backing off
+  (``csrc/update_scale_hysteresis.cu:5-40``, test
+  ``tests/L0/run_amp/test_update_scale_hysteresis.py``).
+- On overflow the step is *skipped* (``apex/amp/handle.py:128-154`` patches
+  ``optimizer.step`` to a no-op for that iteration).
+
+The TPU redesign: scaler state is an immutable :class:`LossScaleState` pytree
+threaded through the jitted train step; the overflow branch is a ``lax.cond``
+(SURVEY.md §7(b)) so there is **no device→host sync per iteration** — the
+reference pays one ``.item()`` round-trip every step (``scaler.py:200``).
+Skip-step is ``jnp.where`` on the parameter update, which XLA turns into a
+predicated update.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, NamedTuple, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "LossScaleState",
+    "DynamicLossScale",
+    "StaticLossScale",
+    "NoOpLossScale",
+    "all_finite",
+    "scale_loss",
+]
+
+
+class LossScaleState(NamedTuple):
+    """Device-resident scaler state (all jnp scalars, jit-carried).
+
+    ``scale``              — current loss scale (fp32).
+    ``growth_tracker``     — consecutive overflow-free steps
+                             (``_unskipped`` in ``apex/amp/scaler.py:44``).
+    ``hysteresis_tracker`` — remaining tolerated overflows before backoff
+                             (``csrc/update_scale_hysteresis.cu:12-24``).
+    ``found_inf``          — whether the *last* step overflowed (for skip-step
+                             predication and inspection).
+    """
+
+    scale: jnp.ndarray
+    growth_tracker: jnp.ndarray
+    hysteresis_tracker: jnp.ndarray
+    found_inf: jnp.ndarray
+
+
+def all_finite(tree) -> jnp.ndarray:
+    """Fused overflow check over a whole gradient pytree.
+
+    The analog of the ``noop_flag`` the multi-tensor kernels set on any
+    non-finite value (``csrc/multi_tensor_scale_kernel.cu:54-120``): one
+    scalar bool, computed inside jit, no host sync.
+    """
+    leaves = [
+        x
+        for x in jax.tree_util.tree_leaves(tree)
+        if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
+    ]
+    if not leaves:
+        return jnp.asarray(True)
+    finites = [jnp.all(jnp.isfinite(x)) for x in leaves]
+    return jnp.stack(finites).all()
+
+
+@dataclasses.dataclass(frozen=True)
+class DynamicLossScale:
+    """Dynamic loss scaling with growth interval and hysteresis.
+
+    Defaults mirror the reference: ``init_scale=2**16``
+    (``apex/amp/scaler.py:38``), ``growth_interval=2000`` (``:42``),
+    ``growth_factor=2``, ``backoff_factor=0.5`` (``update_scale``
+    ``:205-216``), ``min_loss_scale`` clamp (``frontend.py:32-40``),
+    ``hysteresis=1`` (plain scaler; set >1 for the
+    ``update_scale_hysteresis`` behavior ``csrc/update_scale_hysteresis.cu``).
+    """
+
+    init_scale: float = 2.0**16
+    growth_factor: float = 2.0
+    backoff_factor: float = 0.5
+    growth_interval: int = 2000
+    hysteresis: int = 1
+    min_scale: float = 1.0
+    max_scale: float = 2.0**24
+
+    def init(self) -> LossScaleState:
+        return LossScaleState(
+            scale=jnp.float32(self.init_scale),
+            growth_tracker=jnp.int32(0),
+            hysteresis_tracker=jnp.int32(self.hysteresis),
+            found_inf=jnp.asarray(False),
+        )
+
+    def scale(self, loss, state: LossScaleState):
+        """``loss * scale`` — yielded value of ``amp.scale_loss``
+        (``apex/amp/handle.py:113`` does ``loss.float()*loss_scale``)."""
+        return jnp.asarray(loss, jnp.float32) * state.scale
+
+    def unscale(self, grads, state: LossScaleState):
+        """Multiply grads by ``1/scale`` in fp32 — ``LossScaler.unscale``
+        (``apex/amp/scaler.py:94-119``).  Returns fp32 grads (master-grad
+        semantics of the O2 path)."""
+        inv = 1.0 / state.scale
+        return jax.tree_util.tree_map(
+            lambda g: jnp.asarray(g, jnp.float32) * inv, grads
+        )
+
+    def update(self, state: LossScaleState, grads_finite) -> LossScaleState:
+        """Pure-functional ``update_scale`` (``apex/amp/scaler.py:197-217``)
+        with hysteresis (``csrc/update_scale_hysteresis.cu:5-60``), as
+        branchless jnp.where (fully fused by XLA, no host sync):
+
+        - overflow: decrement hysteresis; if exhausted, ``scale *= backoff``
+          (clamped to ``min_scale``) and reset hysteresis+growth.
+        - clean step: increment growth tracker; at ``growth_interval``,
+          ``scale *= growth_factor`` (clamped to ``max_scale``) and reset.
+        """
+        grads_finite = jnp.asarray(grads_finite)
+
+        hyst_after = jnp.maximum(state.hysteresis_tracker - 1, 0)
+        do_backoff = jnp.logical_and(~grads_finite, hyst_after == 0)
+        grew = state.growth_tracker + 1
+        do_grow = jnp.logical_and(grads_finite, grew >= self.growth_interval)
+
+        new_scale = jnp.where(
+            do_backoff,
+            jnp.maximum(state.scale * self.backoff_factor, self.min_scale),
+            jnp.where(
+                do_grow,
+                jnp.minimum(state.scale * self.growth_factor, self.max_scale),
+                state.scale,
+            ),
+        )
+        new_growth = jnp.where(grads_finite, jnp.where(do_grow, 0, grew), 0)
+        new_hyst = jnp.where(
+            grads_finite,
+            jnp.int32(self.hysteresis),
+            jnp.where(do_backoff, jnp.int32(self.hysteresis), hyst_after),
+        )
+        return LossScaleState(
+            scale=new_scale,
+            growth_tracker=new_growth,
+            hysteresis_tracker=new_hyst,
+            found_inf=~grads_finite,
+        )
+
+    def adjust(self, params_new, params_old, state: LossScaleState):
+        """Predicated skip-step: keep old params when the step overflowed.
+
+        The reference patches ``optimizer.step`` to a skip
+        (``apex/amp/handle.py:128-154``); under jit a ``jnp.where`` select is
+        cheaper than a branch and keeps the program static.
+        """
+        keep_new = ~state.found_inf
+        return jax.tree_util.tree_map(
+            lambda n, o: jnp.where(keep_new, n, o), params_new, params_old
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class StaticLossScale:
+    """Fixed loss scale (``loss_scale=<float>`` in ``amp.initialize``,
+    ``apex/amp/frontend.py:27-45``)."""
+
+    loss_scale: float = 1.0
+
+    def init(self) -> LossScaleState:
+        return LossScaleState(
+            scale=jnp.float32(self.loss_scale),
+            growth_tracker=jnp.int32(0),
+            hysteresis_tracker=jnp.int32(1),
+            found_inf=jnp.asarray(False),
+        )
+
+    def scale(self, loss, state: LossScaleState):
+        return jnp.asarray(loss, jnp.float32) * state.scale
+
+    def unscale(self, grads, state: LossScaleState):
+        inv = 1.0 / state.scale
+        return jax.tree_util.tree_map(
+            lambda g: jnp.asarray(g, jnp.float32) * inv, grads
+        )
+
+    def update(self, state: LossScaleState, grads_finite) -> LossScaleState:
+        return state._replace(found_inf=~jnp.asarray(grads_finite))
+
+    def adjust(self, params_new, params_old, state: LossScaleState):
+        keep_new = ~state.found_inf
+        return jax.tree_util.tree_map(
+            lambda n, o: jnp.where(keep_new, n, o), params_new, params_old
+        )
+
+
+class NoOpLossScale(StaticLossScale):
+    """Identity scaler for O0/bf16 paths (scale == 1, never skips)."""
+
+    def __init__(self):
+        super().__init__(loss_scale=1.0)
+
+    def update(self, state: LossScaleState, grads_finite) -> LossScaleState:
+        return state
+
+    def adjust(self, params_new, params_old, state: LossScaleState):
+        return params_new
+
+
+def scale_loss(loss, state: LossScaleState):
+    """Functional stand-in for the ``with amp.scale_loss(...)`` context
+    (``apex/amp/handle.py:17``): returns the scaled loss to differentiate.
+    Unscaling/update happen explicitly on the resulting grads."""
+    return jnp.asarray(loss, jnp.float32) * state.scale

@@ -1,0 +1,30 @@
+"""apex_tpu.parallel — mesh construction, collectives and data parallelism.
+
+TPU-native replacement for ``apex/parallel`` (reference
+``apex/parallel/__init__.py``): instead of NCCL process groups and a
+DistributedDataParallel wrapper with hand-rolled flat-bucket all-reduce
+(``apex/parallel/distributed.py:131``), parallelism is declared as shardings on
+a named :class:`jax.sharding.Mesh` and gradient reduction is a ``psum`` the XLA
+SPMD partitioner schedules and overlaps automatically.
+"""
+
+from apex_tpu.parallel.mesh import (  # noqa: F401
+    MeshSpec,
+    initialize_model_parallel,
+    model_parallel_is_initialized,
+    destroy_model_parallel,
+    get_mesh,
+    get_data_parallel_world_size,
+    get_tensor_model_parallel_world_size,
+    get_pipeline_model_parallel_world_size,
+    get_context_parallel_world_size,
+    get_virtual_pipeline_model_parallel_world_size,
+    get_virtual_pipeline_model_parallel_rank,
+    set_virtual_pipeline_model_parallel_rank,
+    get_pipeline_model_parallel_split_rank,
+    DATA_AXIS,
+    TENSOR_AXIS,
+    PIPELINE_AXIS,
+    CONTEXT_AXIS,
+)
+from apex_tpu.parallel import collectives  # noqa: F401

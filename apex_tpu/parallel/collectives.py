@@ -1,0 +1,165 @@
+"""Collective communication layer — the NCCL/UCC analog.
+
+The reference routes every collective through ``torch.distributed`` with NCCL
+(process-group plumbing in ``apex/transformer/parallel_state.py:83-153``, raw
+p2p in ``apex/contrib/csrc/nccl_p2p/``).  On TPU the transport is the ICI mesh
+(DCN across slices) and the API is ``jax.lax`` collectives bound to named mesh
+axes; XLA schedules and overlaps them.  This module is the single place that
+names those primitives so higher layers (tensor_parallel.mappings, pipeline
+p2p, SyncBN, DDP) never spell ``jax.lax.psum`` themselves.
+
+All functions here must run inside a ``shard_map``/``pmap`` context where
+``axis_name`` is bound.  ``shard_over`` is the helper that enters that context
+from the outside using the registered global mesh.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from apex_tpu.parallel import mesh as mesh_lib
+
+__all__ = [
+    "all_reduce",
+    "all_gather",
+    "reduce_scatter",
+    "ppermute",
+    "all_to_all",
+    "broadcast",
+    "axis_index",
+    "axis_size",
+    "send_recv_next",
+    "send_recv_prev",
+    "shard_over",
+    "named_sharding",
+]
+
+AxisName = Union[str, Sequence[str]]
+
+
+def axis_index(axis: AxisName):
+    """Rank along a mesh axis (inside shard_map). Replaces
+    ``torch.distributed.get_rank(group)``."""
+    return lax.axis_index(axis)
+
+
+def axis_size(axis: AxisName) -> int:
+    """World size along a mesh axis (inside shard_map)."""
+    return lax.axis_size(axis)
+
+
+def all_reduce(x, axis: AxisName, op: str = "sum"):
+    """All-reduce over a mesh axis.
+
+    Replaces ``torch.distributed.all_reduce`` on the TP/DP groups (e.g.
+    ``apex/transformer/tensor_parallel/mappings.py:31`` ``_reduce``).
+    """
+    if op == "sum":
+        return lax.psum(x, axis)
+    if op == "mean":
+        return lax.pmean(x, axis)
+    if op == "max":
+        return lax.pmax(x, axis)
+    if op == "min":
+        return lax.pmin(x, axis)
+    raise ValueError(f"unsupported all_reduce op: {op!r}")
+
+
+def all_gather(x, axis: AxisName, *, concat_axis: int = 0, tiled: bool = True):
+    """All-gather shards along ``concat_axis``.
+
+    Replaces ``torch.distributed.all_gather`` / ``_all_gather_base`` (e.g.
+    sequence-parallel gather ``apex/transformer/tensor_parallel/mappings.py:103``).
+    ``tiled=True`` concatenates (the Megatron convention); ``tiled=False``
+    stacks a new leading axis (the raw all_gather convention).
+    """
+    return lax.all_gather(x, axis, axis=concat_axis, tiled=tiled)
+
+
+def reduce_scatter(x, axis: AxisName, *, scatter_axis: int = 0):
+    """Reduce-scatter: sum over the axis group, keep this rank's shard.
+
+    Replaces ``torch.distributed.reduce_scatter_tensor`` (sequence-parallel
+    reduce-scatter ``apex/transformer/tensor_parallel/mappings.py:122``).
+    """
+    return lax.psum_scatter(x, axis, scatter_dimension=scatter_axis, tiled=True)
+
+
+def ppermute(x, axis: AxisName, perm):
+    """Point-to-point permutation — the p2p send/recv analog
+    (``apex/transformer/pipeline_parallel/p2p_communication.py:48-166``)."""
+    return lax.ppermute(x, axis, perm)
+
+
+def send_recv_next(x, axis: AxisName):
+    """Send to rank+1, receive from rank-1 along ``axis`` (ring, wrapping).
+
+    The pipeline forward-direction transfer: stage i's activations arrive at
+    stage i+1 (``p2p_communication.send_forward`` ``:445``).  The wrap-around
+    edge (last→first) carries data the consumer must mask/ignore, matching the
+    reference where first stage never reads a recv'd activation.
+    """
+    n = lax.axis_size(axis)
+    return lax.ppermute(x, axis, [(i, (i + 1) % n) for i in range(n)])
+
+
+def send_recv_prev(x, axis: AxisName):
+    """Send to rank-1, receive from rank+1 (pipeline backward direction,
+    ``p2p_communication.send_backward`` ``:469``)."""
+    n = lax.axis_size(axis)
+    return lax.ppermute(x, axis, [(i, (i - 1) % n) for i in range(n)])
+
+
+def all_to_all(x, axis: AxisName, *, split_axis: int, concat_axis: int):
+    """All-to-all — used by DeepSpeed-Ulysses-style sequence parallelism and
+    expert parallelism (absent in the reference; first-class here)."""
+    return lax.all_to_all(
+        x, axis, split_axis=split_axis, concat_axis=concat_axis, tiled=True
+    )
+
+
+def broadcast(x, axis: AxisName, root: int = 0):
+    """Broadcast ``root``'s shard to every rank on the axis.
+
+    Replaces ``torch.distributed.broadcast`` (e.g. tensor-parallel input-data
+    broadcast ``apex/transformer/tensor_parallel/data.py:80``).  Implemented as
+    a masked psum: ranks != root contribute zeros.
+    """
+    idx = lax.axis_index(axis)
+    masked = jnp.where(idx == root, x, jnp.zeros_like(x))
+    return lax.psum(masked, axis)
+
+
+def shard_over(
+    fn: Callable,
+    *,
+    mesh: Optional[Mesh] = None,
+    in_specs: Any,
+    out_specs: Any,
+    check_vma: bool = False,
+):
+    """Wrap ``fn`` in a ``shard_map`` over the registered global mesh.
+
+    The bridge from the outer (global-array) world into the per-shard world
+    where the collectives above are legal.  Pipeline schedules and the
+    distributed tests use this; most library code instead relies on sharding
+    annotations and lets XLA infer collectives.
+    """
+    if mesh is None:
+        mesh = mesh_lib.get_mesh()
+    return jax.shard_map(
+        fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=check_vma
+    )
+
+
+def named_sharding(*spec, mesh: Optional[Mesh] = None) -> NamedSharding:
+    """Shorthand for ``NamedSharding(get_mesh(), PartitionSpec(*spec))``."""
+    if mesh is None:
+        mesh = mesh_lib.get_mesh()
+    return NamedSharding(mesh, P(*spec))

@@ -1,0 +1,253 @@
+"""Device-mesh construction — the SPMD analog of Megatron ``parallel_state``.
+
+The reference (``apex/transformer/parallel_state.py:155``,
+``initialize_model_parallel``) builds NCCL process groups for every
+data/tensor/pipeline/model/embedding combination and stores them in module
+globals with rank accessors (``:421-760``).  Under JAX SPMD there are no
+process groups: a single :class:`jax.sharding.Mesh` with named axes carries
+the whole decomposition, XLA inserts collectives from sharding annotations,
+and "which group am I in" becomes ``jax.lax.axis_index(axis_name)`` inside
+``shard_map``.
+
+This module keeps the reference's *API shape* (initialize / accessors /
+destroy) so users migrating from Apex find the same entry points, but the
+state it manages is just a mesh + the virtual-pipeline bookkeeping the
+interleaved schedule needs (reference ``parallel_state.py:521-545``).
+
+Axis layout (innermost = fastest-varying device index = best ICI locality):
+
+    (dp, pp, cp, tp)
+
+``tp`` is innermost so tensor-parallel collectives (the most
+bandwidth-hungry, fired inside every linear layer) ride adjacent-chip ICI
+links; ``dp`` is outermost so data-parallel gradient reduction can span the
+slower DCN axis on multi-slice systems.  This mirrors the reference's rank
+grid documentation (``parallel_state.py:186-200``) with the GPU "ranks
+8..15 = second DP replica" layout replaced by mesh-axis ordering.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+__all__ = [
+    "DATA_AXIS",
+    "TENSOR_AXIS",
+    "PIPELINE_AXIS",
+    "CONTEXT_AXIS",
+    "MeshSpec",
+    "initialize_model_parallel",
+    "model_parallel_is_initialized",
+    "destroy_model_parallel",
+    "get_mesh",
+    "get_data_parallel_world_size",
+    "get_tensor_model_parallel_world_size",
+    "get_pipeline_model_parallel_world_size",
+    "get_context_parallel_world_size",
+    "get_virtual_pipeline_model_parallel_world_size",
+    "get_virtual_pipeline_model_parallel_rank",
+    "set_virtual_pipeline_model_parallel_rank",
+    "get_pipeline_model_parallel_split_rank",
+]
+
+# Canonical axis names.  Everything in apex_tpu refers to mesh axes by these.
+DATA_AXIS = "dp"
+PIPELINE_AXIS = "pp"
+CONTEXT_AXIS = "cp"
+TENSOR_AXIS = "tp"
+
+_AXIS_ORDER = (DATA_AXIS, PIPELINE_AXIS, CONTEXT_AXIS, TENSOR_AXIS)
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """Static description of a parallel decomposition.
+
+    Analog of the (tp, pp, vpp, split_rank) argument bundle of
+    ``initialize_model_parallel`` (``apex/transformer/parallel_state.py:155``).
+    """
+
+    tensor_model_parallel_size: int = 1
+    pipeline_model_parallel_size: int = 1
+    context_parallel_size: int = 1
+    data_parallel_size: Optional[int] = None  # None = fill remaining devices
+    virtual_pipeline_model_parallel_size: Optional[int] = None
+    pipeline_model_parallel_split_rank: Optional[int] = None
+
+    def resolve_dp(self, n_devices: int) -> int:
+        model = (
+            self.tensor_model_parallel_size
+            * self.pipeline_model_parallel_size
+            * self.context_parallel_size
+        )
+        if n_devices % model != 0:
+            raise ValueError(
+                f"world size {n_devices} not divisible by "
+                f"tp*pp*cp={model} "
+                f"(tp={self.tensor_model_parallel_size}, "
+                f"pp={self.pipeline_model_parallel_size}, "
+                f"cp={self.context_parallel_size})"
+            )
+        dp = n_devices // model
+        if self.data_parallel_size is not None and self.data_parallel_size != dp:
+            raise ValueError(
+                f"data_parallel_size={self.data_parallel_size} inconsistent with "
+                f"{n_devices} devices / model-parallel size {model} (= {dp})"
+            )
+        return dp
+
+
+class _State:
+    mesh: Optional[Mesh] = None
+    spec: Optional[MeshSpec] = None
+    virtual_pipeline_rank: Optional[int] = None
+
+
+_STATE = _State()
+
+
+def initialize_model_parallel(
+    tensor_model_parallel_size: int = 1,
+    pipeline_model_parallel_size: int = 1,
+    virtual_pipeline_model_parallel_size: Optional[int] = None,
+    pipeline_model_parallel_split_rank: Optional[int] = None,
+    context_parallel_size: int = 1,
+    *,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build and register the global device mesh.
+
+    Mirrors ``apex/transformer/parallel_state.py:155`` but returns a
+    :class:`jax.sharding.Mesh` instead of creating NCCL groups.  The mesh can
+    also be used directly (``with get_mesh():``) — registration exists so the
+    Megatron-style accessors work without threading the mesh everywhere.
+
+    ``devices`` defaults to ``jax.devices()``; pass an explicit list to build
+    a sub-mesh (e.g. for tests) or to control device order.
+    """
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+    spec = MeshSpec(
+        tensor_model_parallel_size=tensor_model_parallel_size,
+        pipeline_model_parallel_size=pipeline_model_parallel_size,
+        context_parallel_size=context_parallel_size,
+        virtual_pipeline_model_parallel_size=virtual_pipeline_model_parallel_size,
+        pipeline_model_parallel_split_rank=pipeline_model_parallel_split_rank,
+    )
+    dp = spec.resolve_dp(len(devices))
+    shape = (
+        dp,
+        pipeline_model_parallel_size,
+        context_parallel_size,
+        tensor_model_parallel_size,
+    )
+    if virtual_pipeline_model_parallel_size is not None:
+        if pipeline_model_parallel_size < 2:
+            raise ValueError(
+                "virtual pipeline parallelism requires pipeline_model_parallel_size >= 2"
+            )
+    dev_array = np.asarray(devices).reshape(shape)
+    mesh = Mesh(dev_array, axis_names=_AXIS_ORDER)
+    _STATE.mesh = mesh
+    _STATE.spec = spec
+    _STATE.virtual_pipeline_rank = None
+    return mesh
+
+
+def model_parallel_is_initialized() -> bool:
+    """Analog of ``parallel_state.model_parallel_is_initialized`` (``:423``)."""
+    return _STATE.mesh is not None
+
+
+def destroy_model_parallel() -> None:
+    """Analog of ``parallel_state.destroy_model_parallel`` (``:761``)."""
+    _STATE.mesh = None
+    _STATE.spec = None
+    _STATE.virtual_pipeline_rank = None
+
+
+def get_mesh() -> Mesh:
+    if _STATE.mesh is None:
+        raise RuntimeError(
+            "model parallel mesh is not initialized; call "
+            "apex_tpu.parallel.initialize_model_parallel(...) first"
+        )
+    return _STATE.mesh
+
+
+def _axis_size(axis: str) -> int:
+    return get_mesh().shape[axis]
+
+
+def get_data_parallel_world_size() -> int:
+    """Analog of ``parallel_state.get_data_parallel_world_size`` (``:730``)."""
+    return _axis_size(DATA_AXIS)
+
+
+def get_tensor_model_parallel_world_size() -> int:
+    """Analog of ``parallel_state.get_tensor_model_parallel_world_size`` (``:476``)."""
+    return _axis_size(TENSOR_AXIS)
+
+
+def get_pipeline_model_parallel_world_size() -> int:
+    """Analog of ``parallel_state.get_pipeline_model_parallel_world_size`` (``:484``)."""
+    return _axis_size(PIPELINE_AXIS)
+
+
+def get_context_parallel_world_size() -> int:
+    return _axis_size(CONTEXT_AXIS)
+
+
+def get_virtual_pipeline_model_parallel_world_size() -> Optional[int]:
+    """Analog of ``parallel_state.get_virtual_pipeline_model_parallel_world_size``
+    (``:541``)."""
+    if _STATE.spec is None:
+        return None
+    return _STATE.spec.virtual_pipeline_model_parallel_size
+
+
+def get_virtual_pipeline_model_parallel_rank() -> Optional[int]:
+    """Current model-chunk index during an interleaved-schedule step.
+
+    Reference: ``parallel_state.get_virtual_pipeline_model_parallel_rank``
+    (``:521``).  In SPMD this is *not* a device property — every device runs
+    every chunk of its stage — so it is plain host-side schedule bookkeeping.
+    """
+    return _STATE.virtual_pipeline_rank
+
+
+def set_virtual_pipeline_model_parallel_rank(rank: Optional[int]) -> None:
+    """Reference: ``parallel_state.set_virtual_pipeline_model_parallel_rank``
+    (``:531``)."""
+    _STATE.virtual_pipeline_rank = rank
+
+
+def get_pipeline_model_parallel_split_rank() -> Optional[int]:
+    """Encoder/decoder split stage for T5-style models.
+
+    Reference: ``parallel_state.get_pipeline_model_parallel_split_rank``
+    (``:512``).
+    """
+    if _STATE.spec is None:
+        return None
+    return _STATE.spec.pipeline_model_parallel_split_rank
+
+
+def get_rank_info() -> str:
+    """Human-readable mesh summary, analog of ``parallel_state.get_rank_info``
+    (``:421-431``)."""
+    if not model_parallel_is_initialized():
+        return "mesh uninitialized"
+    m = get_mesh()
+    return (
+        f"mesh(dp={m.shape[DATA_AXIS]}, pp={m.shape[PIPELINE_AXIS]}, "
+        f"cp={m.shape[CONTEXT_AXIS]}, tp={m.shape[TENSOR_AXIS]}) "
+        f"process {jax.process_index()}/{jax.process_count()}"
+    )

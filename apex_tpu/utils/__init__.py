@@ -1,0 +1,15 @@
+"""apex_tpu.utils — RNG policy, tree/flatten helpers, timers, logging."""
+
+from apex_tpu.utils.random import (  # noqa: F401
+    RngPolicy,
+    model_parallel_rngs,
+    fold_in_axis,
+)
+from apex_tpu.utils.tree import (  # noqa: F401
+    flatten_to_buffer,
+    unflatten_from_buffer,
+    tree_l2_norm,
+    per_leaf_l2_norms,
+    tree_size,
+)
+from apex_tpu.utils.timers import Timers, get_timers  # noqa: F401

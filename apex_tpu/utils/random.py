@@ -1,0 +1,90 @@
+"""Model-parallel RNG policy.
+
+Reference: Megatron's ``CudaRNGStatesTracker``
+(``apex/transformer/tensor_parallel/random.py:124``) keeps named CUDA RNG
+streams and forks a ``model-parallel-rng`` state seeded with
+``seed + 2718 + tp_rank`` (``model_parallel_cuda_manual_seed``,
+``random.py:204-236``) so that:
+
+- tensor-parallel ranks get **different** dropout masks on sharded
+  activations (each rank holds different neurons), but
+- **the same** seed for operations on replicated activations.
+
+JAX PRNG is functional — there are no global states to track, so the whole
+tracker collapses to key derivation: :func:`model_parallel_rngs` returns a
+``(replicated_key, model_parallel_key)`` pair where the model-parallel key is
+``fold_in(key, MODEL_PARALLEL_OFFSET + axis_index(tp))``.  Inside
+``shard_map`` the fold-in happens per shard; under plain pjit use
+:func:`fold_in_axis` inside the partitioned function.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import zlib
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["RngPolicy", "model_parallel_rngs", "fold_in_axis"]
+
+# Reference uses `seed + 2718` for the tensor-parallel stream offset
+# (apex/transformer/tensor_parallel/random.py:219); we fold the same constant
+# into the key for the analogous split.
+_MODEL_PARALLEL_OFFSET = 2718
+# Pipeline stages additionally offset by 100 * pp_rank in Megatron-LM
+# conventions (the reference test harness seeds per-stage the same way).
+_PIPELINE_OFFSET = 100
+
+
+def fold_in_axis(key: jax.Array, axis_name: str, offset: int = 0) -> jax.Array:
+    """Derive a per-rank key along a mesh axis (call inside shard_map/jit
+    where ``axis_name`` is bound)."""
+    return jax.random.fold_in(key, offset + lax.axis_index(axis_name))
+
+
+def model_parallel_rngs(
+    key: jax.Array, tp_axis: str = "tp", pp_axis: Optional[str] = None
+) -> Tuple[jax.Array, jax.Array]:
+    """Return ``(replicated_key, model_parallel_key)``.
+
+    Analog of ``model_parallel_cuda_manual_seed``
+    (``apex/transformer/tensor_parallel/random.py:204``): the replicated key
+    is identical on all tp ranks (use for dropout on replicated activations);
+    the model-parallel key differs per tp rank (use for dropout on sharded
+    activations and per-rank init).  Must be called where ``tp_axis`` is bound.
+    """
+    mp_key = fold_in_axis(key, tp_axis, _MODEL_PARALLEL_OFFSET)
+    if pp_axis is not None:
+        key = fold_in_axis(key, pp_axis, _PIPELINE_OFFSET)
+        mp_key = fold_in_axis(mp_key, pp_axis, _PIPELINE_OFFSET)
+    return key, mp_key
+
+
+@dataclasses.dataclass(frozen=True)
+class RngPolicy:
+    """Named-stream facade matching the tracker API shape.
+
+    ``CudaRNGStatesTracker.add/fork`` (``random.py:141-199``) becomes pure
+    key derivation: ``policy.key(name, step)`` is deterministic in
+    (base_seed, name, step) and, for ``model_parallel=True`` streams,
+    in the tp rank.
+    """
+
+    base_seed: int = 0
+    tp_axis: str = "tp"
+
+    def base_key(self) -> jax.Array:
+        return jax.random.PRNGKey(self.base_seed)
+
+    def key(self, name: str, step=0, *, model_parallel: bool = False) -> jax.Array:
+        # crc32, not hash(): python string hashing is randomized per process,
+        # which would give different keys on different hosts of a multi-host
+        # run — silent divergence of replicated state.
+        k = jax.random.fold_in(self.base_key(), zlib.crc32(name.encode()))
+        k = jax.random.fold_in(k, step)
+        if model_parallel:
+            k = fold_in_axis(k, self.tp_axis, _MODEL_PARALLEL_OFFSET)
+        return k

@@ -1,0 +1,118 @@
+"""Pytree flatten/unflatten and norm helpers — the ``apex_C`` +
+``multi_tensor_l2norm`` analog.
+
+Reference: ``apex_C.flatten/unflatten`` (``csrc/flatten_unflatten.cpp:15-17``)
+pack a tensor list into one contiguous buffer for bucketed NCCL all-reduce;
+``amp_C.multi_tensor_l2norm`` (``csrc/multi_tensor_l2norm_kernel.cu``)
+computes global and per-tensor L2 norms in one launch.
+
+On TPU, XLA already fuses per-leaf elementwise work, so flattening is only
+needed when an algorithm genuinely wants one buffer (ZeRO bucket sharding,
+Pallas multi-tensor kernels).  These helpers provide it with static metadata
+so the round-trip stays jit-compatible.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "flatten_to_buffer",
+    "unflatten_from_buffer",
+    "tree_l2_norm",
+    "per_leaf_l2_norms",
+    "tree_size",
+]
+
+
+class _FlatMeta(NamedTuple):
+    treedef: Any
+    shapes: Tuple[Tuple[int, ...], ...]
+    dtypes: Tuple[Any, ...]
+    offsets: Tuple[int, ...]  # element offsets into the flat buffer
+    total: int
+    pad_to: int
+
+
+def flatten_to_buffer(
+    tree, dtype=None, pad_to: int = 1
+) -> Tuple[jnp.ndarray, _FlatMeta]:
+    """Concatenate all leaves into one 1-D buffer (+ static metadata).
+
+    ``pad_to`` rounds the total length up (ZeRO bucketing wants shard-divisible
+    buffers, cf. fixed-size buckets in
+    ``apex/contrib/optimizers/distributed_fused_adam.py:397``).
+    """
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    shapes = tuple(tuple(np.shape(x)) for x in leaves)
+    dtypes = tuple(jnp.asarray(x).dtype for x in leaves)
+    if dtype is None and len(set(dtypes)) > 1:
+        raise ValueError(
+            "flatten_to_buffer on a mixed-dtype tree requires an explicit "
+            f"dtype= (got leaf dtypes {sorted({str(d) for d in dtypes})}); "
+            "an implicit cast would silently lose precision on the round-trip"
+        )
+    sizes = [int(np.prod(s)) for s in shapes]  # np.prod(()) == 1 for scalars
+    offsets = tuple(int(x) for x in np.cumsum([0] + sizes[:-1]))
+    total = int(sum(sizes))
+    padded = ((total + pad_to - 1) // pad_to) * pad_to if total else pad_to
+    out_dtype = dtype or (dtypes[0] if dtypes else jnp.float32)
+    if leaves:
+        flat = jnp.concatenate(
+            [jnp.ravel(jnp.asarray(x, out_dtype)) for x in leaves]
+        )
+        if padded != total:
+            flat = jnp.pad(flat, (0, padded - total))
+    else:
+        flat = jnp.zeros((padded,), out_dtype)
+    meta = _FlatMeta(
+        treedef=treedef,
+        shapes=shapes,
+        dtypes=dtypes,
+        offsets=offsets,
+        total=total,
+        pad_to=padded,
+    )
+    return flat, meta
+
+
+def unflatten_from_buffer(buf: jnp.ndarray, meta: _FlatMeta):
+    """Inverse of :func:`flatten_to_buffer` (``apex_C.unflatten`` analog),
+    restoring original shapes and dtypes."""
+    leaves = []
+    for shape, dt, off in zip(meta.shapes, meta.dtypes, meta.offsets):
+        size = int(np.prod(shape))
+        chunk = jax.lax.dynamic_slice_in_dim(buf, off, size)
+        leaves.append(jnp.asarray(chunk.reshape(shape), dt))
+    return jax.tree_util.tree_unflatten(meta.treedef, leaves)
+
+
+def per_leaf_l2_norms(tree) -> List[jnp.ndarray]:
+    """Per-tensor L2 norms in fp32 (``multi_tensor_l2norm`` with
+    ``per_tensor=True``, ``csrc/multi_tensor_l2norm_kernel.cu:480-560``)."""
+    return [
+        jnp.sqrt(jnp.sum(jnp.square(jnp.asarray(x, jnp.float32))))
+        for x in jax.tree_util.tree_leaves(tree)
+    ]
+
+
+def tree_l2_norm(tree) -> jnp.ndarray:
+    """Global L2 norm over a pytree in fp32 — one fused reduction
+    (``multi_tensor_l2norm`` global output)."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        return jnp.float32(0)
+    sq = [jnp.sum(jnp.square(jnp.asarray(x, jnp.float32))) for x in leaves]
+    return jnp.sqrt(jnp.sum(jnp.stack(sq)))
+
+
+def tree_size(tree) -> int:
+    """Total element count of a pytree (host-side, static).
+
+    Consistent with :func:`flatten_to_buffer`'s un-padded total, including
+    zero-element leaves (``np.prod(()) == 1`` covers scalars)."""
+    return int(sum(np.prod(np.shape(x)) for x in jax.tree_util.tree_leaves(tree)))

@@ -1,0 +1,202 @@
+"""amp policy + loss scaler tests.
+
+Scaler behavior matrix mirrors ``tests/L0/run_amp`` (dynamic scale growth /
+backoff, hysteresis — ``tests/L0/run_amp/test_update_scale_hysteresis.py``)
+re-expressed against the functional API.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu import amp
+
+
+class TestPolicy:
+    def test_presets(self):
+        o2 = amp.policy("O2")
+        assert o2.param_dtype == jnp.bfloat16
+        assert o2.master_weights
+        assert o2.loss_scale == "dynamic"
+        o0 = amp.policy("O0")
+        assert o0.param_dtype == jnp.float32
+        assert o0.loss_scale is None
+        o3 = amp.policy("O3")
+        assert o3.output_dtype == jnp.bfloat16
+        assert not o3.master_weights
+
+    def test_fp16_variant(self):
+        o1 = amp.policy("O1", half_dtype=jnp.float16)
+        assert o1.compute_dtype == jnp.float16
+        assert o1.loss_scale == "dynamic"  # fp16 O1 needs scaling
+        assert amp.policy("O1").loss_scale is None  # bf16 O1 does not
+
+    def test_cast_preserves_nonfloat(self):
+        p = amp.policy("O2")
+        tree = {"w": jnp.ones((2, 2)), "ids": jnp.arange(3), "n": 5}
+        out = p.cast_to_compute(tree)
+        assert out["w"].dtype == jnp.bfloat16
+        assert out["ids"].dtype == jnp.int32
+        assert out["n"] == 5
+
+    def test_unknown_level_raises(self):
+        with pytest.raises(ValueError):
+            amp.policy("O4")
+
+    def test_o2_keeps_norm_params_fp32(self):
+        """keep_batchnorm_fp32 exemption (apex/fp16_utils/fp16util.py:22)."""
+        p = amp.policy("O2")
+        tree = {
+            "Dense_0": {"kernel": jnp.ones((2, 2))},
+            "BatchNorm_0": {"scale": jnp.ones(2), "bias": jnp.zeros(2)},
+            "LayerNorm_1": {"scale": jnp.ones(2)},
+        }
+        out = p.cast_to_param(tree)
+        assert out["Dense_0"]["kernel"].dtype == jnp.bfloat16
+        assert out["BatchNorm_0"]["scale"].dtype == jnp.float32
+        assert out["LayerNorm_1"]["scale"].dtype == jnp.float32
+
+    def test_o3_casts_norms_too(self):
+        p = amp.policy("O3")
+        out = p.cast_to_param({"BatchNorm_0": {"scale": jnp.ones(2)}})
+        assert out["BatchNorm_0"]["scale"].dtype == jnp.bfloat16
+
+
+class TestDynamicLossScale:
+    def test_growth_after_interval(self):
+        algo = amp.DynamicLossScale(init_scale=4.0, growth_interval=3)
+        s = algo.init()
+        for _ in range(2):
+            s = algo.update(s, True)
+            assert float(s.scale) == 4.0
+        s = algo.update(s, True)
+        assert float(s.scale) == 8.0
+        assert int(s.growth_tracker) == 0
+
+    def test_backoff_on_overflow(self):
+        algo = amp.DynamicLossScale(init_scale=16.0)
+        s = algo.init()
+        s = algo.update(s, False)
+        assert float(s.scale) == 8.0
+        assert bool(s.found_inf)
+
+    def test_overflow_resets_growth(self):
+        algo = amp.DynamicLossScale(init_scale=4.0, growth_interval=2)
+        s = algo.init()
+        s = algo.update(s, True)
+        s = algo.update(s, False)  # overflow: halve, reset tracker
+        s = algo.update(s, True)
+        assert float(s.scale) == 2.0
+        assert int(s.growth_tracker) == 1
+
+    def test_hysteresis(self):
+        """First overflow tolerated with hysteresis=2; second backs off.
+        (csrc/update_scale_hysteresis.cu semantics)."""
+        algo = amp.DynamicLossScale(init_scale=16.0, hysteresis=2)
+        s = algo.init()
+        s = algo.update(s, False)
+        assert float(s.scale) == 16.0  # tolerated
+        s = algo.update(s, False)
+        assert float(s.scale) == 8.0  # exhausted → backoff
+        # clean step restores hysteresis budget
+        s = algo.update(s, True)
+        s = algo.update(s, False)
+        assert float(s.scale) == 8.0
+
+    def test_min_scale_clamp(self):
+        algo = amp.DynamicLossScale(init_scale=2.0, min_scale=1.0)
+        s = algo.init()
+        for _ in range(5):
+            s = algo.update(s, False)
+        assert float(s.scale) == 1.0
+
+    def test_scale_unscale_roundtrip(self):
+        algo = amp.DynamicLossScale(init_scale=2.0**10)
+        s = algo.init()
+        grads = {"a": jnp.full((4,), 2.0**10, jnp.float16)}
+        un = algo.unscale(grads, s)
+        assert un["a"].dtype == jnp.float32
+        np.testing.assert_allclose(np.asarray(un["a"]), np.ones(4))
+
+    def test_update_inside_jit(self):
+        algo = amp.DynamicLossScale(init_scale=4.0, growth_interval=1)
+
+        @jax.jit
+        def step(s, ok):
+            return algo.update(s, ok)
+
+        s = algo.init()
+        s = step(s, jnp.asarray(True))
+        assert float(s.scale) == 8.0
+        s = step(s, jnp.asarray(False))
+        assert float(s.scale) == 4.0
+
+    def test_skip_step_adjust(self):
+        algo = amp.DynamicLossScale()
+        s = algo.init()
+        s = algo.update(s, False)  # overflow
+        old = {"w": jnp.zeros(3)}
+        new = {"w": jnp.ones(3)}
+        kept = algo.adjust(new, old, s)
+        np.testing.assert_allclose(np.asarray(kept["w"]), 0.0)
+        s = algo.update(s, True)
+        kept = algo.adjust(new, old, s)
+        np.testing.assert_allclose(np.asarray(kept["w"]), 1.0)
+
+
+class TestAllFinite:
+    def test_finite(self):
+        assert bool(amp.all_finite({"a": jnp.ones(3), "b": jnp.zeros(2)}))
+
+    def test_nan(self):
+        assert not bool(amp.all_finite({"a": jnp.array([1.0, jnp.nan])}))
+
+    def test_inf(self):
+        assert not bool(amp.all_finite({"a": jnp.array([jnp.inf])}))
+
+    def test_ignores_ints(self):
+        assert bool(amp.all_finite({"ids": jnp.arange(3)}))
+
+
+class TestMasterWeights:
+    def test_roundtrip(self):
+        params = {"w": jnp.ones((2, 2), jnp.bfloat16), "step": jnp.int32(0)}
+        m = amp.make_master(params)
+        assert m.params["w"].dtype == jnp.float32
+        assert m.params["step"].dtype == jnp.int32
+        back = amp.master_to_model(m)
+        assert back["w"].dtype == jnp.bfloat16
+
+    def test_master_precision_survives(self):
+        """fp32 master accumulates updates a bf16 param would lose."""
+        params = {"w": jnp.ones((1,), jnp.bfloat16)}
+        m = amp.make_master(params)
+        small = 1e-4
+        new_master = m._replace(
+            params={"w": m.params["w"] + small}
+        )
+        assert float(new_master.params["w"][0]) != 1.0  # fp32 keeps it
+        assert float(amp.master_to_model(new_master)["w"][0]) == 1.0  # bf16 rounds
+
+
+class TestFrontend:
+    def test_initialize_o2(self):
+        params = {"w": jnp.ones((2, 2))}
+        conf, state = amp.initialize(params, opt_level="O2")
+        assert conf.policy.name == "O2"
+        assert isinstance(conf.loss_scaler, amp.DynamicLossScale)
+        assert state.master is not None
+        assert state.master.params["w"].dtype == jnp.float32
+
+    def test_initialize_override_scale(self):
+        conf, state = amp.initialize(opt_level="O2", loss_scale=128.0)
+        assert isinstance(conf.loss_scaler, amp.StaticLossScale)
+        assert float(state.scaler.scale) == 128.0
+
+    def test_state_dict_roundtrip(self):
+        conf, state = amp.initialize(opt_level="O2")
+        s2 = conf.loss_scaler.update(state.scaler, False)
+        sd = amp.state_dict(state._replace(scaler=s2))
+        restored = amp.load_state_dict(state, sd)
+        assert float(restored.scaler.scale) == float(s2.scale)

@@ -1,0 +1,74 @@
+"""Mesh builder tests — analog of ``tests/L0/run_transformer/test_parallel_state.py``."""
+
+import jax
+import pytest
+
+from apex_tpu import parallel
+from apex_tpu.parallel import mesh as mesh_lib
+
+
+def test_initialize_default():
+    m = parallel.initialize_model_parallel()
+    assert parallel.model_parallel_is_initialized()
+    assert parallel.get_tensor_model_parallel_world_size() == 1
+    assert parallel.get_pipeline_model_parallel_world_size() == 1
+    assert parallel.get_data_parallel_world_size() == len(jax.devices())
+    assert m is parallel.get_mesh()
+
+
+@pytest.mark.parametrize("tp,pp", [(2, 1), (4, 1), (2, 2), (1, 4), (2, 4), (8, 1)])
+def test_grid_shapes(tp, pp):
+    n = len(jax.devices())
+    if tp * pp > n:
+        pytest.skip("not enough devices")
+    parallel.initialize_model_parallel(
+        tensor_model_parallel_size=tp, pipeline_model_parallel_size=pp
+    )
+    assert parallel.get_tensor_model_parallel_world_size() == tp
+    assert parallel.get_pipeline_model_parallel_world_size() == pp
+    assert parallel.get_data_parallel_world_size() == n // (tp * pp)
+
+
+def test_indivisible_raises():
+    if len(jax.devices()) % 3 == 0:
+        pytest.skip("world size divisible by 3")
+    with pytest.raises(ValueError):
+        parallel.initialize_model_parallel(tensor_model_parallel_size=3)
+
+
+def test_virtual_pipeline_bookkeeping():
+    parallel.initialize_model_parallel(
+        pipeline_model_parallel_size=2, virtual_pipeline_model_parallel_size=2
+    )
+    assert parallel.get_virtual_pipeline_model_parallel_world_size() == 2
+    assert parallel.get_virtual_pipeline_model_parallel_rank() is None
+    parallel.set_virtual_pipeline_model_parallel_rank(1)
+    assert parallel.get_virtual_pipeline_model_parallel_rank() == 1
+
+
+def test_virtual_pipeline_requires_pp():
+    with pytest.raises(ValueError):
+        parallel.initialize_model_parallel(
+            pipeline_model_parallel_size=1, virtual_pipeline_model_parallel_size=2
+        )
+
+
+def test_destroy():
+    parallel.initialize_model_parallel()
+    parallel.destroy_model_parallel()
+    assert not parallel.model_parallel_is_initialized()
+    with pytest.raises(RuntimeError):
+        parallel.get_mesh()
+
+
+def test_mesh_axis_order_tp_innermost():
+    """tp must be the innermost (fastest-varying) axis for ICI locality."""
+    parallel.initialize_model_parallel(tensor_model_parallel_size=2)
+    m = parallel.get_mesh()
+    assert m.axis_names == ("dp", "pp", "cp", "tp")
+    devs = m.devices
+    # Along tp, device ids should be adjacent.
+    flat = devs.reshape(-1, devs.shape[-1])
+    for row in flat:
+        ids = [d.id for d in row]
+        assert ids == sorted(ids)
