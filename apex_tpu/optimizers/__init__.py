@@ -1,0 +1,72 @@
+"""apex_tpu.optimizers — the fused optimizer family.
+
+TPU-native replacement for ``apex/optimizers`` (exports
+``apex/optimizers/__init__.py:1-7``) plus ``apex/parallel/LARC.py`` and
+``apex/contrib/clip_grad``.  Each optimizer is a pure ``init``/``step`` pair
+whose whole update compiles to one XLA executable — the fusion that
+``multi_tensor_apply`` (``apex/multi_tensor_apply/multi_tensor_apply.py:3``)
+achieves with chunked CUDA launches comes from jit + buffer donation here
+(:func:`fused_step`).
+
+Common ``step`` extras (all traced, none incur host syncs):
+``lr=`` per-step override (schedule), ``grad_scale=`` folds loss-scale
+division into the update, ``skip_update=`` predicates the whole step on an
+overflow flag.
+"""
+
+import functools
+
+import jax
+
+from apex_tpu.optimizers.fused_adam import FusedAdam  # noqa: F401
+from apex_tpu.optimizers.fused_sgd import FusedSGD  # noqa: F401
+from apex_tpu.optimizers.fused_lamb import (  # noqa: F401
+    FusedLAMB,
+    FusedMixedPrecisionLamb,
+)
+from apex_tpu.optimizers.fused_lion import FusedLion  # noqa: F401
+from apex_tpu.optimizers.fused_adagrad import FusedAdagrad  # noqa: F401
+from apex_tpu.optimizers.fused_novograd import FusedNovoGrad  # noqa: F401
+from apex_tpu.optimizers.larc import LARC  # noqa: F401
+from apex_tpu.optimizers.clip_grad import (  # noqa: F401
+    clip_grad_norm,
+    global_grad_norm,
+)
+
+__all__ = [
+    "FusedAdam",
+    "FusedSGD",
+    "FusedLAMB",
+    "FusedMixedPrecisionLamb",
+    "FusedLion",
+    "FusedAdagrad",
+    "FusedNovoGrad",
+    "LARC",
+    "clip_grad_norm",
+    "global_grad_norm",
+    "fused_step",
+]
+
+
+def fused_step(optimizer):
+    """Jit an optimizer's ``step`` with state+params donation.
+
+    Donation lets XLA update parameters and optimizer slots in place — the
+    memory behavior of the reference's in-place multi-tensor kernels::
+
+        step = fused_step(opt)
+        params, state = step(grads, state, params)
+    """
+
+    @functools.partial(jax.jit, donate_argnums=(1, 2), static_argnames=())
+    def _step(grads, state, params, lr=None, grad_scale=None, skip_update=None):
+        return optimizer.step(
+            grads,
+            state,
+            params,
+            lr=lr,
+            grad_scale=grad_scale,
+            skip_update=skip_update,
+        )
+
+    return _step

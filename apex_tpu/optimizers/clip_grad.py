@@ -1,0 +1,54 @@
+"""Fused gradient clipping — the ``apex.contrib.clip_grad`` analog.
+
+Behavioral spec: ``apex/contrib/clip_grad/clip_grad.py:16-50``
+(``clip_grad_norm_`` drop-in): total norm via ``multi_tensor_l2norm`` (or
+inf-norm reduction), then ``multi_tensor_scale`` by ``max_norm/(total+1e-6)``
+only when the coefficient < 1.  Here both phases are one fused jit program.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.utils.tree import tree_l2_norm
+
+__all__ = ["clip_grad_norm", "global_grad_norm"]
+
+
+def global_grad_norm(grads, norm_type: float = 2.0) -> jnp.ndarray:
+    """Global norm over a grad pytree (fp32)."""
+    leaves = [
+        jnp.asarray(x, jnp.float32) for x in jax.tree_util.tree_leaves(grads)
+    ]
+    if not leaves:
+        return jnp.float32(0.0)
+    if norm_type == float("inf"):
+        return jnp.max(jnp.stack([jnp.max(jnp.abs(x)) for x in leaves]))
+    if norm_type == 2.0:
+        return tree_l2_norm(grads)
+    acc = jnp.sum(
+        jnp.stack([jnp.sum(jnp.abs(x) ** norm_type) for x in leaves])
+    )
+    return acc ** (1.0 / norm_type)
+
+
+def clip_grad_norm(
+    grads, max_norm: float, norm_type: float = 2.0
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Clip grads to ``max_norm`` globally; returns ``(clipped, total_norm)``.
+
+    Matches ``clip_grad.py:40-49``: coefficient ``max_norm/(total+1e-6)``,
+    applied only when < 1 (expressed branchlessly for jit).
+    """
+    total = global_grad_norm(grads, norm_type)
+    coef = jnp.minimum(jnp.float32(max_norm) / (total + 1e-6), 1.0)
+    clipped = jax.tree_util.tree_map(
+        lambda g: (jnp.asarray(g, jnp.float32) * coef).astype(
+            jnp.asarray(g).dtype
+        ),
+        grads,
+    )
+    return clipped, total

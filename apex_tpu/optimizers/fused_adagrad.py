@@ -1,0 +1,87 @@
+"""FusedAdagrad — the ``multi_tensor_adagrad`` analog.
+
+Behavioral spec: ``apex/optimizers/fused_adagrad.py:44`` over
+``csrc/multi_tensor_adagrad.cu:64-72``:
+
+- ``ADAGRAD_MODE_0`` (L2, default): ``g += wd*p; h += g²;
+  p -= lr * g/(√h + eps)``.
+- ``adagrad_w_mode=True``: ``h += g²; p -= lr*(g/(√h+eps) + wd*p)``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.optimizers._common import (
+    OptState,
+    advance_step,
+    apply_skip,
+    f32,
+    finalize_params,
+    resolve_master,
+    scale_grads,
+    tree_f32,
+    tree_map_multi,
+    tree_zeros_f32,
+)
+
+__all__ = ["FusedAdagrad"]
+
+
+class FusedAdagrad:
+    def __init__(
+        self,
+        lr: float = 1e-2,
+        eps: float = 1e-10,
+        weight_decay: float = 0.0,
+        adagrad_w_mode: bool = False,
+        master_weights: bool = False,
+    ):
+        self.lr = lr
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.adagrad_w_mode = adagrad_w_mode
+        self.master_weights = master_weights
+
+    def init(self, params) -> OptState:
+        return OptState(
+            step=jnp.int32(0),
+            slots={"sum": tree_zeros_f32(params)},
+            master=tree_f32(params) if self.master_weights else None,
+        )
+
+    def step(
+        self,
+        grads,
+        state: OptState,
+        params,
+        *,
+        lr=None,
+        grad_scale=None,
+        skip_update=None,
+    ):
+        lr = f32(self.lr if lr is None else lr)
+        wd, eps = self.weight_decay, self.eps
+        g = scale_grads(grads, grad_scale)
+        p32 = resolve_master(params, state.master, self.master_weights)
+
+        def leaf(p, g, h):
+            if not self.adagrad_w_mode and wd != 0.0:
+                g = g + wd * p
+            h = h + g * g
+            update = g / (jnp.sqrt(h) + eps)
+            if self.adagrad_w_mode and wd != 0.0:
+                update = update + wd * p
+            return p - lr * update, h
+
+        new_p32, new_h = tree_map_multi(leaf, 2, p32, g, state.slots["sum"])
+        new_p32 = apply_skip(skip_update, new_p32, p32)
+        new_h = apply_skip(skip_update, new_h, state.slots["sum"])
+
+        new_params = finalize_params(new_p32, params, self.master_weights)
+        return new_params, OptState(
+            step=advance_step(state.step, skip_update),
+            slots={"sum": new_h},
+            master=new_p32 if self.master_weights else None,
+        )

@@ -1,0 +1,164 @@
+"""FusedLAMB / FusedMixedPrecisionLamb — the ``multi_tensor_lamb`` analog.
+
+Behavioral spec: ``apex/optimizers/fused_lamb.py`` (``step`` ``:116-207``)
+over ``csrc/multi_tensor_lamb.cu`` (``LAMBStage1Functor:41``,
+``LAMBStage2Functor:234``).  Parity points:
+
+- global grad-norm clipping: ``step`` computes the global L2 norm over *all*
+  grads with ``multi_tensor_l2norm`` (``fused_lamb.py:151-164``) and passes
+  ``global_grad_norm / max_grad_norm`` (when > 1) as ``clipped_ratio`` into
+  stage 1, which divides every grad by it (``multi_tensor_lamb.cu:65-80``).
+- stage 1: Adam-style moments on the clipped grad; ``adam_w_mode=True``
+  (``MODE_1``) decouples weight decay into the update
+  (``update = m̂/(√v̂+eps) + wd*p``), ``adam_w_mode=False`` (``MODE_0``) folds
+  ``wd*p`` into the clipped grad before the moments with no decay term in
+  the update (``multi_tensor_lamb.cu:110-140``).
+- stage 2: per-tensor trust ratio ``||p|| / ||update||`` (both fp32,
+  ``multi_tensor_lamb.cu:245-270``), applied only when both norms are
+  nonzero; with ``use_nvlamb=True`` the trust ratio is applied even for
+  zero-weight-decay tensors (``fused_lamb.py:109-114`` NVLAMB note).
+- ``grad_averaging``: ``(1-beta1)`` factor on the grad term
+  (``fused_lamb.py:86``).
+
+``FusedMixedPrecisionLamb`` (``apex/optimizers/fused_mixed_precision_lamb.py:8``)
+keeps all state fp32 while model params are half — here that is just
+``master_weights=True``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.optimizers._common import (
+    OptState,
+    advance_step,
+    apply_skip,
+    f32,
+    finalize_params,
+    resolve_master,
+    scale_grads,
+    tree_f32,
+    tree_map_multi,
+    tree_zeros_f32,
+)
+from apex_tpu.utils.tree import tree_l2_norm
+
+__all__ = ["FusedLAMB", "FusedMixedPrecisionLamb"]
+
+
+class FusedLAMB:
+    def __init__(
+        self,
+        lr: float = 1e-3,
+        bias_correction: bool = True,
+        betas=(0.9, 0.999),
+        eps: float = 1e-6,
+        weight_decay: float = 0.01,
+        amsgrad: bool = False,
+        adam_w_mode: bool = True,
+        grad_averaging: bool = True,
+        max_grad_norm: float = 1.0,
+        use_nvlamb: bool = False,
+        master_weights: bool = False,
+    ):
+        if amsgrad:
+            raise RuntimeError(
+                "FusedLAMB does not support the AMSGrad variant "
+                "(parity with apex/optimizers/fused_lamb.py:75)"
+            )
+        self.lr = lr
+        self.bias_correction = bias_correction
+        self.beta1, self.beta2 = betas
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.adam_w_mode = adam_w_mode
+        self.grad_averaging = grad_averaging
+        self.max_grad_norm = max_grad_norm
+        self.use_nvlamb = use_nvlamb
+        self.master_weights = master_weights
+
+    def init(self, params) -> OptState:
+        return OptState(
+            step=jnp.int32(0),
+            slots={
+                "exp_avg": tree_zeros_f32(params),
+                "exp_avg_sq": tree_zeros_f32(params),
+            },
+            master=tree_f32(params) if self.master_weights else None,
+        )
+
+    def step(
+        self,
+        grads,
+        state: OptState,
+        params,
+        *,
+        lr=None,
+        grad_scale=None,
+        skip_update=None,
+    ):
+        lr = f32(self.lr if lr is None else lr)
+        b1, b2, eps, wd = self.beta1, self.beta2, self.eps, self.weight_decay
+        t = state.step + 1
+        g = scale_grads(grads, grad_scale)
+        p32 = resolve_master(params, state.master, self.master_weights)
+
+        # --- global grad norm + clip ratio (fused_lamb.py:151-170) --------
+        global_norm = tree_l2_norm(g)
+        if self.max_grad_norm and self.max_grad_norm > 0:
+            clip = jnp.maximum(global_norm / self.max_grad_norm, 1.0)
+        else:
+            clip = jnp.float32(1.0)
+
+        beta3 = 1.0 - b1 if self.grad_averaging else 1.0
+        if self.bias_correction:
+            bc1 = 1.0 - b1 ** f32(t)
+            bc2 = 1.0 - b2 ** f32(t)
+        else:
+            bc1 = bc2 = jnp.float32(1.0)
+
+        def leaf(p, g, m, v):
+            g = g / clip
+            if wd != 0.0 and not self.adam_w_mode:
+                g = g + wd * p  # MODE_0: L2 into the clipped grad
+            m = b1 * m + beta3 * g
+            v = b2 * v + (1.0 - b2) * g * g
+            update = (m / bc1) / (jnp.sqrt(v / bc2) + eps)
+            if wd != 0.0 and self.adam_w_mode:
+                update = update + wd * p  # MODE_1: decoupled decay
+            # stage 2: per-tensor trust ratio (multi_tensor_lamb.cu:245-270)
+            w_norm = jnp.sqrt(jnp.sum(jnp.square(p)))
+            u_norm = jnp.sqrt(jnp.sum(jnp.square(update)))
+            if wd != 0.0 or self.use_nvlamb:
+                ratio = jnp.where(
+                    (w_norm > 0) & (u_norm > 0), w_norm / u_norm, 1.0
+                )
+            else:
+                ratio = jnp.float32(1.0)
+            return p - lr * ratio * update, m, v
+
+        new_p32, new_m, new_v = tree_map_multi(
+            leaf, 3, p32, g, state.slots["exp_avg"], state.slots["exp_avg_sq"]
+        )
+        new_p32 = apply_skip(skip_update, new_p32, p32)
+        new_m = apply_skip(skip_update, new_m, state.slots["exp_avg"])
+        new_v = apply_skip(skip_update, new_v, state.slots["exp_avg_sq"])
+
+        new_params = finalize_params(new_p32, params, self.master_weights)
+        return new_params, OptState(
+            step=advance_step(state.step, skip_update),
+            slots={"exp_avg": new_m, "exp_avg_sq": new_v},
+            master=new_p32 if self.master_weights else None,
+        )
+
+
+class FusedMixedPrecisionLamb(FusedLAMB):
+    """LAMB with fp32 state for half-precision models
+    (``apex/optimizers/fused_mixed_precision_lamb.py:8``): exactly
+    ``FusedLAMB(master_weights=True)``; ``lr`` may be a traced array
+    (the reference keeps lr as a GPU tensor, ``:43-48``) — pass it per step."""
+
+    def __init__(self, *args, **kwargs):
+        kwargs["master_weights"] = True
+        super().__init__(*args, **kwargs)

@@ -1,0 +1,94 @@
+"""FusedLion — the ``multi_tensor_lion`` analog.
+
+Behavioral spec: ``apex/optimizers/fused_lion.py`` (ctor ``:9``,
+``lion_w_mode`` default True ``:22``) over ``csrc/multi_tensor_lion.cu``:
+
+- ``LION_MODE_0`` (L2): ``g += wd*p``; ``u = sign(beta1*m + (1-beta1)*g)``;
+  ``p -= lr*u``; ``m = beta2*m + (1-beta2)*g`` (``multi_tensor_lion.cu:87-99``).
+- ``LION_MODE_1`` (decoupled, default): same but
+  ``u = sign(...) + wd*p`` (``:101-110``).
+- the kernel's sign maps 0 → -1 (``if(update<=0) update=-1``) — reproduced
+  exactly for bitwise parity of the zero-gradient edge.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.optimizers._common import (
+    OptState,
+    advance_step,
+    apply_skip,
+    f32,
+    finalize_params,
+    resolve_master,
+    scale_grads,
+    tree_f32,
+    tree_map_multi,
+    tree_zeros_f32,
+)
+
+__all__ = ["FusedLion"]
+
+
+def _apex_sign(u):
+    # csrc/multi_tensor_lion.cu:91-92 — u<=0 → -1, else +1 (not jnp.sign)
+    return jnp.where(u <= 0, -1.0, 1.0)
+
+
+class FusedLion:
+    def __init__(
+        self,
+        lr: float = 1e-4,
+        betas=(0.9, 0.999),
+        lion_w_mode: bool = True,
+        weight_decay: float = 0.0,
+        master_weights: bool = False,
+    ):
+        self.lr = lr
+        self.beta1, self.beta2 = betas
+        self.lion_w_mode = lion_w_mode
+        self.weight_decay = weight_decay
+        self.master_weights = master_weights
+
+    def init(self, params) -> OptState:
+        return OptState(
+            step=jnp.int32(0),
+            slots={"exp_avg": tree_zeros_f32(params)},
+            master=tree_f32(params) if self.master_weights else None,
+        )
+
+    def step(
+        self,
+        grads,
+        state: OptState,
+        params,
+        *,
+        lr=None,
+        grad_scale=None,
+        skip_update=None,
+    ):
+        lr = f32(self.lr if lr is None else lr)
+        b1, b2, wd = self.beta1, self.beta2, self.weight_decay
+        g = scale_grads(grads, grad_scale)
+        p32 = resolve_master(params, state.master, self.master_weights)
+
+        def leaf(p, g, m):
+            if wd != 0.0 and not self.lion_w_mode:
+                g = g + wd * p
+            u = _apex_sign(b1 * m + (1.0 - b1) * g)
+            if wd != 0.0 and self.lion_w_mode:
+                u = u + wd * p
+            return p - lr * u, b2 * m + (1.0 - b2) * g
+
+        new_p32, new_m = tree_map_multi(leaf, 2, p32, g, state.slots["exp_avg"])
+        new_p32 = apply_skip(skip_update, new_p32, p32)
+        new_m = apply_skip(skip_update, new_m, state.slots["exp_avg"])
+
+        new_params = finalize_params(new_p32, params, self.master_weights)
+        return new_params, OptState(
+            step=advance_step(state.step, skip_update),
+            slots={"exp_avg": new_m},
+            master=new_p32 if self.master_weights else None,
+        )

@@ -1,0 +1,158 @@
+"""FusedNovoGrad — the ``multi_tensor_novograd`` analog.
+
+Behavioral spec: ``apex/optimizers/fused_novograd.py`` (ctor ``:69-77``,
+``step`` ``:108-214``) over ``csrc/multi_tensor_novograd.cu``:
+
+- per-tensor gradient-norm second moment, blended each step
+  (``multi_tensor_norm_out_cuda`` call ``:164``):
+  L2:  ``gn = sqrt(beta2*gn² + (1-beta2)*n²)``;
+  Linf: ``gn = beta2*gn + (1-beta2)*n``.
+- norm state init: first-step norm (blend is then a no-op) unless
+  ``init_zero`` (``fused_novograd.py:160-180``).
+- bias corrections ``bc1 = 1-beta1^t``, ``bc2 = sqrt(1-beta2^t)``
+  (``multi_tensor_novograd.cu:147-151``).
+- ``MOMENT_MODE_0`` (``reg_inside_moment=True``): regularize inside momentum:
+  ``g' = g/(gn/bc2+eps) + wd*p; m = beta1*m + beta3*g'; p -= lr*(m/bc1)``
+  (``:99-104``).
+- ``MOMENT_MODE_1`` (default): decoupled:
+  ``m = beta1*m + beta3*g; p -= lr*((m/bc1)/(gn/bc2+eps) + wd*p)``
+  (``:107-112``).
+- ``grad_averaging`` → ``beta3 = 1-beta1`` (``:156-158``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.optimizers._common import (
+    OptState,
+    advance_step,
+    apply_skip,
+    f32,
+    finalize_params,
+    resolve_master,
+    scale_grads,
+    tree_f32,
+    tree_map_multi,
+    tree_zeros_f32,
+)
+
+__all__ = ["FusedNovoGrad"]
+
+
+class FusedNovoGrad:
+    def __init__(
+        self,
+        lr: float = 1e-3,
+        bias_correction: bool = True,
+        betas=(0.95, 0.98),
+        eps: float = 1e-8,
+        weight_decay: float = 0.0,
+        amsgrad: bool = False,
+        reg_inside_moment: bool = False,
+        grad_averaging: bool = True,
+        norm_type: int = 2,
+        init_zero: bool = False,
+        master_weights: bool = False,
+    ):
+        if amsgrad:
+            raise RuntimeError(
+                "FusedNovoGrad does not support the AMSGrad variant "
+                "(parity with apex/optimizers/fused_novograd.py:83)"
+            )
+        if norm_type not in (0, 2):
+            raise RuntimeError(
+                "FusedNovoGrad only supports l2 (2) / inf (0) norms "
+                "(parity with fused_novograd.py:174)"
+            )
+        self.lr = lr
+        self.bias_correction = bias_correction
+        self.beta1, self.beta2 = betas
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.moment_mode = 0 if reg_inside_moment else 1
+        self.grad_averaging = grad_averaging
+        self.norm_type = norm_type
+        self.init_zero = init_zero
+        self.master_weights = master_weights
+
+    def _leaf_norm(self, g):
+        if self.norm_type == 0:
+            return jnp.max(jnp.abs(g))
+        return jnp.sqrt(jnp.sum(jnp.square(g)))
+
+    def init(self, params) -> OptState:
+        # exp_avg_sq (per-tensor norm) lazily initialized on first step when
+        # init_zero=False; represented as -1 sentinel so the first step can
+        # substitute the first-step norm (fused_novograd.py:166-180).
+        norms = jax.tree_util.tree_map(
+            lambda x: (
+                jnp.float32(0.0) if self.init_zero else jnp.float32(-1.0)
+            ),
+            params,
+        )
+        return OptState(
+            step=jnp.int32(0),
+            slots={"exp_avg": tree_zeros_f32(params), "exp_avg_sq": norms},
+            master=tree_f32(params) if self.master_weights else None,
+        )
+
+    def step(
+        self,
+        grads,
+        state: OptState,
+        params,
+        *,
+        lr=None,
+        grad_scale=None,
+        skip_update=None,
+    ):
+        lr = f32(self.lr if lr is None else lr)
+        b1, b2, eps, wd = self.beta1, self.beta2, self.eps, self.weight_decay
+        t = state.step + 1
+        g = scale_grads(grads, grad_scale)
+        p32 = resolve_master(params, state.master, self.master_weights)
+
+        beta3 = 1.0 - b1 if self.grad_averaging else 1.0
+        if self.bias_correction:
+            bc1 = 1.0 - b1 ** f32(t)
+            bc2 = jnp.sqrt(1.0 - b2 ** f32(t))
+        else:
+            bc1 = bc2 = jnp.float32(1.0)
+
+        def leaf(p, g, m, gn):
+            n = self._leaf_norm(g)
+            # lazy init: sentinel -1 → adopt first-step norm (blend no-op)
+            gn = jnp.where(gn < 0, n, gn)
+            if self.norm_type == 0:
+                gn = b2 * gn + (1.0 - b2) * n
+            else:
+                gn = jnp.sqrt(b2 * gn * gn + (1.0 - b2) * n * n)
+            denom = gn / bc2 + eps
+            if self.moment_mode == 0:
+                g2 = g / denom
+                if wd != 0.0:
+                    g2 = g2 + wd * p
+                m = b1 * m + beta3 * g2
+                update = m / bc1
+            else:
+                m = b1 * m + beta3 * g
+                update = (m / bc1) / denom
+                if wd != 0.0:
+                    update = update + wd * p
+            return p - lr * update, m, gn
+
+        new_p32, new_m, new_gn = tree_map_multi(
+            leaf, 3, p32, g, state.slots["exp_avg"], state.slots["exp_avg_sq"]
+        )
+        new_p32 = apply_skip(skip_update, new_p32, p32)
+        new_m = apply_skip(skip_update, new_m, state.slots["exp_avg"])
+        new_gn = apply_skip(skip_update, new_gn, state.slots["exp_avg_sq"])
+
+        new_params = finalize_params(new_p32, params, self.master_weights)
+        return new_params, OptState(
+            step=advance_step(state.step, skip_update),
+            slots={"exp_avg": new_m, "exp_avg_sq": new_gn},
+            master=new_p32 if self.master_weights else None,
+        )

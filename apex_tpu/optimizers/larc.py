@@ -1,0 +1,94 @@
+"""LARC — layerwise adaptive rate control as a gradient transformation.
+
+Behavioral spec: ``apex/parallel/LARC.py:5-107``.  The reference wraps an
+optimizer and, in ``step``, mutates every grad:
+
+    adaptive_lr = trust_coefficient * ||p|| / (||g|| + wd*||p|| + eps)
+    if clip: adaptive_lr = min(adaptive_lr / lr, 1)
+    g = (g + wd*p) * adaptive_lr            # (LARC.py:92-102)
+
+absorbing the wrapped optimizer's weight decay (zeroing it for the inner
+step, ``LARC.py:81-85``).  Functionally that is a grad transform applied
+before any optimizer's ``step`` — which is how it is expressed here::
+
+    larc = LARC(trust_coefficient=0.02, clip=True, weight_decay=wd)
+    grads = larc.transform_grads(grads, params, lr=lr)
+    params, opt_state = opt.step(grads, opt_state, params, lr=lr)
+    # construct the inner optimizer with weight_decay=0
+
+There is also a :class:`LARC`-as-wrapper convenience matching the reference
+constructor shape for drop-in migration.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.optimizers._common import f32, tree_map_multi
+
+__all__ = ["LARC"]
+
+
+class LARC:
+    def __init__(
+        self,
+        optimizer=None,
+        trust_coefficient: float = 0.02,
+        clip: bool = True,
+        eps: float = 1e-8,
+        weight_decay: float = 0.0,
+    ):
+        self.optim = optimizer
+        self.trust_coefficient = trust_coefficient
+        self.clip = clip
+        self.eps = eps
+        # reference absorbs wd from the wrapped optimizer (LARC.py:81-85);
+        # here the inner optimizer must be built with weight_decay=0 and the
+        # decay given to LARC directly.
+        self.weight_decay = weight_decay
+        if optimizer is not None and getattr(optimizer, "weight_decay", 0.0):
+            self.weight_decay = optimizer.weight_decay
+            optimizer.weight_decay = 0.0
+
+    def transform_grads(self, grads, params, *, lr):
+        """Scale each grad leaf by its LARC adaptive rate (LARC.py:92-102)."""
+        lr = f32(lr)
+        wd, eps, tc = self.weight_decay, self.eps, self.trust_coefficient
+
+        def leaf(g, p):
+            g0 = jnp.asarray(g, jnp.float32)
+            p = jnp.asarray(p, jnp.float32)
+            p_norm = jnp.sqrt(jnp.sum(jnp.square(p)))
+            g_norm = jnp.sqrt(jnp.sum(jnp.square(g0)))
+            adaptive = tc * p_norm / (g_norm + p_norm * wd + eps)
+            if self.clip:
+                adaptive = jnp.minimum(adaptive / lr, 1.0)
+            # when either norm is zero the reference leaves the grad
+            # untouched (no wd either), LARC.py:92
+            g_out = jnp.where(
+                (p_norm != 0) & (g_norm != 0), (g0 + wd * p) * adaptive, g0
+            )
+            return (g_out,)
+
+        (out,) = tree_map_multi(leaf, 1, grads, params)
+        return out
+
+    # -- wrapper-style API (reference constructor shape) -------------------
+    def init(self, params):
+        assert self.optim is not None, "LARC used as wrapper needs an optimizer"
+        return self.optim.init(params)
+
+    def step(self, grads, state, params, *, lr=None, grad_scale=None, **kw):
+        assert self.optim is not None, "LARC used as wrapper needs an optimizer"
+        eff_lr = self.optim.lr if lr is None else lr
+        if grad_scale is not None:
+            # unscale BEFORE computing LARC norms — adaptive rates on
+            # loss-scaled grads would collapse toward zero and wd would be
+            # divided by the scale; the inner step gets already-unscaled grads
+            inv = 1.0 / jnp.asarray(grad_scale, jnp.float32)
+            grads = jax.tree_util.tree_map(
+                lambda g: jnp.asarray(g, jnp.float32) * inv, grads
+            )
+        grads = self.transform_grads(grads, params, lr=eff_lr)
+        return self.optim.step(grads, state, params, lr=lr, **kw)

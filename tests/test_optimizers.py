@@ -1,0 +1,413 @@
+"""Fused-optimizer numerics vs torch references.
+
+Mirrors ``tests/L0/run_optimizers/test_fused_optimizer.py`` (FusedAdam/SGD vs
+``torch.optim`` within tolerance) and ``test_lamb.py`` (reference LAMB
+reimplemented in-test).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+
+from apex_tpu import optimizers as opt_mod
+from apex_tpu.optimizers import (
+    LARC,
+    FusedAdagrad,
+    FusedAdam,
+    FusedLAMB,
+    FusedLion,
+    FusedNovoGrad,
+    FusedSGD,
+    clip_grad_norm,
+    fused_step,
+)
+
+
+def _make_problem(seed=0, shapes=((7, 3), (11,), (2, 5, 3))):
+    rng = np.random.RandomState(seed)
+    params = {f"p{i}": rng.randn(*s).astype(np.float32) for i, s in enumerate(shapes)}
+    grads_seq = [
+        {k: rng.randn(*v.shape).astype(np.float32) for k, v in params.items()}
+        for _ in range(5)
+    ]
+    return params, grads_seq
+
+
+def _run_ours(opt, params_np, grads_seq, **step_kw):
+    params = {k: jnp.asarray(v) for k, v in params_np.items()}
+    state = opt.init(params)
+    step = fused_step(opt)
+    for g in grads_seq:
+        params, state = step({k: jnp.asarray(v) for k, v in g.items()}, state, params, **step_kw)
+    return {k: np.asarray(v) for k, v in params.items()}
+
+
+def _run_torch(torch_opt_ctor, params_np, grads_seq):
+    tparams = {
+        k: torch.nn.Parameter(torch.tensor(v)) for k, v in params_np.items()
+    }
+    topt = torch_opt_ctor(list(tparams.values()))
+    for g in grads_seq:
+        for k, p in tparams.items():
+            p.grad = torch.tensor(g[k])
+        topt.step()
+    return {k: p.detach().numpy() for k, p in tparams.items()}
+
+
+class TestFusedAdam:
+    @pytest.mark.parametrize("wd", [0.0, 0.1])
+    def test_adamw_vs_torch(self, wd):
+        params, grads = _make_problem()
+        ours = _run_ours(
+            FusedAdam(lr=1e-2, weight_decay=wd, adam_w_mode=True), params, grads
+        )
+        ref = _run_torch(
+            lambda ps: torch.optim.AdamW(ps, lr=1e-2, weight_decay=wd, eps=1e-8),
+            params,
+            grads,
+        )
+        for k in params:
+            np.testing.assert_allclose(ours[k], ref[k], rtol=1e-5, atol=1e-6)
+
+    @pytest.mark.parametrize("wd", [0.0, 0.1])
+    def test_adam_l2_vs_torch(self, wd):
+        params, grads = _make_problem(1)
+        ours = _run_ours(
+            FusedAdam(lr=1e-2, weight_decay=wd, adam_w_mode=False), params, grads
+        )
+        ref = _run_torch(
+            lambda ps: torch.optim.Adam(ps, lr=1e-2, weight_decay=wd, eps=1e-8),
+            params,
+            grads,
+        )
+        for k in params:
+            np.testing.assert_allclose(ours[k], ref[k], rtol=1e-5, atol=1e-6)
+
+    def test_no_bias_correction(self):
+        params, grads = _make_problem(2)
+        ours = _run_ours(FusedAdam(lr=1e-2, bias_correction=False), params, grads)
+        # hand reference
+        m = {k: np.zeros_like(v) for k, v in params.items()}
+        v_ = {k: np.zeros_like(v) for k, v in params.items()}
+        p = {k: v.copy() for k, v in params.items()}
+        for g in grads:
+            for k in p:
+                m[k] = 0.9 * m[k] + 0.1 * g[k]
+                v_[k] = 0.999 * v_[k] + 0.001 * g[k] ** 2
+                p[k] -= 1e-2 * m[k] / (np.sqrt(v_[k]) + 1e-8)
+        for k in p:
+            np.testing.assert_allclose(ours[k], p[k], rtol=1e-5, atol=1e-6)
+
+    def test_amsgrad_rejected(self):
+        with pytest.raises(RuntimeError):
+            FusedAdam(amsgrad=True)
+
+    def test_grad_scale_folding(self):
+        """grad_scale=S with grads*S must equal the unscaled run."""
+        params, grads = _make_problem(3)
+        scaled = [{k: v * 128.0 for k, v in g.items()} for g in grads]
+        a = _run_ours(FusedAdam(lr=1e-2), params, grads)
+        b = _run_ours(FusedAdam(lr=1e-2), params, scaled, grad_scale=jnp.float32(128.0))
+        for k in params:
+            np.testing.assert_allclose(a[k], b[k], rtol=1e-5, atol=1e-6)
+
+    def test_skip_update(self):
+        params, grads = _make_problem(4)
+        out = _run_ours(FusedAdam(lr=1e-2), params, grads, skip_update=jnp.asarray(True))
+        for k in params:
+            np.testing.assert_allclose(out[k], params[k])
+
+    def test_skipped_steps_dont_advance_counter(self):
+        """Reference predicates the step counter on the overflow flag
+        (fused_adam.py:152): a skipped first step must not change the bias
+        correction of the first applied step."""
+        params, grads = _make_problem(4)
+        opt = FusedAdam(lr=1e-2)
+        jp = {k: jnp.asarray(v) for k, v in params.items()}
+        state = opt.init(jp)
+        # two skipped steps, then one real one
+        for _ in range(2):
+            jp, state = opt.step(
+                {k: jnp.asarray(v) for k, v in grads[0].items()}, state, jp,
+                skip_update=jnp.asarray(True),
+            )
+        assert int(state.step) == 0
+        jp, state = opt.step(
+            {k: jnp.asarray(v) for k, v in grads[0].items()}, state, jp
+        )
+        assert int(state.step) == 1
+        ref = _run_ours(FusedAdam(lr=1e-2), params, grads[:1])
+        for k in params:
+            np.testing.assert_allclose(np.asarray(jp[k]), ref[k], rtol=1e-6)
+
+    def test_master_weights_bf16(self):
+        """bf16 params with masters must track the fp32 run closely."""
+        params, grads = _make_problem(5)
+        ref = _run_ours(FusedAdam(lr=1e-2), params, grads)
+
+        opt = FusedAdam(lr=1e-2, master_weights=True)
+        bf = {k: jnp.asarray(v, jnp.bfloat16) for k, v in params.items()}
+        state = opt.init({k: jnp.asarray(v) for k, v in params.items()})
+        step = fused_step(opt)
+        for g in grads:
+            bf, state = step({k: jnp.asarray(v, jnp.bfloat16) for k, v in g.items()}, state, bf)
+        for k in params:
+            assert bf[k].dtype == jnp.bfloat16
+            # master (fp32) should match the fp32 run to fp32-accumulation
+            # accuracy; grads were quantized to bf16 so allow that noise
+            np.testing.assert_allclose(
+                np.asarray(state.master[k]), ref[k], rtol=3e-2, atol=3e-2
+            )
+
+    def test_lr_override(self):
+        params, grads = _make_problem(6)
+        a = _run_ours(FusedAdam(lr=999.0), params, grads, lr=jnp.float32(1e-2))
+        b = _run_ours(FusedAdam(lr=1e-2), params, grads)
+        for k in params:
+            np.testing.assert_allclose(a[k], b[k], rtol=1e-6)
+
+
+class TestFusedSGD:
+    @pytest.mark.parametrize(
+        "momentum,wd,nesterov",
+        [(0.0, 0.0, False), (0.9, 0.0, False), (0.9, 0.01, False), (0.9, 0.0, True)],
+    )
+    def test_vs_torch(self, momentum, wd, nesterov):
+        params, grads = _make_problem(7)
+        ours = _run_ours(
+            FusedSGD(lr=0.05, momentum=momentum, weight_decay=wd, nesterov=nesterov),
+            params,
+            grads,
+        )
+        ref = _run_torch(
+            lambda ps: torch.optim.SGD(
+                ps, lr=0.05, momentum=momentum, weight_decay=wd, nesterov=nesterov
+            ),
+            params,
+            grads,
+        )
+        for k in params:
+            np.testing.assert_allclose(ours[k], ref[k], rtol=1e-5, atol=1e-6)
+
+    def test_dampening(self):
+        params, grads = _make_problem(8)
+        ours = _run_ours(FusedSGD(lr=0.05, momentum=0.9, dampening=0.5), params, grads)
+        ref = _run_torch(
+            lambda ps: torch.optim.SGD(ps, lr=0.05, momentum=0.9, dampening=0.5),
+            params,
+            grads,
+        )
+        for k in params:
+            np.testing.assert_allclose(ours[k], ref[k], rtol=1e-5, atol=1e-6)
+
+
+class TestFusedAdagrad:
+    @pytest.mark.parametrize("wd", [0.0, 0.1])
+    def test_vs_torch(self, wd):
+        params, grads = _make_problem(9)
+        ours = _run_ours(FusedAdagrad(lr=0.05, weight_decay=wd, eps=1e-10), params, grads)
+        ref = _run_torch(
+            lambda ps: torch.optim.Adagrad(ps, lr=0.05, weight_decay=wd, eps=1e-10),
+            params,
+            grads,
+        )
+        for k in params:
+            np.testing.assert_allclose(ours[k], ref[k], rtol=1e-5, atol=1e-6)
+
+
+class TestFusedLamb:
+    def test_vs_reference_impl(self):
+        """Hand-rolled LAMB reference (mirrors tests/L0/run_optimizers/test_lamb.py)."""
+        params, grads = _make_problem(10)
+        lr, b1, b2, eps, wd = 1e-2, 0.9, 0.999, 1e-6, 0.01
+        max_gn = 1.0
+        ours = _run_ours(
+            FusedLAMB(lr=lr, betas=(b1, b2), eps=eps, weight_decay=wd,
+                      max_grad_norm=max_gn),
+            params, grads,
+        )
+        p = {k: v.copy() for k, v in params.items()}
+        m = {k: np.zeros_like(v) for k, v in params.items()}
+        v_ = {k: np.zeros_like(v) for k, v in params.items()}
+        t = 0
+        for g in grads:
+            t += 1
+            gn = np.sqrt(sum((g[k] ** 2).sum() for k in g))
+            clip = max(gn / max_gn, 1.0)
+            for k in p:
+                gg = g[k] / clip
+                m[k] = b1 * m[k] + (1 - b1) * gg
+                v_[k] = b2 * v_[k] + (1 - b2) * gg * gg
+                mhat = m[k] / (1 - b1**t)
+                vhat = v_[k] / (1 - b2**t)
+                u = mhat / (np.sqrt(vhat) + eps) + wd * p[k]
+                wn = np.sqrt((p[k] ** 2).sum())
+                un = np.sqrt((u**2).sum())
+                ratio = wn / un if (wn > 0 and un > 0) else 1.0
+                p[k] -= lr * ratio * u
+        for k in p:
+            np.testing.assert_allclose(ours[k], p[k], rtol=1e-4, atol=1e-5)
+
+    def test_adam_w_mode_false_l2(self):
+        """MODE_0: wd folded into the clipped grad, no decay in update
+        (multi_tensor_lamb.cu:110-132)."""
+        params, grads = _make_problem(17)
+        lr, b1, b2, eps, wd = 1e-2, 0.9, 0.999, 1e-6, 0.01
+        max_gn = 1.0
+        ours = _run_ours(
+            FusedLAMB(lr=lr, betas=(b1, b2), eps=eps, weight_decay=wd,
+                      max_grad_norm=max_gn, adam_w_mode=False),
+            params, grads,
+        )
+        p = {k: v.copy() for k, v in params.items()}
+        m = {k: np.zeros_like(v) for k, v in params.items()}
+        v_ = {k: np.zeros_like(v) for k, v in params.items()}
+        t = 0
+        for g in grads:
+            t += 1
+            gn = np.sqrt(sum((g[k] ** 2).sum() for k in g))
+            clip = max(gn / max_gn, 1.0)
+            for k in p:
+                gg = g[k] / clip + wd * p[k]
+                m[k] = b1 * m[k] + (1 - b1) * gg
+                v_[k] = b2 * v_[k] + (1 - b2) * gg * gg
+                u = (m[k] / (1 - b1**t)) / (np.sqrt(v_[k] / (1 - b2**t)) + eps)
+                wn = np.sqrt((p[k] ** 2).sum())
+                un = np.sqrt((u**2).sum())
+                ratio = wn / un if (wn > 0 and un > 0) else 1.0
+                p[k] -= lr * ratio * u
+        for k in p:
+            np.testing.assert_allclose(ours[k], p[k], rtol=1e-4, atol=1e-5)
+
+    def test_mixed_precision_lamb_is_master(self):
+        from apex_tpu.optimizers import FusedMixedPrecisionLamb
+
+        o = FusedMixedPrecisionLamb(lr=1e-3)
+        assert o.master_weights
+
+
+class TestFusedLion:
+    def test_vs_reference_impl(self):
+        params, grads = _make_problem(11)
+        lr, b1, b2, wd = 1e-3, 0.9, 0.99, 0.1
+        ours = _run_ours(
+            FusedLion(lr=lr, betas=(b1, b2), weight_decay=wd), params, grads
+        )
+        p = {k: v.copy() for k, v in params.items()}
+        m = {k: np.zeros_like(v) for k, v in params.items()}
+        for g in grads:
+            for k in p:
+                u = b1 * m[k] + (1 - b1) * g[k]
+                u = np.where(u <= 0, -1.0, 1.0) + wd * p[k]  # apex sign: 0→-1
+                p[k] -= lr * u
+                m[k] = b2 * m[k] + (1 - b2) * g[k]
+        for k in p:
+            np.testing.assert_allclose(ours[k], p[k], rtol=1e-5, atol=1e-6)
+
+
+class TestFusedNovoGrad:
+    def test_vs_reference_impl(self):
+        params, grads = _make_problem(12)
+        lr, b1, b2, eps, wd = 1e-2, 0.95, 0.98, 1e-8, 0.01
+        ours = _run_ours(
+            FusedNovoGrad(lr=lr, betas=(b1, b2), eps=eps, weight_decay=wd),
+            params, grads,
+        )
+        p = {k: v.copy() for k, v in params.items()}
+        m = {k: np.zeros_like(v) for k, v in params.items()}
+        gn = {k: None for k in params}
+        t = 0
+        for g in grads:
+            t += 1
+            bc1 = 1 - b1**t
+            bc2 = np.sqrt(1 - b2**t)
+            for k in p:
+                n = np.sqrt((g[k] ** 2).sum())
+                if gn[k] is None:
+                    gn[k] = n
+                gn[k] = np.sqrt(b2 * gn[k] ** 2 + (1 - b2) * n**2)
+                denom = gn[k] / bc2 + eps
+                m[k] = b1 * m[k] + (1 - b1) * g[k]
+                u = (m[k] / bc1) / denom + wd * p[k]
+                p[k] -= lr * u
+        for k in p:
+            np.testing.assert_allclose(ours[k], p[k], rtol=1e-4, atol=1e-5)
+
+    def test_inf_norm_mode(self):
+        params, grads = _make_problem(13)
+        out = _run_ours(FusedNovoGrad(lr=1e-2, norm_type=0), params, grads)
+        for k in params:  # just sanity: moved and finite
+            assert np.all(np.isfinite(out[k]))
+            assert not np.allclose(out[k], params[k])
+
+
+class TestLARC:
+    def test_transform_matches_reference_formula(self):
+        params, grads = _make_problem(14)
+        lr, tc, wd, eps = 0.1, 0.02, 0.01, 1e-8
+        larc = LARC(trust_coefficient=tc, clip=True, eps=eps, weight_decay=wd)
+        jp = {k: jnp.asarray(v) for k, v in params.items()}
+        jg = {k: jnp.asarray(v) for k, v in grads[0].items()}
+        out = larc.transform_grads(jg, jp, lr=lr)
+        for k in params:
+            pn = np.sqrt((params[k] ** 2).sum())
+            gnn = np.sqrt((grads[0][k] ** 2).sum())
+            adaptive = tc * pn / (gnn + pn * wd + eps)
+            adaptive = min(adaptive / lr, 1.0)
+            expect = (grads[0][k] + wd * params[k]) * adaptive
+            np.testing.assert_allclose(np.asarray(out[k]), expect, rtol=1e-5)
+
+    def test_wrapper_unscales_before_norms(self):
+        """LARC adaptive rates must be computed on unscaled grads."""
+        params, grads = _make_problem(18)
+        S = 4096.0
+
+        def run(gs, scale):
+            inner = FusedSGD(lr=0.05, momentum=0.9)
+            larc = LARC(inner, trust_coefficient=0.02, weight_decay=0.01)
+            jp = {k: jnp.asarray(v) for k, v in params.items()}
+            state = larc.init(jp)
+            jg = {k: jnp.asarray(v) for k, v in gs.items()}
+            return larc.step(jg, state, jp, grad_scale=scale)[0]
+
+        a = run(grads[0], None)
+        b = run({k: v * S for k, v in grads[0].items()}, jnp.float32(S))
+        for k in params:
+            np.testing.assert_allclose(
+                np.asarray(a[k]), np.asarray(b[k]), rtol=1e-5, atol=1e-6
+            )
+
+    def test_wrapper_steps(self):
+        params, grads = _make_problem(15)
+        inner = FusedSGD(lr=0.05, momentum=0.9)
+        larc = LARC(inner, trust_coefficient=0.02)
+        jp = {k: jnp.asarray(v) for k, v in params.items()}
+        state = larc.init(jp)
+        newp, state = larc.step({k: jnp.asarray(v) for k, v in grads[0].items()}, state, jp)
+        assert not np.allclose(np.asarray(newp["p0"]), params["p0"])
+
+
+class TestClipGrad:
+    def test_vs_torch(self):
+        params, grads = _make_problem(16)
+        jg = {k: jnp.asarray(v) for k, v in grads[0].items()}
+        clipped, total = clip_grad_norm(jg, max_norm=1.0)
+        tg = [torch.tensor(grads[0][k], requires_grad=False) for k in grads[0]]
+        for t in tg:
+            t.grad = None
+        tp = [torch.nn.Parameter(t) for t in tg]
+        for p, k in zip(tp, grads[0]):
+            p.grad = torch.tensor(grads[0][k])
+        tnorm = torch.nn.utils.clip_grad_norm_(tp, 1.0)
+        np.testing.assert_allclose(float(total), float(tnorm), rtol=1e-5)
+        for k, p in zip(grads[0], tp):
+            np.testing.assert_allclose(
+                np.asarray(clipped[k]), p.grad.numpy(), rtol=1e-4, atol=1e-6
+            )
+
+    def test_no_clip_when_small(self):
+        g = {"a": jnp.full((2,), 1e-3)}
+        clipped, total = clip_grad_norm(g, max_norm=10.0)
+        np.testing.assert_allclose(np.asarray(clipped["a"]), 1e-3, rtol=1e-5)
