@@ -1,0 +1,27 @@
+"""apex_tpu.normalization — fused LayerNorm / RMSNorm.
+
+TPU-native replacement for ``apex/normalization``
+(``apex/normalization/fused_layer_norm.py``, kernels
+``csrc/layer_norm_cuda_kernel.cu``).  On TPU a row-norm is a small fusion XLA
+handles well; the value preserved from the reference is *semantics*:
+
+- affine / non-affine, LayerNorm and RMSNorm;
+- mixed-dtype mode (bf16 input, fp32 weights — the "MixedFused" Megatron
+  variants, ``fused_layer_norm.py:430``);
+- ``memory_efficient`` backward that recomputes the normalized input from
+  the *output* instead of saving the input
+  (``csrc/layer_norm_cuda_kernel.cu:576-717``), exposed as a custom_vjp so
+  it composes with ``jax.checkpoint``.
+"""
+
+from apex_tpu.normalization.fused_layer_norm import (  # noqa: F401
+    fused_layer_norm,
+    fused_layer_norm_affine,
+    fused_rms_norm,
+    fused_rms_norm_affine,
+    FusedLayerNorm,
+    FusedRMSNorm,
+    MixedFusedLayerNorm,
+    MixedFusedRMSNorm,
+    manual_rms_norm,
+)

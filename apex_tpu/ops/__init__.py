@@ -1,0 +1,33 @@
+"""apex_tpu.ops — fused functional ops.
+
+TPU-native replacements for the reference's fused CUDA op zoo:
+
+- :mod:`softmax` — the megatron scale-mask-softmax family
+  (``csrc/megatron/scaled_*_softmax*``, frontend
+  ``apex/transformer/functional/fused_softmax.py``)
+- :mod:`dense` — GEMM+bias(+GeLU) epilogue fusions
+  (``csrc/fused_dense_cuda.cu``, ``apex/fused_dense``)
+- :mod:`mlp` — whole-MLP forward/backward (``csrc/mlp_cuda.cu``, ``apex/mlp``)
+- :mod:`xentropy` — softmax-cross-entropy saving only max+logsumexp
+  (``apex/contrib/csrc/xentropy``)
+- :mod:`pallas_norm` — Pallas row-norm fast path
+  (``apex/contrib/csrc/layer_norm`` FastLayerNorm analog)
+"""
+
+from apex_tpu.ops.softmax import (  # noqa: F401
+    scaled_softmax,
+    scaled_masked_softmax,
+    scaled_upper_triang_masked_softmax,
+    generic_scaled_masked_softmax,
+    FusedScaleMaskSoftmax,
+    AttnMaskType,
+)
+from apex_tpu.ops.dense import (  # noqa: F401
+    fused_dense,
+    fused_dense_gelu_dense,
+    FusedDense,
+    FusedDenseGeluDense,
+)
+from apex_tpu.ops.mlp import MLP, mlp_forward  # noqa: F401
+from apex_tpu.ops.xentropy import softmax_cross_entropy_loss  # noqa: F401
+from apex_tpu.ops import pallas_norm  # noqa: F401

@@ -1,0 +1,92 @@
+"""Whole-MLP fusion — the ``mlp_cuda`` analog.
+
+Behavioral spec: ``apex/mlp/mlp.py`` (``MlpFunction:11``, ``MLP:33``) over
+``csrc/mlp_cuda.cu`` (``mlp_gemm`` chain with fused bias + relu/sigmoid
+epilogues ``:59-147``).  The reference fuses an entire N-layer perceptron —
+every GEMM, bias add and activation, forward and backward — into one C++
+call to avoid framework overhead between layers.
+
+Under jit the Python loop below unrolls into a single XLA computation, so the
+reference's whole point (no per-layer dispatch) holds by construction.  API
+parity: ``mlp_sizes`` list, ``bias`` flag, ``activation`` in
+{'none', 'relu', 'sigmoid'} (``apex/mlp/mlp.py:36-46``), torch weight layout
+[out, in].
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import jax
+import jax.numpy as jnp
+
+try:
+    import flax.linen as nn
+except Exception:  # pragma: no cover
+    nn = None
+
+__all__ = ["mlp_forward", "MLP"]
+
+_ACTIVATIONS = {
+    "none": lambda x: x,
+    "relu": jax.nn.relu,
+    "sigmoid": jax.nn.sigmoid,
+}
+
+
+def mlp_forward(x, weights: Sequence, biases: Sequence, activation: str = "relu"):
+    """Functional N-layer MLP.
+
+    The activation is applied after *every* layer including the last — the
+    reference applies its epilogue per GEMM (``mlp_cuda.cu:1332-1350``), and
+    its own test builds the torch reference as Linear+ReLU pairs for every
+    layer (``tests/L0/run_mlp/test_mlp.py:28-36``).
+    """
+    if activation not in _ACTIVATIONS:
+        raise ValueError(
+            f"activation must be one of {sorted(_ACTIVATIONS)} "
+            "(parity with apex/mlp/mlp.py:43)"
+        )
+    act = _ACTIVATIONS[activation]
+    h = x
+    for i, w in enumerate(weights):
+        h = jnp.dot(h, w.T, preferred_element_type=h.dtype)
+        if biases:
+            h = h + biases[i]
+        h = act(h)
+    return h
+
+
+if nn is not None:
+
+    class MLP(nn.Module):
+        """Module analog of ``apex.mlp.MLP`` (``apex/mlp/mlp.py:33``).
+
+        ``mlp_sizes``: [in, hidden..., out]."""
+
+        mlp_sizes: Sequence[int]
+        use_bias: bool = True
+        activation: str = "relu"
+        param_dtype: jnp.dtype = jnp.float32
+
+        @nn.compact
+        def __call__(self, x):
+            weights, biases = [], []
+            for i in range(len(self.mlp_sizes) - 1):
+                w = self.param(
+                    f"weight_{i}",
+                    nn.initializers.lecun_normal(),
+                    (self.mlp_sizes[i + 1], self.mlp_sizes[i]),
+                    self.param_dtype,
+                )
+                weights.append(jnp.asarray(w, x.dtype))
+                if self.use_bias:
+                    b = self.param(
+                        f"bias_{i}", nn.initializers.zeros,
+                        (self.mlp_sizes[i + 1],), self.param_dtype,
+                    )
+                    biases.append(jnp.asarray(b, x.dtype))
+            return mlp_forward(x, weights, biases, self.activation)
+
+else:  # pragma: no cover
+    MLP = None

@@ -1,0 +1,231 @@
+"""Scale-mask-softmax family — the megatron fused softmax analog.
+
+Behavioral spec: ``apex/transformer/functional/fused_softmax.py`` (autograd
+wrappers ``:21,71,106,133``, dispatcher ``FusedScaleMaskSoftmax:164``) over
+the warp-level kernels in ``csrc/megatron/scaled_*_softmax*.cu``.
+
+Semantics preserved:
+
+- forward: ``softmax(scale * x + mask)`` with the mask applied *after*
+  scaling, causal (upper-triangular) or additive padding mask variants;
+  math in fp32, result cast back to the input dtype (the kernels compute
+  ``acc_t = float`` internally);
+- backward saves only the softmax *output*:
+  ``dx = scale * y * (dy - sum(dy*y))`` — expressed as a custom_vjp so the
+  activation-memory profile matches the fused kernels (the default jax vjp
+  of the composed forward would save the inputs as well);
+- ``generic_scaled_masked_softmax`` — the no-shape-limit variant
+  (``csrc/megatron/generic_scaled_masked_softmax.cu``);
+- :class:`FusedScaleMaskSoftmax` keeps the dispatcher API (mask type,
+  ``softmax_in_fp32``, ``mask_func``, scale validation) but needs no
+  ``is_kernel_available`` shape gate — there is no 16384-key or
+  seq-multiple-of-4 limit, any shape compiles (``fused_softmax.py:222-246``
+  becomes vacuous on TPU; kept as a method returning True for API parity).
+
+Masks: the reference's padding mask is a *bool* tensor where True means
+"mask out" (filled with -10000 by ``mask_func``); reproduced here.
+"""
+
+from __future__ import annotations
+
+import enum
+from functools import partial
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "AttnMaskType",
+    "scaled_softmax",
+    "scaled_masked_softmax",
+    "scaled_upper_triang_masked_softmax",
+    "generic_scaled_masked_softmax",
+    "FusedScaleMaskSoftmax",
+]
+
+
+class AttnMaskType(enum.Enum):
+    """``apex/transformer/enums.py`` AttnMaskType."""
+
+    padding = 1
+    causal = 2
+
+
+_MASK_FILL = -10000.0  # reference mask fill value (attention_mask_func)
+
+
+def _softmax_fwd_f32(x32):
+    m = jnp.max(x32, axis=-1, keepdims=True)
+    e = jnp.exp(x32 - m)
+    return e / jnp.sum(e, axis=-1, keepdims=True)
+
+
+def _softmax_bwd_from_y(y, dy, scale):
+    y32 = jnp.asarray(y, jnp.float32)
+    dy32 = jnp.asarray(dy, jnp.float32)
+    inner = dy32 - jnp.sum(dy32 * y32, axis=-1, keepdims=True)
+    return scale * y32 * inner
+
+
+# --- scaled softmax (no mask) ---------------------------------------------
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def scaled_softmax(x, scale: float = 1.0):
+    """``ScaledSoftmax`` (``fused_softmax.py:133``): softmax(scale*x)."""
+    y = _softmax_fwd_f32(jnp.asarray(x, jnp.float32) * scale)
+    return jnp.asarray(y, x.dtype)
+
+
+def _ss_fwd(x, scale):
+    y = scaled_softmax(x, scale)
+    return y, (y,)
+
+
+def _ss_bwd(scale, res, dy):
+    (y,) = res
+    return (jnp.asarray(_softmax_bwd_from_y(y, dy, scale), y.dtype),)
+
+
+scaled_softmax.defvjp(_ss_fwd, _ss_bwd)
+
+
+# --- scaled masked softmax (padding mask) ----------------------------------
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(2,))
+def scaled_masked_softmax(x, mask, scale: float = 1.0):
+    """``ScaledMaskedSoftmax`` (``fused_softmax.py:71``):
+    softmax(mask_fill(scale*x)).  ``mask`` is bool, True = masked out,
+    broadcastable to x ([b, 1, sq, sk] against [b, np, sq, sk])."""
+    x32 = jnp.asarray(x, jnp.float32) * scale
+    if mask is not None:
+        x32 = jnp.where(mask, _MASK_FILL, x32)
+    y = _softmax_fwd_f32(x32)
+    return jnp.asarray(y, x.dtype)
+
+
+def _sms_fwd(x, mask, scale):
+    y = scaled_masked_softmax(x, mask, scale)
+    return y, (y,)
+
+
+def _sms_bwd(scale, res, dy):
+    (y,) = res
+    # masked positions have y==0 so their grad is 0 automatically
+    return (jnp.asarray(_softmax_bwd_from_y(y, dy, scale), y.dtype), None)
+
+
+scaled_masked_softmax.defvjp(_sms_fwd, _sms_bwd)
+
+
+# --- causal -----------------------------------------------------------------
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def scaled_upper_triang_masked_softmax(x, scale: float = 1.0):
+    """``ScaledUpperTriangMaskedSoftmax`` (``fused_softmax.py:21``): causal
+    mask built in-kernel (``scaled_upper_triang_masked_softmax.h``).
+    x: [..., sq, sk] with sq == sk (attn_batches leading)."""
+    sq, sk = x.shape[-2], x.shape[-1]
+    x32 = jnp.asarray(x, jnp.float32) * scale
+    causal = jnp.tril(jnp.ones((sq, sk), bool))
+    x32 = jnp.where(causal, x32, _MASK_FILL)
+    y = _softmax_fwd_f32(x32)
+    # kernel zeroes the strictly-upper triangle exactly
+    y = jnp.where(causal, y, 0.0)
+    return jnp.asarray(y, x.dtype)
+
+
+def _sutms_fwd(x, scale):
+    y = scaled_upper_triang_masked_softmax(x, scale)
+    return y, (y,)
+
+
+def _sutms_bwd(scale, res, dy):
+    (y,) = res
+    return (jnp.asarray(_softmax_bwd_from_y(y, dy, scale), y.dtype),)
+
+
+scaled_upper_triang_masked_softmax.defvjp(_sutms_fwd, _sutms_bwd)
+
+
+def generic_scaled_masked_softmax(x, mask, scale: float = 1.0):
+    """No-shape-limit variant (``csrc/megatron/generic_scaled_masked_softmax.cu``)
+    — on TPU identical to :func:`scaled_masked_softmax`."""
+    return scaled_masked_softmax(x, mask, scale)
+
+
+# --- dispatcher module ------------------------------------------------------
+
+
+class FusedScaleMaskSoftmax:
+    """Dispatcher with the reference constructor surface
+    (``fused_softmax.py:164-213``).
+
+    On TPU every shape takes the fused path; ``softmax_in_fp32`` and the
+    float16 flags only affect the *non-scaled* fallback dtype behavior the
+    reference has (``forward_torch_softmax`` ``:253-270``), which we keep for
+    numerical parity of the ``softmax_in_fp32=False`` configuration.
+    """
+
+    def __init__(
+        self,
+        input_in_fp16: bool = False,
+        input_in_bf16: bool = True,
+        attn_mask_type: AttnMaskType = AttnMaskType.padding,
+        scaled_masked_softmax_fusion: bool = True,
+        mask_func: Optional[Callable] = None,
+        softmax_in_fp32: bool = True,
+        scale: Optional[float] = None,
+    ):
+        if input_in_fp16 and input_in_bf16:
+            raise RuntimeError(
+                "both fp16 and bf16 flags cannot be active at the same time."
+            )
+        self.input_in_fp16 = input_in_fp16
+        self.input_in_bf16 = input_in_bf16
+        self.input_in_float16 = input_in_fp16 or input_in_bf16
+        self.attn_mask_type = attn_mask_type
+        self.scaled_masked_softmax_fusion = scaled_masked_softmax_fusion
+        self.mask_func = mask_func
+        self.softmax_in_fp32 = softmax_in_fp32
+        self.scale = scale
+        if not (scale is None or softmax_in_fp32):
+            raise RuntimeError("softmax should be in fp32 when scaled")
+
+    def is_kernel_available(self, mask, b, np_, sq, sk) -> bool:
+        """Always True on TPU — no warp-kernel shape limits
+        (cf. ``fused_softmax.py:222-246``)."""
+        return self.scaled_masked_softmax_fusion
+
+    def __call__(self, x, mask):
+        assert x.ndim == 4, "expected [b, np, sq, sk]"
+        scale = self.scale if self.scale is not None else 1.0
+        if self.scaled_masked_softmax_fusion:
+            if self.attn_mask_type == AttnMaskType.causal:
+                b, np_, sq, sk = x.shape
+                assert sq == sk, "causal mask requires sq == sk"
+                y = scaled_upper_triang_masked_softmax(
+                    x.reshape(b * np_, sq, sk), scale
+                )
+                return y.reshape(b, np_, sq, sk)
+            return scaled_masked_softmax(x, mask, scale)
+        # unfused fallback with reference dtype behavior
+        if self.input_in_float16 and self.softmax_in_fp32:
+            x = jnp.asarray(x, jnp.float32)
+        if self.scale is not None:
+            x = x * self.scale
+        if mask is not None and self.mask_func is not None:
+            x = self.mask_func(x, mask)
+        elif mask is not None:
+            x = jnp.where(mask, _MASK_FILL, x)
+        probs = jax.nn.softmax(x, axis=-1)
+        if self.input_in_float16 and self.softmax_in_fp32:
+            # cast back to the declared input half dtype
+            # (fused_softmax.py:263-266 .half() vs .bfloat16())
+            probs = jnp.asarray(
+                probs, jnp.float16 if self.input_in_fp16 else jnp.bfloat16
+            )
+        return probs

@@ -1,0 +1,92 @@
+"""Fused softmax-cross-entropy with label smoothing — the ``xentropy`` analog.
+
+Behavioral spec: ``apex/contrib/xentropy/softmax_xentropy.py:6-30`` over
+``apex/contrib/csrc/xentropy/xentropy_kernel.cu``:
+
+- forward (``:424-431``): per-row
+  ``loss = (lse - Σlogits/C) * smoothing - log_prob[label] * (1-smoothing)``
+  with ``lse = max + log Σ exp(x - max)``; rows whose ``label ==
+  padding_idx`` are zeroed (``softmax_xentropy.py:11``);
+- the kernel saves only ``max_log_sum_exp`` (one scalar per row) for the
+  backward — *not* the softmax probabilities — and recomputes
+  ``exp(logit - lse)`` from the logits in bprop (``:444-470``):
+  ``dlogits = dloss * (exp(x - lse) - onehot*(1-smoothing) - smoothing/C)``,
+  zeroed on padding rows.
+
+The custom_vjp below has exactly that residual set (logits, lse, labels),
+so activation memory matches the fused kernel: O(rows) extra instead of a
+full [rows, classes] probability tensor.  ``half_to_float=True`` returns
+fp32 losses from half logits (``softmax_xentropy.py:9``).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["softmax_cross_entropy_loss"]
+
+
+def _lse(x32):
+    m = jnp.max(x32, axis=-1)
+    return m + jnp.log(jnp.sum(jnp.exp(x32 - m[..., None]), axis=-1))
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+def softmax_cross_entropy_loss(
+    logits,
+    labels,
+    smoothing: float = 0.0,
+    padding_idx: int = 0,
+    half_to_float: bool = False,
+):
+    """Per-row smoothed CE losses of shape ``labels.shape``.
+
+    ``logits: [..., C]`` (any float dtype; math in fp32), ``labels: [...]``
+    int.  Matches ``SoftmaxCrossEntropyLoss.apply`` including the
+    padding-row zeroing.
+    """
+    loss, _ = _fwd_math(logits, labels, smoothing, padding_idx)
+    if half_to_float or logits.dtype == jnp.float32:
+        return loss
+    return jnp.asarray(loss, logits.dtype)
+
+
+def _fwd_math(logits, labels, smoothing, padding_idx):
+    x32 = jnp.asarray(logits, jnp.float32)
+    lse = _lse(x32)
+    label_logit = jnp.take_along_axis(
+        x32, labels[..., None].astype(jnp.int32), axis=-1
+    )[..., 0]
+    log_prob = label_logit - lse
+    C = x32.shape[-1]
+    sum_logits = jnp.sum(x32, axis=-1)
+    loss = (lse - sum_logits / C) * smoothing - log_prob * (1.0 - smoothing)
+    loss = jnp.where(labels == padding_idx, 0.0, loss)
+    return loss, lse
+
+
+def _vjp_fwd(logits, labels, smoothing, padding_idx, half_to_float):
+    loss, lse = _fwd_math(logits, labels, smoothing, padding_idx)
+    if not (half_to_float or logits.dtype == jnp.float32):
+        loss = jnp.asarray(loss, logits.dtype)
+    # residuals: logits + one lse scalar per row (xentropy_kernel.cu:430)
+    return loss, (logits, lse, labels)
+
+
+def _vjp_bwd(smoothing, padding_idx, half_to_float, res, dloss):
+    logits, lse, labels = res
+    x32 = jnp.asarray(logits, jnp.float32)
+    C = x32.shape[-1]
+    probs = jnp.exp(x32 - lse[..., None])
+    onehot = jax.nn.one_hot(labels, C, dtype=jnp.float32)
+    g = probs - onehot * (1.0 - smoothing) - smoothing / C
+    d32 = jnp.asarray(dloss, jnp.float32)
+    d32 = jnp.where(labels == padding_idx, 0.0, d32)
+    dlogits = d32[..., None] * g
+    return (jnp.asarray(dlogits, logits.dtype), None)
+
+
+softmax_cross_entropy_loss.defvjp(_vjp_fwd, _vjp_bwd)

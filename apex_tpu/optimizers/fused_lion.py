@@ -40,12 +40,17 @@ def _apex_sign(u):
 class FusedLion:
     def __init__(
         self,
-        lr: float = 1e-4,
+        lr: float = 1e-3,
+        bias_correction: bool = True,
         betas=(0.9, 0.999),
+        eps: float = 1e-8,
         lion_w_mode: bool = True,
         weight_decay: float = 0.0,
         master_weights: bool = False,
     ):
+        # bias_correction/eps accepted for ctor parity (fused_lion.py:8-9);
+        # the reference kernel ignores both (commented out in
+        # multi_tensor_lion.cu:93-96), as does this implementation.
         self.lr = lr
         self.beta1, self.beta2 = betas
         self.lion_w_mode = lion_w_mode

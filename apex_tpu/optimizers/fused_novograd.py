@@ -46,7 +46,7 @@ class FusedNovoGrad:
         self,
         lr: float = 1e-3,
         bias_correction: bool = True,
-        betas=(0.95, 0.98),
+        betas=(0.9, 0.999),
         eps: float = 1e-8,
         weight_decay: float = 0.0,
         amsgrad: bool = False,

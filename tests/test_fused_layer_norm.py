@@ -1,0 +1,164 @@
+"""Fused LayerNorm/RMSNorm numerics — analog of
+``tests/L0/run_fused_layer_norm/test_fused_layer_norm.py`` (fused vs
+framework-native reference across affine/RMS/mixed-dtype/memory-efficient)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+
+from apex_tpu.normalization import (
+    FusedLayerNorm,
+    FusedRMSNorm,
+    fused_layer_norm,
+    fused_layer_norm_affine,
+    fused_rms_norm,
+    fused_rms_norm_affine,
+    manual_rms_norm,
+)
+
+
+def _rand(shape, seed=0, dtype=np.float32):
+    return np.random.RandomState(seed).randn(*shape).astype(dtype)
+
+
+SHAPES = [((4, 16), (16,)), ((2, 3, 8), (8,)), ((5, 4, 6), (4, 6))]
+
+
+class TestLayerNorm:
+    @pytest.mark.parametrize("xshape,nshape", SHAPES)
+    @pytest.mark.parametrize("mem_eff", [False, True])
+    def test_affine_fwd_bwd_vs_torch(self, xshape, nshape, mem_eff):
+        x = _rand(xshape, 1)
+        w = _rand(nshape, 2) * 0.5 + 1.0
+        b = _rand(nshape, 3) * 0.1
+
+        y = fused_layer_norm_affine(
+            jnp.asarray(x), jnp.asarray(w), jnp.asarray(b), nshape,
+            memory_efficient=mem_eff,
+        )
+        tx = torch.tensor(x, requires_grad=True)
+        tw = torch.tensor(w, requires_grad=True)
+        tb = torch.tensor(b, requires_grad=True)
+        ty = torch.nn.functional.layer_norm(tx, nshape, tw, tb, 1e-5)
+        np.testing.assert_allclose(np.asarray(y), ty.detach().numpy(),
+                                   rtol=1e-5, atol=1e-5)
+
+        # backward
+        dy = _rand(xshape, 4)
+        dx, dw, db = jax.grad(
+            lambda x_, w_, b_: jnp.sum(
+                fused_layer_norm_affine(x_, w_, b_, nshape,
+                                        memory_efficient=mem_eff)
+                * jnp.asarray(dy)
+            ),
+            argnums=(0, 1, 2),
+        )(jnp.asarray(x), jnp.asarray(w), jnp.asarray(b))
+        ty.backward(torch.tensor(dy))
+        np.testing.assert_allclose(np.asarray(dx), tx.grad.numpy(), rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(dw), tw.grad.numpy(), rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(db), tb.grad.numpy(), rtol=1e-4, atol=1e-5)
+
+    def test_non_affine(self):
+        x = _rand((4, 16), 5)
+        y = fused_layer_norm(jnp.asarray(x), (16,))
+        ty = torch.nn.functional.layer_norm(torch.tensor(x), (16,))
+        np.testing.assert_allclose(np.asarray(y), ty.numpy(), rtol=1e-5, atol=1e-5)
+
+    def test_bf16_input_fp32_stats(self):
+        """Mixed dtype: bf16 input, fp32 weights (MixedFused variant)."""
+        x = _rand((8, 32), 6)
+        w = np.ones(32, np.float32)
+        b = np.zeros(32, np.float32)
+        y = fused_layer_norm_affine(
+            jnp.asarray(x, jnp.bfloat16), jnp.asarray(w), jnp.asarray(b), (32,)
+        )
+        assert y.dtype == jnp.bfloat16
+        ty = torch.nn.functional.layer_norm(torch.tensor(x), (32,))
+        np.testing.assert_allclose(
+            np.asarray(y, np.float32), ty.numpy(), rtol=2e-2, atol=2e-2
+        )
+
+    def test_memory_efficient_matches_standard(self):
+        x = _rand((4, 16), 7)
+        w = _rand((16,), 8) + 1.0
+        b = _rand((16,), 9)
+        f = lambda me: jax.grad(
+            lambda x_: jnp.sum(
+                fused_layer_norm_affine(x_, jnp.asarray(w), jnp.asarray(b),
+                                        (16,), memory_efficient=me) ** 2
+            )
+        )(jnp.asarray(x))
+        np.testing.assert_allclose(np.asarray(f(True)), np.asarray(f(False)),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_memory_efficient_zero_gamma_no_nan(self):
+        """clamp_by_magnitude parity (layer_norm_cuda_kernel.cu:443): zero
+        gamma must not produce NaN grads in the memory-efficient backward."""
+        x = jnp.asarray(_rand((4, 16), 30))
+        w = jnp.zeros(16)
+        b = jnp.zeros(16)
+        dx = jax.grad(
+            lambda x_: jnp.sum(
+                fused_layer_norm_affine(x_, w, b, (16,), memory_efficient=True)
+            )
+        )(x)
+        assert np.all(np.isfinite(np.asarray(dx)))
+
+    def test_module(self):
+        m = FusedLayerNorm(normalized_shape=16)
+        x = jnp.asarray(_rand((4, 16), 10))
+        params = m.init(jax.random.PRNGKey(0), x)
+        y = m.apply(params, x)
+        ty = torch.nn.functional.layer_norm(torch.tensor(np.asarray(x)), (16,))
+        np.testing.assert_allclose(np.asarray(y), ty.numpy(), rtol=1e-5, atol=1e-5)
+
+
+class TestRMSNorm:
+    @pytest.mark.parametrize("xshape,nshape", SHAPES)
+    @pytest.mark.parametrize("mem_eff", [False, True])
+    def test_affine_fwd_bwd_vs_manual(self, xshape, nshape, mem_eff):
+        x = _rand(xshape, 11)
+        w = _rand(nshape, 12) * 0.5 + 1.0
+        y = fused_rms_norm_affine(
+            jnp.asarray(x), jnp.asarray(w), nshape, memory_efficient=mem_eff
+        )
+        ref = manual_rms_norm(jnp.asarray(x), nshape, jnp.asarray(w), 1e-5)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(ref), rtol=1e-5, atol=1e-5)
+
+        # grads vs autodiff of the manual implementation
+        dy = _rand(xshape, 13)
+        got = jax.grad(
+            lambda x_, w_: jnp.sum(
+                fused_rms_norm_affine(x_, w_, nshape, memory_efficient=mem_eff)
+                * jnp.asarray(dy)
+            ),
+            argnums=(0, 1),
+        )(jnp.asarray(x), jnp.asarray(w))
+        want = jax.grad(
+            lambda x_, w_: jnp.sum(
+                manual_rms_norm(x_, nshape, w_, 1e-5) * jnp.asarray(dy)
+            ),
+            argnums=(0, 1),
+        )(jnp.asarray(x), jnp.asarray(w))
+        np.testing.assert_allclose(np.asarray(got[0]), np.asarray(want[0]),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(got[1]), np.asarray(want[1]),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_torch_rms_norm_parity(self):
+        x = _rand((4, 16), 14)
+        w = _rand((16,), 15) + 1.0
+        y = fused_rms_norm_affine(jnp.asarray(x), jnp.asarray(w), (16,))
+        ty = torch.nn.functional.rms_norm(
+            torch.tensor(x), (16,), torch.tensor(w), 1e-5
+        )
+        np.testing.assert_allclose(np.asarray(y), ty.numpy(), rtol=1e-5, atol=1e-5)
+
+    def test_module(self):
+        m = FusedRMSNorm(normalized_shape=16)
+        x = jnp.asarray(_rand((4, 16), 16))
+        params = m.init(jax.random.PRNGKey(0), x)
+        y = m.apply(params, x)
+        assert y.shape == x.shape

@@ -1,0 +1,241 @@
+"""Fused softmax / dense / MLP / xentropy numerics vs references.
+
+Mirrors ``tests/L0/run_transformer/test_fused_softmax.py``,
+``tests/L0/run_mlp/test_mlp.py`` and
+``apex/contrib/test/xentropy/test_label_smoothing.py``."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+
+from apex_tpu.ops import (
+    AttnMaskType,
+    FusedScaleMaskSoftmax,
+    MLP,
+    fused_dense,
+    fused_dense_gelu_dense,
+    mlp_forward,
+    scaled_masked_softmax,
+    scaled_softmax,
+    scaled_upper_triang_masked_softmax,
+    softmax_cross_entropy_loss,
+)
+
+
+def _rand(shape, seed=0, dtype=np.float32):
+    return np.random.RandomState(seed).randn(*shape).astype(dtype)
+
+
+class TestSoftmax:
+    def test_scaled_softmax(self):
+        x = _rand((2, 4, 8, 8), 0)
+        y = scaled_softmax(jnp.asarray(x), 0.5)
+        ref = torch.softmax(torch.tensor(x) * 0.5, dim=-1)
+        np.testing.assert_allclose(np.asarray(y), ref.numpy(), rtol=1e-5, atol=1e-6)
+
+    def test_scaled_masked_softmax(self):
+        x = _rand((2, 4, 8, 8), 1)
+        mask = np.random.RandomState(2).rand(2, 1, 8, 8) > 0.7
+        y = scaled_masked_softmax(jnp.asarray(x), jnp.asarray(mask), 0.7)
+        tx = torch.tensor(x) * 0.7
+        tx = tx.masked_fill(torch.tensor(mask), -10000.0)
+        ref = torch.softmax(tx, dim=-1)
+        np.testing.assert_allclose(np.asarray(y), ref.numpy(), rtol=1e-5, atol=1e-6)
+
+    def test_causal_softmax(self):
+        x = _rand((8, 16, 16), 3)
+        y = scaled_upper_triang_masked_softmax(jnp.asarray(x), 1.0)
+        tx = torch.tensor(x)
+        mask = torch.triu(torch.ones(16, 16, dtype=torch.bool), diagonal=1)
+        ref = torch.softmax(tx.masked_fill(mask, -10000.0), dim=-1)
+        ref = ref.masked_fill(mask, 0.0)
+        np.testing.assert_allclose(np.asarray(y), ref.numpy(), rtol=1e-5, atol=1e-6)
+        # strictly-upper triangle exactly zero (kernel parity)
+        yy = np.asarray(y)
+        assert np.all(yy[:, np.triu_indices(16, 1)[0], np.triu_indices(16, 1)[1]] == 0)
+
+    def test_softmax_backward_saves_only_output(self):
+        """custom_vjp backward: dx = scale*y*(dy - sum(dy*y))."""
+        x = _rand((2, 2, 4, 4), 4)
+        dy = _rand((2, 2, 4, 4), 5)
+        dx = jax.grad(
+            lambda x_: jnp.sum(scaled_softmax(x_, 2.0) * jnp.asarray(dy))
+        )(jnp.asarray(x))
+        tx = torch.tensor(x, requires_grad=True)
+        ty = torch.softmax(tx * 2.0, dim=-1)
+        ty.backward(torch.tensor(dy))
+        np.testing.assert_allclose(np.asarray(dx), tx.grad.numpy(), rtol=1e-4, atol=1e-6)
+
+    def test_masked_softmax_backward(self):
+        x = _rand((2, 2, 4, 4), 6)
+        mask = np.random.RandomState(7).rand(2, 1, 4, 4) > 0.6
+        dy = _rand((2, 2, 4, 4), 8)
+        dx = jax.grad(
+            lambda x_: jnp.sum(
+                scaled_masked_softmax(x_, jnp.asarray(mask), 1.3) * jnp.asarray(dy)
+            )
+        )(jnp.asarray(x))
+        tx = torch.tensor(x, requires_grad=True)
+        tm = torch.tensor(mask)
+        ty = torch.softmax((tx * 1.3).masked_fill(tm, -10000.0), dim=-1)
+        ty.backward(torch.tensor(dy))
+        np.testing.assert_allclose(np.asarray(dx), tx.grad.numpy(), rtol=1e-4, atol=1e-6)
+
+    def test_dispatcher_causal(self):
+        x = _rand((2, 4, 8, 8), 9).astype(np.float32)
+        sm = FusedScaleMaskSoftmax(
+            input_in_fp16=False, input_in_bf16=True,
+            attn_mask_type=AttnMaskType.causal, scale=0.5,
+        )
+        y = sm(jnp.asarray(x, jnp.bfloat16), None)
+        assert y.shape == x.shape
+        # rows sum to 1 over the visible prefix
+        s = np.asarray(y, np.float32).sum(-1)
+        np.testing.assert_allclose(s, 1.0, atol=2e-2)
+
+    def test_unfused_fallback_restores_fp16(self):
+        """fused_softmax.py:263-266: fp16 input → fp16 output in the
+        softmax_in_fp32 unfused path (not bf16)."""
+        sm = FusedScaleMaskSoftmax(
+            input_in_fp16=True, input_in_bf16=False,
+            scaled_masked_softmax_fusion=False, softmax_in_fp32=True,
+        )
+        x = jnp.asarray(_rand((2, 2, 4, 4), 60), jnp.float16)
+        assert sm(x, None).dtype == jnp.float16
+
+    def test_dispatcher_rejects_scale_without_fp32(self):
+        with pytest.raises(RuntimeError):
+            FusedScaleMaskSoftmax(softmax_in_fp32=False, scale=2.0)
+
+    def test_dispatcher_rejects_both_dtypes(self):
+        with pytest.raises(RuntimeError):
+            FusedScaleMaskSoftmax(input_in_fp16=True, input_in_bf16=True)
+
+
+class TestDense:
+    def test_fused_dense_vs_torch(self):
+        x = _rand((4, 8), 10)
+        w = _rand((16, 8), 11)
+        b = _rand((16,), 12)
+        y = fused_dense(jnp.asarray(x), jnp.asarray(w), jnp.asarray(b))
+        ref = torch.nn.functional.linear(
+            torch.tensor(x), torch.tensor(w), torch.tensor(b)
+        )
+        np.testing.assert_allclose(np.asarray(y), ref.numpy(), rtol=1e-5, atol=1e-5)
+
+    def test_gelu_dense_vs_torch(self):
+        x = _rand((4, 8), 13)
+        w1, b1 = _rand((32, 8), 14), _rand((32,), 15)
+        w2, b2 = _rand((8, 32), 16), _rand((8,), 17)
+        y = fused_dense_gelu_dense(
+            *(jnp.asarray(a) for a in (x, w1, b1, w2, b2))
+        )
+        h = torch.nn.functional.linear(torch.tensor(x), torch.tensor(w1), torch.tensor(b1))
+        h = torch.nn.functional.gelu(h)  # erf gelu
+        ref = torch.nn.functional.linear(h, torch.tensor(w2), torch.tensor(b2))
+        np.testing.assert_allclose(np.asarray(y), ref.numpy(), rtol=1e-5, atol=1e-5)
+
+
+class TestMLP:
+    @pytest.mark.parametrize("activation", ["none", "relu", "sigmoid"])
+    @pytest.mark.parametrize("use_bias", [True, False])
+    def test_vs_torch_sequential(self, activation, use_bias):
+        """Parity with tests/L0/run_mlp/test_mlp.py: activation after every
+        layer."""
+        sizes = [7, 16, 4]
+        ws = [_rand((sizes[i + 1], sizes[i]), 20 + i) for i in range(2)]
+        bs = [_rand((sizes[i + 1],), 30 + i) for i in range(2)] if use_bias else []
+        x = _rand((5, 7), 40)
+        y = mlp_forward(
+            jnp.asarray(x), [jnp.asarray(w) for w in ws],
+            [jnp.asarray(b) for b in bs], activation,
+        )
+        h = torch.tensor(x)
+        for i in range(2):
+            h = torch.nn.functional.linear(
+                h, torch.tensor(ws[i]), torch.tensor(bs[i]) if use_bias else None
+            )
+            if activation == "relu":
+                h = torch.relu(h)
+            elif activation == "sigmoid":
+                h = torch.sigmoid(h)
+        np.testing.assert_allclose(np.asarray(y), h.numpy(), rtol=1e-5, atol=1e-5)
+
+    def test_invalid_activation(self):
+        with pytest.raises(ValueError):
+            mlp_forward(jnp.ones((2, 4)), [jnp.ones((4, 4))], [], "tanh")
+
+    def test_module(self):
+        m = MLP(mlp_sizes=(7, 16, 4))
+        x = jnp.asarray(_rand((5, 7), 41))
+        params = m.init(jax.random.PRNGKey(0), x)
+        assert m.apply(params, x).shape == (5, 4)
+
+
+class TestXentropy:
+    @pytest.mark.parametrize("smoothing", [0.0, 0.1])
+    def test_loss_vs_torch(self, smoothing):
+        """Parity with apex/contrib/test/xentropy/test_label_smoothing.py's
+        python reference (label_smoothing_raw)."""
+        C, N = 11, 6
+        logits = _rand((N, C), 50)
+        labels = np.random.RandomState(51).randint(1, C, size=(N,))
+        loss = softmax_cross_entropy_loss(
+            jnp.asarray(logits), jnp.asarray(labels), smoothing, -100
+        )
+        tl = torch.tensor(logits)
+        logprobs = torch.log_softmax(tl, dim=-1)
+        nll = -logprobs[torch.arange(N), torch.tensor(labels)]
+        smooth = -logprobs.mean(dim=-1)
+        ref = (1 - smoothing) * nll + smoothing * smooth
+        np.testing.assert_allclose(np.asarray(loss), ref.numpy(), rtol=1e-5, atol=1e-5)
+
+    def test_padding_rows_zeroed(self):
+        C = 5
+        logits = _rand((4, C), 52)
+        labels = np.array([0, 2, 0, 3])
+        loss = softmax_cross_entropy_loss(
+            jnp.asarray(logits), jnp.asarray(labels), 0.0, padding_idx=0
+        )
+        out = np.asarray(loss)
+        assert out[0] == 0.0 and out[2] == 0.0
+        assert out[1] != 0.0 and out[3] != 0.0
+
+    @pytest.mark.parametrize("smoothing", [0.0, 0.15])
+    def test_grad_vs_torch(self, smoothing):
+        C, N = 9, 5
+        logits = _rand((N, C), 53)
+        labels = np.random.RandomState(54).randint(1, C, size=(N,))
+        dl = jax.grad(
+            lambda x: jnp.sum(
+                softmax_cross_entropy_loss(x, jnp.asarray(labels), smoothing, -100)
+            )
+        )(jnp.asarray(logits))
+        tl = torch.tensor(logits, requires_grad=True)
+        logprobs = torch.log_softmax(tl, dim=-1)
+        nll = -logprobs[torch.arange(N), torch.tensor(labels)]
+        smooth = -logprobs.mean(dim=-1)
+        ((1 - smoothing) * nll + smoothing * smooth).sum().backward()
+        np.testing.assert_allclose(np.asarray(dl), tl.grad.numpy(), rtol=1e-4, atol=1e-6)
+
+    def test_half_to_float(self):
+        logits = jnp.asarray(_rand((4, 8), 55), jnp.bfloat16)
+        labels = jnp.asarray([1, 2, 3, 4])
+        out32 = softmax_cross_entropy_loss(logits, labels, 0.0, -100, True)
+        out16 = softmax_cross_entropy_loss(logits, labels, 0.0, -100, False)
+        assert out32.dtype == jnp.float32
+        assert out16.dtype == jnp.bfloat16
+
+    def test_grad_padding_rows_zero(self):
+        C = 6
+        logits = _rand((3, C), 56)
+        labels = np.array([0, 2, 4])
+        dl = jax.grad(
+            lambda x: jnp.sum(
+                softmax_cross_entropy_loss(x, jnp.asarray(labels), 0.1, 0)
+            )
+        )(jnp.asarray(logits))
+        np.testing.assert_allclose(np.asarray(dl)[0], 0.0)
+        assert np.abs(np.asarray(dl)[1]).sum() > 0
