@@ -1,0 +1,130 @@
+"""ResNet (NHWC, TPU-native) — the ``examples/imagenet`` workload model.
+
+The reference trains torchvision ResNet-50 under amp O2 + apex DDP +
+optional SyncBatchNorm (``examples/imagenet/main_amp.py:107-160``).  This is
+the equivalent model family built TPU-first:
+
+- NHWC layout throughout (TPU conv layout; the reference's
+  ``--channels-last`` fast path is the default here);
+- :class:`apex_tpu.parallel.SyncBatchNorm` as the norm layer, with
+  ``axis_name=None`` degrading to plain BN for single-replica runs —
+  the ``convert_syncbn_model`` decision (``apex/parallel/__init__.py:14-58``)
+  becomes a constructor flag;
+- the Bottleneck block fuses BN+ReLU epilogues (``fuse_relu=True``) and the
+  residual add into the last BN (``z=residual``) — the capability of
+  ``apex/contrib/bottleneck`` / ``groupbn`` BN-Add-ReLU expressed as module
+  composition that XLA fuses.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.parallel.sync_batchnorm import SyncBatchNorm
+
+__all__ = ["ResNet", "ResNet18", "ResNet50", "ResNet101"]
+
+
+class BasicBlock(nn.Module):
+    features: int
+    strides: int = 1
+    axis_name: Optional[str] = None
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        residual = x
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        bn = partial(SyncBatchNorm, axis_name=self.axis_name)
+
+        y = conv(self.features, (3, 3), (self.strides, self.strides))(x)
+        y = bn(self.features, fuse_relu=True)(y, use_running_average=not train)
+        y = conv(self.features, (3, 3))(y)
+        if residual.shape != y.shape:
+            residual = conv(self.features, (1, 1), (self.strides, self.strides),
+                            name="conv_proj")(x)
+            residual = bn(self.features, name="bn_proj")(
+                residual, use_running_average=not train
+            )
+        # BN + residual-add + ReLU fused epilogue
+        return bn(self.features, fuse_relu=True)(
+            y, z=residual, use_running_average=not train
+        )
+
+
+class BottleneckBlock(nn.Module):
+    features: int  # bottleneck width; output is 4*features
+    strides: int = 1
+    axis_name: Optional[str] = None
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        residual = x
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        bn = partial(SyncBatchNorm, axis_name=self.axis_name)
+        out_feats = self.features * 4
+
+        y = conv(self.features, (1, 1))(x)
+        y = bn(self.features, fuse_relu=True)(y, use_running_average=not train)
+        y = conv(self.features, (3, 3), (self.strides, self.strides))(y)
+        y = bn(self.features, fuse_relu=True)(y, use_running_average=not train)
+        y = conv(out_feats, (1, 1))(y)
+        if residual.shape != y.shape:
+            residual = conv(out_feats, (1, 1), (self.strides, self.strides),
+                            name="conv_proj")(x)
+            residual = bn(out_feats, name="bn_proj")(
+                residual, use_running_average=not train
+            )
+        return bn(out_feats, fuse_relu=True)(
+            y, z=residual, use_running_average=not train
+        )
+
+
+class ResNet(nn.Module):
+    """Generic ResNet; ``stage_sizes`` and ``block_cls`` select the variant.
+
+    ``axis_name="dp"`` enables cross-replica SyncBatchNorm (the
+    ``--sync_bn`` flag of ``examples/imagenet/main_amp.py:42,131``).
+    """
+
+    stage_sizes: Sequence[int]
+    block_cls: Any
+    num_classes: int = 1000
+    num_filters: int = 64
+    axis_name: Optional[str] = None
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        x = conv(self.num_filters, (7, 7), (2, 2), name="conv_init")(x)
+        x = SyncBatchNorm(
+            self.num_filters, axis_name=self.axis_name, fuse_relu=True,
+            name="bn_init",
+        )(x, use_running_average=not train)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for i, block_count in enumerate(self.stage_sizes):
+            for j in range(block_count):
+                strides = 2 if i > 0 and j == 0 else 1
+                x = self.block_cls(
+                    self.num_filters * 2**i,
+                    strides=strides,
+                    axis_name=self.axis_name,
+                    dtype=self.dtype,
+                )(x, train=train)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(
+            jnp.asarray(x, jnp.float32)
+        )
+        return x
+
+
+ResNet18 = partial(ResNet, stage_sizes=(2, 2, 2, 2), block_cls=BasicBlock)
+ResNet50 = partial(ResNet, stage_sizes=(3, 4, 6, 3), block_cls=BottleneckBlock)
+ResNet101 = partial(ResNet, stage_sizes=(3, 4, 23, 3), block_cls=BottleneckBlock)

@@ -28,3 +28,15 @@ from apex_tpu.parallel.mesh import (  # noqa: F401
     CONTEXT_AXIS,
 )
 from apex_tpu.parallel import collectives  # noqa: F401
+from apex_tpu.parallel.distributed import (  # noqa: F401
+    DistributedDataParallel,
+    all_reduce_gradients,
+    data_parallel_train_step,
+    dp_shard_batch,
+    replicate,
+)
+from apex_tpu.parallel.sync_batchnorm import (  # noqa: F401
+    SyncBatchNorm,
+    sync_batch_norm_stats,
+)
+from apex_tpu.optimizers.larc import LARC  # noqa: F401

@@ -1,0 +1,186 @@
+"""Data-parallel training — the ``apex.parallel.DistributedDataParallel`` analog.
+
+Behavioral spec: ``apex/parallel/distributed.py:131`` — apex DDP hooks every
+parameter's grad-accumulation, discovers flat buckets on the first iteration
+(``:287``), and kicks off NCCL all-reduces on a side stream as buckets fill
+during backward (``comm_ready_buckets:517``, ``allreduce_bucket:429``),
+optionally pre-dividing by world size (``gradient_predivide_factor``).
+
+Under XLA SPMD the *entire mechanism dissolves*: declare the batch sharded on
+the ``dp`` mesh axis and parameters replicated, and the partitioner emits one
+fused gradient all-reduce schedule, overlapped with the backward
+automatically.  What remains worth shipping:
+
+- :func:`data_parallel_train_step` — the recommended pjit path: a factory
+  that shards the batch, replicates params, and returns a jitted step whose
+  gradient reduction is implicit;
+- :class:`DistributedDataParallel` — an explicit shard_map-style wrapper with
+  the reference's knobs (``gradient_average``,
+  ``gradient_predivide_factor``, ``allreduce_always_fp32`` — cf. apex DDP
+  ctor ``distributed.py:131-198``) for users porting code that calls
+  all-reduce by hand;
+- :func:`all_reduce_gradients` — the bare collective, for custom loops.
+
+The ``delay_allreduce`` / bucket-structure machinery has no analog: XLA
+already schedules reductions optimally, so those knobs are intentionally
+absent (SURVEY.md §7: rebuild capabilities, not mechanisms).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from apex_tpu.parallel import collectives as cc
+from apex_tpu.parallel import mesh as mesh_lib
+
+__all__ = [
+    "all_reduce_gradients",
+    "DistributedDataParallel",
+    "data_parallel_train_step",
+    "dp_shard_batch",
+    "replicate",
+]
+
+
+def all_reduce_gradients(
+    grads,
+    axis: str = mesh_lib.DATA_AXIS,
+    *,
+    gradient_average: bool = True,
+    gradient_predivide_factor: float = 1.0,
+    allreduce_always_fp32: bool = False,
+):
+    """All-reduce a gradient pytree over a mesh axis (inside shard_map).
+
+    Mirrors ``allreduce_bucket`` (``apex/parallel/distributed.py:429-477``):
+    optional fp32 upcast for the reduction, predivide before / postdivide
+    after (``:434-450``), mean vs sum.
+    """
+    world = cc.axis_size(axis)
+
+    def leaf(g):
+        orig_dtype = g.dtype
+        if allreduce_always_fp32:
+            g = jnp.asarray(g, jnp.float32)
+        if gradient_predivide_factor != 1.0:
+            g = g / gradient_predivide_factor
+        g = cc.all_reduce(g, axis, op="sum")
+        if gradient_average:
+            g = g / (world / gradient_predivide_factor)
+        # gradient_average=False leaves the result at sum/predivide_factor,
+        # exactly like allreduce_bucket (distributed.py:455-456 never
+        # multiplies the predivide back)
+        if allreduce_always_fp32:
+            g = jnp.asarray(g, orig_dtype)
+        return g
+
+    return jax.tree_util.tree_map(leaf, grads)
+
+
+def dp_shard_batch(batch, mesh=None):
+    """Place a host batch sharded along the dp axis (leading dim)."""
+    if mesh is None:
+        mesh = mesh_lib.get_mesh()
+
+    def leaf(x):
+        if jnp.ndim(x) == 0:  # scalars (e.g. a mixup lambda) replicate
+            spec = P()
+        else:
+            spec = P(mesh_lib.DATA_AXIS, *([None] * (jnp.ndim(x) - 1)))
+        return jax.device_put(x, NamedSharding(mesh, spec))
+
+    return jax.tree_util.tree_map(leaf, batch)
+
+
+def replicate(tree, mesh=None):
+    """Replicate params/optimizer state across the whole mesh."""
+    if mesh is None:
+        mesh = mesh_lib.get_mesh()
+    sharding = NamedSharding(mesh, P())
+    return jax.tree_util.tree_map(lambda x: jax.device_put(x, sharding), tree)
+
+
+@dataclasses.dataclass
+class DistributedDataParallel:
+    """Explicit DDP wrapper (shard_map style) with the apex constructor knobs.
+
+    ``grad_fn(params, batch) -> (loss, grads)`` computed per-shard; the
+    wrapper all-reduces grads (and averages the loss) over ``dp``::
+
+        ddp = DistributedDataParallel(grad_fn)
+        step = ddp.build(mesh)        # jitted global-array function
+        loss, grads = step(params, sharded_batch)
+
+    cf. apex ctor options ``apex/parallel/distributed.py:131-198``.
+    """
+
+    grad_fn: Callable  # (params, batch) -> (loss, grads)
+    gradient_average: bool = True
+    gradient_predivide_factor: float = 1.0
+    allreduce_always_fp32: bool = False
+    axis: str = mesh_lib.DATA_AXIS
+
+    def build(self, mesh=None):
+        if mesh is None:
+            mesh = mesh_lib.get_mesh()
+        ndim_axis = self.axis
+
+        def per_shard(params, batch):
+            loss, grads = self.grad_fn(params, batch)
+            grads = all_reduce_gradients(
+                grads,
+                ndim_axis,
+                gradient_average=self.gradient_average,
+                gradient_predivide_factor=self.gradient_predivide_factor,
+                allreduce_always_fp32=self.allreduce_always_fp32,
+            )
+            loss = cc.all_reduce(loss, ndim_axis, op="mean")
+            return loss, grads
+
+        def batch_spec(x):
+            return P(ndim_axis, *([None] * (x.ndim - 1)))
+
+        def wrapped(params, batch):
+            in_specs = (
+                jax.tree_util.tree_map(lambda _: P(), params),
+                jax.tree_util.tree_map(batch_spec, batch),
+            )
+            out_specs = (P(), jax.tree_util.tree_map(lambda _: P(), params))
+            return cc.shard_over(
+                per_shard, mesh=mesh, in_specs=in_specs, out_specs=out_specs
+            )(params, batch)
+
+        return jax.jit(wrapped)
+
+
+def data_parallel_train_step(
+    loss_fn: Callable,
+    optimizer,
+    *,
+    mesh=None,
+    donate: bool = True,
+):
+    """The pjit path: build a jitted DP train step with implicit reduction.
+
+    ``loss_fn(params, batch) -> scalar loss`` written over the *global*
+    batch; batch enters sharded on ``dp`` (use :func:`dp_shard_batch`),
+    params replicated.  Because the loss is a mean over the global batch,
+    XLA inserts the gradient psum itself — this is the whole DDP feature set
+    expressed as shardings.  Returns ``step(params, opt_state, batch) ->
+    (params, opt_state, loss)``.
+    """
+    if mesh is None:
+        mesh = mesh_lib.get_mesh()
+
+    def step(params, opt_state, batch, lr=None):
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        params, opt_state = optimizer.step(grads, opt_state, params, lr=lr)
+        return params, opt_state, loss
+
+    donate_argnums = (0, 1) if donate else ()
+    return jax.jit(step, donate_argnums=donate_argnums)

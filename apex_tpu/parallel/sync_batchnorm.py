@@ -1,0 +1,159 @@
+"""SyncBatchNorm — cross-replica batch normalization via mesh collectives.
+
+Behavioral spec: ``apex/parallel/optimized_sync_batchnorm.py:9-85`` +
+``optimized_sync_batchnorm_kernel.py:10-119`` over ``csrc/welford.cu``:
+
+- local Welford mean/biased-var (+count), all-gather, ``welford_parallel``
+  merge (``welford.cu:569``) → global mean, **biased** inv_std for
+  normalization, **unbiased** var for running stats
+  (``var = var_biased * count/(count-1)``, ``kernel.py:45-48``);
+- running stats: ``running = running*(1-momentum) + momentum*current``
+  (``kernel.py:53-57``) — note apex's ``momentum`` weights the *new* value;
+- optional fused residual-add + ReLU epilogue (``fuse_relu`` + ``z`` input,
+  ``batchnorm_forward_c_last`` ``welford.cu:652``) — the ``groupbn``
+  BN-Add-ReLU capability;
+- process sub-groups (``group_size``, ``apex/parallel/__init__.py:60-97``)
+  map to ``axis_index_groups`` of the collective;
+- backward all-reduces ``sum_dy``/``sum_dy_xmu`` (``kernel.py:95-113``) —
+  here that falls out of autodiff through the psum'd statistics.
+
+The merge math: with equal-count shards (always true for an evenly-sharded
+global array), psum of (Σx, Σx², n) reproduces the count-weighted Welford
+combine exactly.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+try:
+    import flax.linen as nn
+except Exception:  # pragma: no cover
+    nn = None
+
+__all__ = ["SyncBatchNorm", "sync_batch_norm_stats"]
+
+
+def sync_batch_norm_stats(
+    x,
+    reduce_axes: Tuple[int, ...],
+    axis_name: Optional[Union[str, Sequence[str]]] = None,
+    axis_index_groups=None,
+):
+    """Global (mean, biased_var, count) over batch+spatial dims and, when
+    ``axis_name`` is bound, across replicas — the ``welford_mean_var`` +
+    all-gather + ``welford_parallel`` pipeline as one fused reduction."""
+    x32 = jnp.asarray(x, jnp.float32)
+    local_count = 1
+    for a in reduce_axes:
+        local_count *= x.shape[a]
+    s = jnp.sum(x32, axis=reduce_axes)
+    sq = jnp.sum(jnp.square(x32), axis=reduce_axes)
+    count = jnp.float32(local_count)
+    if axis_name is not None:
+        s = lax.psum(s, axis_name, axis_index_groups=axis_index_groups)
+        sq = lax.psum(sq, axis_name, axis_index_groups=axis_index_groups)
+        count = lax.psum(count, axis_name, axis_index_groups=axis_index_groups)
+    mean = s / count
+    var_biased = sq / count - jnp.square(mean)
+    return mean, var_biased, count
+
+
+if nn is not None:
+
+    class SyncBatchNorm(nn.Module):
+        """Flax module with the apex ``SyncBatchNorm`` surface
+        (``apex/parallel/optimized_sync_batchnorm.py:9``).
+
+        ``axis_name``: mesh axis (or tuple) to synchronize over — the process
+        group — **for shard_map-style training loops**, where the module sees
+        a per-replica shard.  Under pjit with a dp-sharded global batch leave
+        it ``None``: the statistics are computed over the *global* array and
+        the partitioner inserts the cross-replica reduction itself, i.e.
+        pjit-BN is always SyncBN (the apex BN-vs-SyncBN distinction only
+        exists in the per-shard world).  NHWC layout (the reference's
+        optimized ``syncbn.welford_mean_var_c_last`` path).
+
+        Call with ``use_running_average=False`` and
+        ``mutable=["batch_stats"]`` during training.
+        ``z``: optional residual added before the (optional) fused ReLU.
+        """
+
+        num_features: int
+        eps: float = 1e-5
+        momentum: float = 0.1
+        affine: bool = True
+        track_running_stats: bool = True
+        axis_name: Optional[Union[str, Tuple[str, ...]]] = None
+        axis_index_groups: Any = None
+        fuse_relu: bool = False
+        param_dtype: jnp.dtype = jnp.float32
+
+        @nn.compact
+        def __call__(self, x, z=None, use_running_average: bool = False):
+            C = self.num_features
+            assert x.shape[-1] == C, (
+                f"SyncBatchNorm is channel-last (NHWC); got trailing dim "
+                f"{x.shape[-1]} != num_features {C}"
+            )
+            reduce_axes = tuple(range(x.ndim - 1))
+
+            running_mean = self.variable(
+                "batch_stats", "running_mean",
+                lambda: jnp.zeros((C,), jnp.float32),
+            )
+            running_var = self.variable(
+                "batch_stats", "running_var",
+                lambda: jnp.ones((C,), jnp.float32),
+            )
+
+            if use_running_average and self.track_running_stats:
+                mean = running_mean.value
+                var_biased = running_var.value
+            else:
+                # track_running_stats=False always normalizes with batch
+                # statistics (torch _BatchNorm semantics); during module init
+                # the mesh axis is not bound (init runs outside
+                # shard_map/pjit) so compute local stats only, like
+                # flax.linen.BatchNorm
+                axis = None if self.is_initializing() else self.axis_name
+                mean, var_biased, count = sync_batch_norm_stats(
+                    x, reduce_axes, axis, self.axis_index_groups
+                )
+                if self.track_running_stats and not self.is_initializing():
+                    # unbiased var for running stats (kernel.py:45-48),
+                    # biased inv_std for normalization
+                    var_unbiased = (
+                        var_biased * count / jnp.maximum(count - 1.0, 1.0)
+                    )
+                    running_mean.value = (
+                        running_mean.value * (1.0 - self.momentum)
+                        + self.momentum * mean
+                    )
+                    running_var.value = (
+                        running_var.value * (1.0 - self.momentum)
+                        + self.momentum * var_unbiased
+                    )
+
+            inv_std = lax.rsqrt(var_biased + self.eps)
+            y = (jnp.asarray(x, jnp.float32) - mean) * inv_std
+            if self.affine:
+                weight = self.param(
+                    "scale", nn.initializers.ones, (C,), self.param_dtype
+                )
+                bias = self.param(
+                    "bias", nn.initializers.zeros, (C,), self.param_dtype
+                )
+                y = y * weight + bias
+            if z is not None:
+                y = y + jnp.asarray(z, jnp.float32)
+            if self.fuse_relu:
+                y = jax.nn.relu(y)
+            return jnp.asarray(y, x.dtype)
+
+else:  # pragma: no cover
+    SyncBatchNorm = None

@@ -1,0 +1,57 @@
+"""Toy distributed mixed-precision training — the analog of
+``examples/simple/distributed/distributed_data_parallel.py``.
+
+The reference wraps a 2-layer model in apex DDP + amp O1 and runs
+``python -m torch.distributed.launch``.  Here the same workload is one SPMD
+program over the device mesh: no launcher, no process groups.
+
+Run (CPU demo):
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python examples/simple_distributed.py
+Run (TPU): python examples/simple_distributed.py
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu import amp, parallel
+from apex_tpu.optimizers import FusedSGD
+from apex_tpu.parallel import data_parallel_train_step, dp_shard_batch, replicate
+
+
+def main(steps: int = 40):
+    mesh = parallel.initialize_model_parallel()  # all devices on dp
+    print(parallel.mesh.get_rank_info())
+
+    D, H = 64, 128
+    rng = np.random.RandomState(0)
+    params = {
+        "w1": jnp.asarray(rng.randn(D, H).astype(np.float32) / np.sqrt(D)),
+        "w2": jnp.asarray(rng.randn(H, D).astype(np.float32) / np.sqrt(H)),
+    }
+    policy = amp.policy("O1")  # bf16 compute, fp32 params
+
+    def loss_fn(p, batch):
+        x, y = batch
+        h = jax.nn.relu(x.astype(policy.compute_dtype) @ p["w1"].astype(policy.compute_dtype))
+        out = (h @ p["w2"].astype(policy.compute_dtype)).astype(jnp.float32)
+        return jnp.mean((out - y) ** 2)
+
+    opt = FusedSGD(lr=0.3, momentum=0.9)
+    params = replicate(params, mesh)
+    opt_state = replicate(opt.init(params), mesh)
+    step = data_parallel_train_step(loss_fn, opt, mesh=mesh)
+
+    for i in range(steps):
+        x = rng.randn(64, D).astype(np.float32)
+        y = x  # identity target
+        batch = dp_shard_batch((jnp.asarray(x), jnp.asarray(y)), mesh)
+        params, opt_state, loss = step(params, opt_state, batch)
+        if i % 5 == 0 or i == steps - 1:
+            print(f"step {i:3d} loss {float(loss):.5f}")
+    return float(loss)
+
+
+if __name__ == "__main__":
+    main()

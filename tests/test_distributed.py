@@ -1,0 +1,261 @@
+"""DDP + SyncBatchNorm tests on the virtual 8-device mesh.
+
+Mirrors ``tests/distributed/synced_batchnorm`` (SyncBN numerics vs plain BN
+over the full batch; subgroups) and the DDP grad-average semantics of
+``apex/parallel/distributed.py``."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu import parallel
+from apex_tpu.optimizers import FusedSGD
+from apex_tpu.parallel import (
+    DistributedDataParallel,
+    SyncBatchNorm,
+    all_reduce_gradients,
+    data_parallel_train_step,
+    dp_shard_batch,
+    replicate,
+)
+from apex_tpu.parallel import collectives as cc
+
+
+class TestDDP:
+    def test_explicit_ddp_matches_single_device(self):
+        """Grads from the 8-shard DDP wrapper == grads on the full batch."""
+        mesh = parallel.initialize_model_parallel()
+        rng = np.random.RandomState(0)
+        params = {"w": jnp.asarray(rng.randn(6, 3).astype(np.float32))}
+        X = jnp.asarray(rng.randn(32, 6).astype(np.float32))
+        Y = jnp.asarray(rng.randn(32, 3).astype(np.float32))
+
+        def grad_fn(p, batch):
+            x, y = batch
+            loss = jnp.mean((x @ p["w"] - y) ** 2)
+            return loss, jax.grad(lambda q: jnp.mean((x @ q["w"] - y) ** 2))(p)
+
+        ddp = DistributedDataParallel(grad_fn)
+        step = ddp.build(mesh)
+        loss, grads = step(params, (X, Y))
+
+        ref_loss = jnp.mean((X @ params["w"] - Y) ** 2)
+        ref_grads = jax.grad(lambda q: jnp.mean((X @ q["w"] - Y) ** 2))(params)
+        np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(grads["w"]), np.asarray(ref_grads["w"]), rtol=1e-5, atol=1e-6
+        )
+
+    def test_predivide_factor(self):
+        """predivide/postdivide composition keeps the average invariant
+        (distributed.py:434-450)."""
+        mesh = parallel.initialize_model_parallel()
+        g = {"w": jnp.ones((8, 2))}
+
+        def run(**kw):
+            f = cc.shard_over(
+                lambda g: all_reduce_gradients(g, "dp", **kw),
+                in_specs=(jax.tree_util.tree_map(lambda _: P("dp", None), g),),
+                out_specs=jax.tree_util.tree_map(lambda _: P("dp", None), g),
+            )
+            return np.asarray(f(g)["w"])
+
+        np.testing.assert_allclose(run(), 1.0)
+        np.testing.assert_allclose(run(gradient_predivide_factor=4.0), 1.0)
+        np.testing.assert_allclose(run(gradient_average=False), 8.0)
+        # average=False + predivide: stays at sum/predivide (apex
+        # distributed.py:455-456 never multiplies the predivide back)
+        np.testing.assert_allclose(
+            run(gradient_average=False, gradient_predivide_factor=4.0), 2.0)
+
+    def test_fp32_allreduce_of_bf16(self):
+        mesh = parallel.initialize_model_parallel()
+        g = {"w": jnp.full((8, 2), 0.1, jnp.bfloat16)}
+        f = cc.shard_over(
+            lambda g: all_reduce_gradients(g, "dp", allreduce_always_fp32=True),
+            in_specs=(jax.tree_util.tree_map(lambda _: P("dp", None), g),),
+            out_specs=jax.tree_util.tree_map(lambda _: P("dp", None), g),
+        )
+        out = f(g)
+        assert out["w"].dtype == jnp.bfloat16
+
+    def test_pjit_train_step_converges_and_matches(self):
+        """The pjit DP path trains identically to a single-device loop."""
+        mesh = parallel.initialize_model_parallel()
+        rng = np.random.RandomState(1)
+        w0 = rng.randn(4, 1).astype(np.float32)
+        X = rng.randn(64, 4).astype(np.float32)
+        Y = (X @ rng.randn(4, 1)).astype(np.float32)
+
+        def loss_fn(p, batch):
+            x, y = batch
+            return jnp.mean((x @ p["w"] - y) ** 2)
+
+        opt = FusedSGD(lr=0.1, momentum=0.9)
+
+        # distributed run
+        params = replicate({"w": jnp.asarray(w0)}, mesh)
+        state = replicate(opt.init(params), mesh)
+        step = data_parallel_train_step(loss_fn, opt, mesh=mesh, donate=False)
+        batch = dp_shard_batch((jnp.asarray(X), jnp.asarray(Y)), mesh)
+        for _ in range(10):
+            params, state, loss = step(params, state, batch)
+
+        # single-device reference
+        p2 = {"w": jnp.asarray(w0)}
+        s2 = opt.init(p2)
+        for _ in range(10):
+            g = jax.grad(loss_fn)(p2, (jnp.asarray(X), jnp.asarray(Y)))
+            p2, s2 = opt.step(g, s2, p2)
+
+        np.testing.assert_allclose(
+            np.asarray(params["w"]), np.asarray(p2["w"]), rtol=1e-4, atol=1e-5
+        )
+
+
+class TestSyncBatchNorm:
+    def _data(self, seed=0, n=32, c=5):
+        return np.random.RandomState(seed).randn(n, c).astype(np.float32) * 2 + 1
+
+    def test_matches_torch_bn_single(self):
+        x = self._data()
+        bn = SyncBatchNorm(num_features=5, momentum=0.1)
+        vars_ = bn.init(jax.random.PRNGKey(0), jnp.asarray(x))
+        y, mut = bn.apply(vars_, jnp.asarray(x), mutable=["batch_stats"])
+
+        tbn = torch.nn.BatchNorm1d(5, momentum=0.1)
+        ty = tbn(torch.tensor(x))
+        np.testing.assert_allclose(np.asarray(y), ty.detach().numpy(),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(mut["batch_stats"]["running_mean"]),
+            tbn.running_mean.numpy(), rtol=1e-4, atol=1e-5,
+        )
+        np.testing.assert_allclose(
+            np.asarray(mut["batch_stats"]["running_var"]),
+            tbn.running_var.numpy(), rtol=1e-4, atol=1e-5,
+        )
+
+    def test_sync_across_replicas_matches_full_batch(self):
+        """Sharded SyncBN == BN over the full batch (the two_gpu_unit_test
+        invariant, tests/distributed/synced_batchnorm)."""
+        mesh = parallel.initialize_model_parallel()
+        x = self._data(2, 64, 5)
+        bn = SyncBatchNorm(num_features=5, axis_name="dp")
+        vars_ = bn.init(jax.random.PRNGKey(0), jnp.asarray(x[:8]))
+
+        def per_shard(x):
+            y, mut = bn.apply(vars_, x, mutable=["batch_stats"])
+            return y, mut["batch_stats"]["running_var"]
+
+        f = cc.shard_over(
+            per_shard,
+            mesh=mesh,
+            in_specs=P("dp", None),
+            out_specs=(P("dp", None), P()),
+        )
+        y_dist, rv_dist = f(jnp.asarray(x))
+
+        bn_ref = SyncBatchNorm(num_features=5)
+        y_ref, mut_ref = bn_ref.apply(vars_, jnp.asarray(x), mutable=["batch_stats"])
+        np.testing.assert_allclose(np.asarray(y_dist), np.asarray(y_ref),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(rv_dist),
+            np.asarray(mut_ref["batch_stats"]["running_var"]),
+            rtol=1e-4, atol=1e-5,
+        )
+
+    def test_subgroups(self):
+        """group_size semantics (apex/parallel/__init__.py:60-97): stats
+        synced only within axis_index_groups."""
+        mesh = parallel.initialize_model_parallel()
+        x = self._data(3, 64, 4)
+        groups = [[0, 1, 2, 3], [4, 5, 6, 7]]
+        bn = SyncBatchNorm(num_features=4, axis_name="dp",
+                           axis_index_groups=groups)
+        vars_ = bn.init(jax.random.PRNGKey(0), jnp.asarray(x[:8]))
+
+        f = cc.shard_over(
+            lambda x: bn.apply(vars_, x, mutable=["batch_stats"])[0],
+            in_specs=P("dp", None),
+            out_specs=P("dp", None),
+        )
+        y = np.asarray(f(jnp.asarray(x)))
+        # first half uses stats of x[:32], second of x[32:]
+        for half, sl in ((0, slice(0, 32)), (1, slice(32, 64))):
+            ref, _ = SyncBatchNorm(num_features=4).apply(
+                vars_, jnp.asarray(x[sl]), mutable=["batch_stats"]
+            )
+            np.testing.assert_allclose(y[sl], np.asarray(ref), rtol=1e-4, atol=1e-5)
+
+    def test_track_running_stats_false_uses_batch_stats(self):
+        """torch _BatchNorm semantics: track_running_stats=False always
+        normalizes with batch statistics."""
+        x = self._data(11, 64, 3)
+        bn = SyncBatchNorm(num_features=3, affine=False,
+                           track_running_stats=False)
+        vars_ = bn.init(jax.random.PRNGKey(0), jnp.asarray(x))
+        y = np.asarray(bn.apply(vars_, jnp.asarray(x)))
+        np.testing.assert_allclose(y.mean(0), 0.0, atol=1e-4)
+        np.testing.assert_allclose(y.std(0), 1.0, atol=1e-2)
+
+    def test_dp_shard_batch_scalar_leaf(self):
+        from apex_tpu.parallel import dp_shard_batch
+        parallel.initialize_model_parallel()
+        batch = (jnp.ones((16, 4)), jnp.float32(0.5))
+        out = dp_shard_batch(batch)
+        assert out[1].shape == ()
+
+    def test_fused_add_relu(self):
+        x = self._data(4, 16, 3)
+        z = self._data(5, 16, 3)
+        bn = SyncBatchNorm(num_features=3, fuse_relu=True)
+        vars_ = bn.init(jax.random.PRNGKey(0), jnp.asarray(x))
+        y = bn.apply(vars_, jnp.asarray(x), jnp.asarray(z),
+                     mutable=["batch_stats"])[0]
+        assert np.all(np.asarray(y) >= 0)
+
+    def test_eval_uses_running_stats(self):
+        x = self._data(6)
+        bn = SyncBatchNorm(num_features=5)
+        vars_ = bn.init(jax.random.PRNGKey(0), jnp.asarray(x))
+        _, mut = bn.apply(vars_, jnp.asarray(x), mutable=["batch_stats"])
+        vars2 = {"params": vars_["params"], "batch_stats": mut["batch_stats"]}
+        y_eval = bn.apply(vars2, jnp.asarray(x), use_running_average=True)
+        assert not np.allclose(
+            np.asarray(y_eval),
+            np.asarray(bn.apply(vars_, jnp.asarray(x), mutable=["batch_stats"])[0]),
+        )
+
+    def test_grad_flows_through_sync(self):
+        mesh = parallel.initialize_model_parallel()
+        x = self._data(7, 32, 4)
+        bn = SyncBatchNorm(num_features=4, axis_name="dp")
+        vars_ = bn.init(jax.random.PRNGKey(0), jnp.asarray(x[:4]))
+
+        def per_shard(params, x):
+            def loss(p):
+                y, _ = bn.apply(
+                    {"params": p, "batch_stats": vars_["batch_stats"]},
+                    x, mutable=["batch_stats"],
+                )
+                return jnp.sum(y**2)
+
+            l, g = jax.value_and_grad(loss)(params)
+            return cc.all_reduce(l, "dp"), jax.tree_util.tree_map(
+                lambda t: cc.all_reduce(t, "dp"), g
+            )
+
+        f = cc.shard_over(
+            per_shard,
+            in_specs=(jax.tree_util.tree_map(lambda _: P(), vars_["params"]),
+                      P("dp", None)),
+            out_specs=(P(), jax.tree_util.tree_map(lambda _: P(), vars_["params"])),
+        )
+        loss, grads = f(vars_["params"], jnp.asarray(x))
+        assert np.isfinite(float(loss))
+        assert np.all(np.isfinite(np.asarray(grads["scale"])))
