@@ -1,0 +1,106 @@
+"""Headline benchmark: ResNet-50 mixed-precision (O2) training throughput.
+
+Runs the reference's headline config (``examples/imagenet/main_amp.py``:
+ResNet-50, amp O2, FusedSGD) as apex_tpu's SPMD train step on whatever
+devices are attached and prints ONE JSON line:
+
+    {"metric": ..., "value": N, "unit": "images/sec/chip", "vs_baseline": N}
+
+``vs_baseline`` normalizes against an adopted per-A100 figure for Apex RN50
+AMP training (the repo itself publishes no numbers — BASELINE.md): NVIDIA NGC
+PyTorch+Apex RN50 AMP convergence runs report ~2.5k images/sec per A100-80GB
+at batch 256 with DALI input.  We record throughput per chip so the number is
+comparable across mesh sizes.
+"""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+APEX_A100_IMAGES_PER_SEC = 2500.0  # adopted baseline, see module docstring
+
+
+def main():
+    from apex_tpu import amp
+    from apex_tpu.models import ResNet50
+    from apex_tpu.optimizers import FusedSGD
+    from apex_tpu.parallel import dp_shard_batch, mesh as mesh_lib, replicate
+
+    n_chips = len(jax.devices())
+    on_tpu = jax.devices()[0].platform == "tpu"
+    batch_per_chip = 128 if on_tpu else 4
+    image_size = 224 if on_tpu else 32
+    steps = 30 if on_tpu else 3
+    batch = batch_per_chip * n_chips
+
+    mesh = mesh_lib.initialize_model_parallel()
+    policy = amp.policy("O2")
+    model = ResNet50(num_classes=1000, axis_name=None,
+                     dtype=policy.compute_dtype)
+
+    x0 = jnp.zeros((2, image_size, image_size, 3), jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0), x0, train=True)
+    params = policy.cast_to_param(variables["params"])
+    batch_stats = variables["batch_stats"]
+    opt = FusedSGD(lr=0.1, momentum=0.9, weight_decay=1e-4,
+                   master_weights=policy.master_weights)
+    opt_state = opt.init(params)
+
+    def loss_fn(params, batch_stats, batch):
+        x, y = batch
+        logits, mutated = model.apply(
+            {"params": params, "batch_stats": batch_stats},
+            policy.cast_to_compute(x),
+            train=True,
+            mutable=["batch_stats"],
+        )
+        logp = jax.nn.log_softmax(logits)
+        loss = -jnp.mean(logp[jnp.arange(y.shape[0]), y])
+        return loss, mutated["batch_stats"]
+
+    @jax.jit
+    def train_step(params, batch_stats, opt_state, batch):
+        (loss, new_stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            params, batch_stats, batch
+        )
+        params, opt_state = opt.step(grads, opt_state, params)
+        return params, new_stats, opt_state, loss
+
+    params = replicate(params, mesh)
+    batch_stats = replicate(batch_stats, mesh)
+    opt_state = replicate(opt_state, mesh)
+
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(batch, image_size, image_size, 3),
+                    jnp.float32)
+    y = jnp.asarray(rng.randint(0, 1000, size=(batch,)))
+    sharded = dp_shard_batch((x, y), mesh)
+
+    # warmup / compile
+    params, batch_stats, opt_state, loss = train_step(
+        params, batch_stats, opt_state, sharded
+    )
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, batch_stats, opt_state, loss = train_step(
+            params, batch_stats, opt_state, sharded
+        )
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    ips_per_chip = batch * steps / dt / n_chips
+    print(json.dumps({
+        "metric": "resnet50_o2_train_throughput",
+        "value": round(ips_per_chip, 1),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(ips_per_chip / APEX_A100_IMAGES_PER_SEC, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()
