@@ -58,7 +58,15 @@ def f32(x):
 
 
 def tree_f32(tree):
-    return jax.tree_util.tree_map(f32, tree)
+    """fp32 master copy of ``params``.
+
+    Always copies — even fp32 leaves — so the master state never aliases the
+    model params' buffers (aliasing breaks ``donate_argnums`` train steps
+    with "attempt to donate the same buffer twice").
+    """
+    return jax.tree_util.tree_map(
+        lambda x: jnp.array(x, dtype=jnp.float32, copy=True), tree
+    )
 
 
 def tree_zeros_f32(params):

@@ -1,0 +1,23 @@
+"""apex_tpu.transformer — the Megatron-style model-parallel runtime.
+
+TPU-native rebuild of ``apex/transformer`` (reference layout:
+``apex/transformer/__init__.py``): tensor/sequence parallelism
+(:mod:`~apex_tpu.transformer.tensor_parallel`), pipeline schedules
+(:mod:`~apex_tpu.transformer.pipeline_parallel`), the model-parallel-aware
+grad scaler (:mod:`~apex_tpu.transformer.amp`), and fused functional ops
+(:mod:`~apex_tpu.transformer.functional`).
+
+Where the reference manages NCCL process groups through
+``parallel_state`` (``apex/transformer/parallel_state.py:155``), this runtime
+runs SPMD over a named :class:`jax.sharding.Mesh` — ``parallel_state`` here
+re-exports the mesh builder from :mod:`apex_tpu.parallel.mesh` so migrated
+code keeps its import path.
+"""
+
+from apex_tpu.parallel import mesh as parallel_state
+from apex_tpu.transformer import tensor_parallel
+
+__all__ = [
+    "parallel_state",
+    "tensor_parallel",
+]

@@ -1,0 +1,100 @@
+"""Vocab-parallel softmax cross-entropy.
+
+Behavioral spec: ``apex/transformer/tensor_parallel/cross_entropy.py`` —
+``_VocabParallelCrossEntropy:23-131`` / ``vocab_parallel_cross_entropy:132``.
+The logits stay sharded along the vocabulary dim; the softmax statistics are
+assembled with three collectives, never materializing the full-vocab tensor:
+
+1. all-reduce(MAX) of the per-row max logit (``:37-41``),
+2. all-reduce(SUM) of the target logit, looked up only on the rank owning the
+   target id (``:43-63``),
+3. all-reduce(SUM) of the local ``sum(exp)`` (``:65-70``).
+
+The reference hand-writes the backward (``softmax - onehot`` from saved
+``exp_logits``, ``:75-80,96-130``) because torch autograd cannot
+differentiate through NCCL.  Here the collectives are ``lax`` primitives
+with replication-aware transposes, so plain JAX AD *derives* that same
+backward — each rank's logit-shard gradient is its local
+``softmax - onehot`` piece (verified against the unsharded reference in
+``tests/test_tensor_parallel.py``).  The max-shift is wrapped in
+``stop_gradient`` (gradient-invariant, and it keeps the nondifferentiable
+``pmax`` out of the cotangent path).
+
+Label smoothing (``:82-93``): here the smooth term uses the **global** mean
+log-prob (``psum`` of the local sums over the full vocabulary) where the
+reference averages over the local partition only — a small upstream bug we do
+not reproduce; with tp=1 the two agree.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from apex_tpu.parallel.mesh import TENSOR_AXIS
+from apex_tpu.transformer.tensor_parallel.utils import VocabUtility
+
+__all__ = ["vocab_parallel_cross_entropy"]
+
+
+def _psum(x, axis):
+    return x if axis is None else lax.psum(x, axis)
+
+
+def _pmax(x, axis):
+    return x if axis is None else lax.pmax(x, axis)
+
+
+def vocab_parallel_cross_entropy(
+    logits,
+    target,
+    axis: Optional[str] = TENSOR_AXIS,
+    label_smoothing: float = 0.0,
+):
+    """Per-token CE loss from vocab-sharded ``logits`` ``[..., V/tp]``.
+
+    ``target`` holds global token ids; returns loss with ``logits.shape[:-1]``
+    in fp32 (the reference computes the softmax statistics in the input dtype
+    but its fused-kernel sibling ``apex/contrib/xentropy`` accumulates fp32 —
+    we always accumulate fp32).  Pass ``axis=None`` for the unsharded case.
+    """
+    logits = jnp.asarray(logits, jnp.float32)
+    vocab_local = logits.shape[-1]
+    world = 1 if axis is None else lax.axis_size(axis)
+    vocab_global = vocab_local * world
+
+    # (1) numerically-stable shift by the global max (cross_entropy.py:37-41).
+    logits_max = _pmax(jnp.max(lax.stop_gradient(logits), axis=-1), axis)
+    logits = logits - logits_max[..., None]
+
+    # (2) target logit from the owning rank (cross_entropy.py:43-63).
+    if axis is None:
+        start = 0
+    else:
+        rank = lax.axis_index(axis)
+        start, _ = VocabUtility.vocab_range_from_per_partition_vocab_size(
+            vocab_local, rank
+        )
+    local_target = target - start
+    in_range = (local_target >= 0) & (local_target < vocab_local)
+    safe_target = jnp.where(in_range, local_target, 0)
+    picked = jnp.take_along_axis(logits, safe_target[..., None], axis=-1)
+    picked = jnp.squeeze(picked, -1)
+    predicted_logit = _psum(jnp.where(in_range, picked, 0.0), axis)
+
+    # (3) partition function (cross_entropy.py:65-70).
+    sum_exp = _psum(jnp.sum(jnp.exp(logits), axis=-1), axis)
+    lse = jnp.log(sum_exp)
+    loss = lse - predicted_logit
+
+    if label_smoothing > 0:
+        # smooth term over the *global* vocab: mean_j log p_j
+        # = mean_j (z_j - max) - lse  (see module docstring).
+        s_hat = label_smoothing * vocab_global / (vocab_global - 1)
+        mean_logits = _psum(jnp.sum(logits, axis=-1), axis) / vocab_global
+        mean_log_probs = mean_logits - lse
+        loss = (1.0 - s_hat) * loss - s_hat * mean_log_probs
+    return loss

@@ -1,0 +1,47 @@
+"""Tensor-parallel input-data broadcast.
+
+Behavioral spec: ``apex/transformer/tensor_parallel/data.py`` —
+``broadcast_data:80`` sends a dict of int64 tensors from tp-rank-0 to the
+whole tensor-parallel group (with key/shape bookkeeping ``:34-78`` so
+non-src ranks can allocate receive buffers).
+
+Under SPMD there are no receive buffers to size — every rank already holds
+an array of the right shape — so the shape plumbing disappears and the
+broadcast is a masked psum from rank 0 over the tensor axis.  The semantic
+content (guarantee all TP ranks see bit-identical batches even if their host
+input pipelines drifted) is preserved.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax.numpy as jnp
+
+from apex_tpu.parallel import collectives
+from apex_tpu.parallel.mesh import TENSOR_AXIS
+
+__all__ = ["broadcast_data"]
+
+
+def broadcast_data(
+    keys,
+    data: Dict[str, jnp.ndarray],
+    datatype=jnp.int32,
+    axis: Optional[str] = TENSOR_AXIS,
+) -> Dict[str, jnp.ndarray]:
+    """Broadcast ``data[k] for k in keys`` from tp-rank 0 to all tp ranks.
+
+    The reference flattens all values into one int64 tensor for a single
+    NCCL broadcast (``data.py:97-111``); XLA fuses the per-key broadcasts
+    itself so we keep them separate.  ``datatype`` keeps the reference's
+    signature; values are cast to it (the reference asserts instead,
+    ``:89-94``).
+    """
+    out = {}
+    for k in keys:
+        v = jnp.asarray(data[k], datatype)
+        if axis is not None:
+            v = collectives.broadcast(v, axis, root=0)
+        out[k] = v
+    return out

@@ -1,0 +1,292 @@
+"""Tensor-parallel layers: vocab-parallel embedding, column/row linear.
+
+Behavioral spec: ``apex/transformer/tensor_parallel/layers.py`` —
+``VocabParallelEmbedding:174``, ``ColumnParallelLinear:460``,
+``RowParallelLinear:645``, and the fused autograd function
+``LinearWithGradAccumulationAndAsyncCommunication:279-437`` (sequence-parallel
+all-gather of activations in forward; async all-reduce / reduce-scatter of the
+input gradient overlapped with the weight-gradient GEMM; optional fused
+wgrad accumulation into ``weight.main_grad``).
+
+TPU-first notes:
+
+- The reference's hand-rolled async overlap (dgrad collective started before
+  the wgrad GEMM, ``layers.py:333-437``) is XLA's latency-hiding scheduler's
+  job: both GEMMs and the collective appear in one fused backward computation
+  and XLA overlaps them on the ICI DMA engines.  Nothing to hand-schedule.
+- ``gradient_accumulation_fusion`` (wgrad accumulated straight into a
+  persistent ``main_grad`` buffer) is donation: the optimizer's grad
+  accumulator is a jit-carried buffer XLA updates in place.
+- Weights follow the torch layout of the reference (``weight: [out, in]``,
+  ``y = x @ w.T``) so checkpoints migrate 1:1; the *local* shard shapes match
+  Megatron's partitioning (column: ``[out/tp, in]``, row: ``[out, in/tp]``).
+- Modules run inside ``shard_map`` with the tensor axis bound (see
+  :func:`apex_tpu.parallel.collectives.shard_over`).  Pass ``axis=None`` to
+  get the degenerate single-rank layer.
+
+Sharded-parameter init follows ``_initialize_affine_weight_gpu``
+(``layers.py:137-172``): each rank draws from an independent stream — here
+the flax RNG key folded with the rank (:func:`parallel_init`), the JAX analog
+of the model-parallel RNG-tracker fork (``random.py:204-235``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from apex_tpu.parallel.mesh import TENSOR_AXIS
+from apex_tpu.transformer.tensor_parallel import mappings
+from apex_tpu.transformer.tensor_parallel.utils import VocabUtility, divide
+
+__all__ = [
+    "parallel_init",
+    "linear_with_grad_accumulation",
+    "VocabParallelEmbedding",
+    "ColumnParallelLinear",
+    "RowParallelLinear",
+]
+
+Initializer = Callable[..., jax.Array]
+
+
+def parallel_init(init_fn: Initializer, axis: Optional[str]) -> Initializer:
+    """Wrap ``init_fn`` so each rank on ``axis`` draws independent values.
+
+    The JAX analog of initializing the local shard under the
+    ``model-parallel-rng`` tracker fork (``tensor_parallel/random.py:175``,
+    used by ``_initialize_affine_weight_gpu`` ``layers.py:161``).
+    """
+    if axis is None:
+        return init_fn
+
+    def init(key, *args, **kwargs):
+        key = jax.random.fold_in(key, lax.axis_index(axis))
+        return init_fn(key, *args, **kwargs)
+
+    return init
+
+
+def _axis_size(axis: Optional[str]) -> int:
+    return 1 if axis is None else lax.axis_size(axis)
+
+
+def linear_with_grad_accumulation(
+    x,
+    weight,
+    bias=None,
+    *,
+    sequence_parallel: bool = False,
+    axis: Optional[str] = TENSOR_AXIS,
+):
+    """``y = x @ w.T + b`` with optional SP all-gather of ``x``.
+
+    Functional core of ``LinearWithGradAccumulationAndAsyncCommunication``
+    (``layers.py:279-437``): under ``sequence_parallel`` the activation is
+    all-gathered along the sequence (first) dim in forward and its gradient
+    reduce-scattered in backward — exactly
+    :func:`~apex_tpu.transformer.tensor_parallel.mappings.gather_from_sequence_parallel_region`
+    with ``tensor_parallel_output_grad=True``.
+    """
+    if sequence_parallel:
+        if axis is None:
+            raise ValueError("sequence_parallel requires a tensor axis")
+        x = mappings.gather_from_sequence_parallel_region(
+            x, axis, True
+        )
+    y = jnp.matmul(x, weight.T)
+    if bias is not None:
+        y = y + bias
+    return y
+
+
+class VocabParallelEmbedding(nn.Module):
+    """Embedding sharded along the vocabulary dimension.
+
+    Reference: ``apex/transformer/tensor_parallel/layers.py:174-277`` — each
+    rank owns vocab range ``[rank*V/tp, (rank+1)*V/tp)``, out-of-range token
+    ids are masked to 0, looked up locally, the masked rows zeroed, and the
+    partial embeddings all-reduced.
+    """
+
+    num_embeddings: int
+    embedding_dim: int
+    axis: Optional[str] = TENSOR_AXIS
+    embedding_init: Initializer = nn.initializers.normal(stddev=0.02)
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, token_ids):
+        world = _axis_size(self.axis)
+        vocab_local = divide(self.num_embeddings, world)
+        weight = self.param(
+            "embedding",
+            parallel_init(self.embedding_init, self.axis if world > 1 else None),
+            (vocab_local, self.embedding_dim),
+            self.param_dtype,
+        )
+        weight = jnp.asarray(weight, self.dtype)
+        if world == 1:
+            return jnp.take(weight, token_ids, axis=0)
+
+        rank = lax.axis_index(self.axis)
+        start, _ = VocabUtility.vocab_range_from_per_partition_vocab_size(
+            vocab_local, rank
+        )
+        # Masked local lookup (layers.py:250-262): clamp out-of-range ids to
+        # 0, zero their rows, then psum partials across the vocab shards.
+        local_ids = token_ids - start
+        in_range = (local_ids >= 0) & (local_ids < vocab_local)
+        local_ids = jnp.where(in_range, local_ids, 0)
+        out = jnp.take(weight, local_ids, axis=0)
+        out = jnp.where(in_range[..., None], out, jnp.zeros_like(out))
+        return mappings.reduce_from_tensor_model_parallel_region(out, self.axis)
+
+
+class ColumnParallelLinear(nn.Module):
+    """Linear with the output dimension sharded: ``W = [W_1 .. W_p]`` rows.
+
+    Reference: ``ColumnParallelLinear`` (``layers.py:460-644``).  Forward
+    semantics (``:609-641``):
+
+    - ``sequence_parallel=True``: input is the local sequence shard; it is
+      all-gathered along the sequence dim (and its grad reduce-scattered);
+    - otherwise the input is replicated and passes through
+      ``copy_to_tensor_model_parallel_region`` so its gradient is summed;
+    - output is the local ``out/tp`` shard unless ``gather_output``.
+
+    ``skip_bias_add`` returns the bias separately for downstream fusion
+    (bias+gelu, bias+dropout+add) exactly like the reference (``:630-641``).
+    """
+
+    input_size: int
+    output_size: int
+    use_bias: bool = True
+    gather_output: bool = False
+    sequence_parallel: bool = False
+    skip_bias_add: bool = False
+    axis: Optional[str] = TENSOR_AXIS
+    kernel_init: Initializer = nn.initializers.lecun_normal()
+    bias_init: Initializer = nn.initializers.zeros_init()
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        world = _axis_size(self.axis)
+        out_local = divide(self.output_size, world)
+        shard_axis = self.axis if world > 1 else None
+        weight = self.param(
+            "kernel",
+            parallel_init(self.kernel_init, shard_axis),
+            (out_local, self.input_size),
+            self.param_dtype,
+        )
+        bias = (
+            self.param(
+                "bias",
+                parallel_init(self.bias_init, shard_axis),
+                (out_local,),
+                self.param_dtype,
+            )
+            if self.use_bias
+            else None
+        )
+        weight = jnp.asarray(weight, self.dtype)
+        bias = None if bias is None else jnp.asarray(bias, self.dtype)
+
+        if world > 1 and not self.sequence_parallel:
+            x = mappings.copy_to_tensor_model_parallel_region(x, self.axis)
+        y = linear_with_grad_accumulation(
+            x,
+            weight,
+            bias if not self.skip_bias_add else None,
+            sequence_parallel=self.sequence_parallel and world > 1,
+            axis=shard_axis,
+        )
+        if self.gather_output:
+            if self.sequence_parallel:
+                raise ValueError(
+                    "gather_output is incompatible with sequence_parallel "
+                    "(layers.py:578-582)"
+                )
+            if world > 1:
+                y = mappings.gather_from_tensor_model_parallel_region(
+                    y, self.axis
+                )
+        if self.skip_bias_add:
+            return y, bias
+        return y
+
+
+class RowParallelLinear(nn.Module):
+    """Linear with the input dimension sharded: ``W = [W_1; ..; W_p]`` cols.
+
+    Reference: ``RowParallelLinear`` (``layers.py:645-813``).  Forward
+    (``:777-812``): local GEMM on the input shard, then all-reduce of the
+    partial outputs — or reduce-scatter along the sequence dim under
+    ``sequence_parallel`` — and the (replicated) bias added after the
+    reduction.
+    """
+
+    input_size: int
+    output_size: int
+    use_bias: bool = True
+    input_is_parallel: bool = True
+    sequence_parallel: bool = False
+    skip_bias_add: bool = False
+    axis: Optional[str] = TENSOR_AXIS
+    kernel_init: Initializer = nn.initializers.lecun_normal()
+    bias_init: Initializer = nn.initializers.zeros_init()
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        world = _axis_size(self.axis)
+        in_local = divide(self.input_size, world)
+        shard_axis = self.axis if world > 1 else None
+        weight = self.param(
+            "kernel",
+            parallel_init(self.kernel_init, shard_axis),
+            (self.output_size, in_local),
+            self.param_dtype,
+        )
+        # Bias is replicated and added after the reduction (layers.py:806-812)
+        # — plain init, identical on every rank.
+        bias = (
+            self.param("bias", self.bias_init, (self.output_size,),
+                       self.param_dtype)
+            if self.use_bias
+            else None
+        )
+        weight = jnp.asarray(weight, self.dtype)
+        bias = None if bias is None else jnp.asarray(bias, self.dtype)
+
+        if world > 1 and not self.input_is_parallel:
+            if self.sequence_parallel:
+                raise ValueError(
+                    "sequence_parallel requires input_is_parallel "
+                    "(layers.py:761-764)"
+                )
+            x = mappings.scatter_to_tensor_model_parallel_region(x, self.axis)
+        y = jnp.matmul(x, weight.T)
+        if world > 1:
+            if self.sequence_parallel:
+                y = mappings.reduce_scatter_to_sequence_parallel_region(
+                    y, self.axis
+                )
+            else:
+                y = mappings.reduce_from_tensor_model_parallel_region(
+                    y, self.axis
+                )
+        if self.skip_bias_add:
+            return y, bias
+        if bias is not None:
+            y = y + bias
+        return y

@@ -1,0 +1,139 @@
+"""Model-parallel RNG policy and activation checkpointing.
+
+Behavioral spec: ``apex/transformer/tensor_parallel/random.py`` —
+``CudaRNGStatesTracker:124`` (named RNG states, ``fork():175``),
+``model_parallel_cuda_manual_seed:204`` (tensor-parallel ranks get
+``seed + 2718 + tp_rank`` for sharded params, the same ``seed`` for
+replicated ones), and gradient checkpointing ``CheckpointFunction:237`` /
+``checkpoint:308`` (recompute with the RNG states restored so dropout
+patterns match).
+
+JAX's counter-based PRNG dissolves most of this: there is no mutable device
+RNG state to stash/restore — recompute under ``jax.checkpoint`` replays the
+same fold-in chain, so dropout-in-recompute correctness (the entire reason
+``CheckpointFunction`` saves RNG states, ``random.py:237-306``) holds by
+construction.  What remains is the *seed-offset policy*: sharded params and
+per-rank dropout must draw different streams per tensor-parallel rank,
+replicated params the same stream.  ``model_parallel_rng_key`` implements
+exactly that fold.
+
+``init_checkpointed_activations_memory_buffer`` (``random.py:48``) —
+pre-allocated activation stores with TP-partitioned checkpoints — has no
+analog: ``jax.checkpoint`` policies decide what is saved and XLA allocates.
+``checkpoint`` here forwards to ``jax.checkpoint`` with the reference's
+``distribute_saved_activations`` expressed as a saveable-policy choice.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+from jax import lax
+
+from apex_tpu.parallel.mesh import TENSOR_AXIS
+
+__all__ = [
+    "MODEL_PARALLEL_RNG_OFFSET",
+    "model_parallel_rng_key",
+    "data_parallel_rng_key",
+    "RngStatesTracker",
+    "get_rng_states_tracker",
+    "model_parallel_seed",
+    "checkpoint",
+]
+
+# The reference's fixed offset separating the model-parallel stream from the
+# default stream (``random.py:222``: ``tensor_model_parallel_seed = offset +
+# tensor_model_parallel_rank`` with ``offset = seed + 2718``).
+MODEL_PARALLEL_RNG_OFFSET = 2718
+
+
+def model_parallel_rng_key(key, axis: Optional[str] = TENSOR_AXIS):
+    """Per-tensor-parallel-rank stream: fold tp-rank into ``key``.
+
+    Use for sharded-param init and any dropout applied to tensor-parallel
+    (sharded) activations — the ``model-parallel-rng`` fork
+    (``random.py:230-235``).
+    """
+    if axis is None:
+        return key
+    key = jax.random.fold_in(key, MODEL_PARALLEL_RNG_OFFSET)
+    return jax.random.fold_in(key, lax.axis_index(axis))
+
+
+def data_parallel_rng_key(key, axis: str):
+    """Per-data-parallel-rank stream (distinct dropout per replica batch)."""
+    return jax.random.fold_in(key, lax.axis_index(axis))
+
+
+class RngStatesTracker:
+    """Named RNG streams — API parity with ``CudaRNGStatesTracker``
+    (``random.py:124-202``).
+
+    States are plain keys; ``fork`` returns the named key folded with a
+    per-use counter instead of a context manager swapping device state.
+    """
+
+    def __init__(self):
+        self._states = {}
+        self._uses = {}
+
+    def reset(self):
+        self._states.clear()
+        self._uses.clear()
+
+    def get_states(self):
+        return dict(self._states)
+
+    def set_states(self, states):
+        self._states = dict(states)
+        self._uses = {k: 0 for k in self._states}
+
+    def add(self, name: str, key):
+        if name in self._states:
+            raise RuntimeError(f"rng state {name} already exists")
+        self._states[name] = key
+        self._uses[name] = 0
+
+    def fork(self, name: str = "model-parallel-rng"):
+        if name not in self._states:
+            raise RuntimeError(f"rng state {name} is not added")
+        use = self._uses[name]
+        self._uses[name] = use + 1
+        return jax.random.fold_in(self._states[name], use)
+
+
+_TRACKER = RngStatesTracker()
+
+
+def get_rng_states_tracker() -> RngStatesTracker:
+    """Analog of ``get_cuda_rng_tracker`` (``random.py:196``)."""
+    return _TRACKER
+
+
+def model_parallel_seed(seed: int, axis: Optional[str] = TENSOR_AXIS):
+    """Analog of ``model_parallel_cuda_manual_seed`` (``random.py:204``).
+
+    Returns the default (replicated) key and registers the model-parallel
+    stream on the tracker.  Call inside ``shard_map``.
+    """
+    key = jax.random.PRNGKey(seed)
+    _TRACKER.reset()
+    _TRACKER.add("model-parallel-rng", model_parallel_rng_key(key, axis))
+    return key
+
+
+def checkpoint(fn, *args, use_reentrant: bool = True, policy=None, **kwargs):
+    """Activation-checkpointed call — ``tensor_parallel.checkpoint``
+    (``random.py:308-330``).
+
+    ``policy`` is a ``jax.checkpoint_policies`` entry; the default (save
+    nothing) matches the reference's full recompute.  The reference's
+    ``distribute_saved_activations`` (partition the saved input across TP
+    ranks, ``random.py:253-262``) corresponds to checkpointing with inputs
+    saved sharded — under SPMD saved residuals inherit the sharding of the
+    values themselves, so it needs no special handling.
+    """
+    del use_reentrant  # torch-ism; recompute is always functional here
+    return jax.checkpoint(fn, policy=policy)(*args, **kwargs)

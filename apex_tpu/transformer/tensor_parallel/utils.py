@@ -1,0 +1,65 @@
+"""Shape/partition utilities for tensor parallelism.
+
+Behavioral spec: ``apex/transformer/tensor_parallel/utils.py`` (divisibility
+asserts, ``split_tensor_along_last_dim``) and the vocab-range helper class
+``VocabUtility`` (``apex/transformer/tensor_parallel/utils.py:55-80``).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax.numpy as jnp
+
+__all__ = [
+    "ensure_divisibility",
+    "divide",
+    "split_tensor_along_last_dim",
+    "VocabUtility",
+]
+
+
+def ensure_divisibility(numerator: int, denominator: int) -> None:
+    """``apex/transformer/tensor_parallel/utils.py`` ``ensure_divisibility``."""
+    if numerator % denominator != 0:
+        raise ValueError(f"{numerator} is not divisible by {denominator}")
+
+
+def divide(numerator: int, denominator: int) -> int:
+    """Exact integer division (``utils.py`` ``divide``)."""
+    ensure_divisibility(numerator, denominator)
+    return numerator // denominator
+
+
+def split_tensor_along_last_dim(x, num_partitions: int) -> Tuple:
+    """Split the last dimension into ``num_partitions`` equal chunks.
+
+    Reference: ``split_tensor_along_last_dim`` (``utils.py``).  The
+    ``contiguous_split_chunks`` flag is meaningless under XLA (no views).
+    """
+    last = x.shape[-1]
+    divide(last, num_partitions)
+    return tuple(jnp.split(x, num_partitions, axis=-1))
+
+
+class VocabUtility:
+    """Partition a vocabulary into contiguous per-rank ranges ``[fist, last)``.
+
+    Reference: ``apex/transformer/tensor_parallel/utils.py:55-80``.
+    """
+
+    @staticmethod
+    def vocab_range_from_per_partition_vocab_size(
+        per_partition_vocab_size: int, rank
+    ) -> Tuple:
+        index_f = rank * per_partition_vocab_size
+        return index_f, index_f + per_partition_vocab_size
+
+    @staticmethod
+    def vocab_range_from_global_vocab_size(
+        global_vocab_size: int, rank, world_size: int
+    ) -> Tuple:
+        per_partition = divide(global_vocab_size, world_size)
+        return VocabUtility.vocab_range_from_per_partition_vocab_size(
+            per_partition, rank
+        )
