@@ -1,0 +1,30 @@
+"""Pipeline parallelism — schedules, microbatch bookkeeping, utilities.
+
+TPU-native rebuild of ``apex/transformer/pipeline_parallel`` (reference
+``__init__.py`` exports ``get_forward_backward_func`` and ``build_model``).
+The p2p layer (``p2p_communication.py``) has no separate module here: stage
+transfer is the ``lax.ppermute`` inside the rotation schedule — see
+:mod:`apex_tpu.transformer.pipeline_parallel.schedules`.
+"""
+
+from apex_tpu.transformer.pipeline_parallel.schedules import (
+    forward_backward_no_pipelining,
+    forward_backward_pipelining_with_interleaving,
+    forward_backward_pipelining_without_interleaving,
+    get_forward_backward_func,
+    pipeline_apply,
+    split_into_microbatches,
+    stack_stage_params,
+)
+from apex_tpu.transformer.pipeline_parallel import utils
+
+__all__ = [
+    "get_forward_backward_func",
+    "forward_backward_no_pipelining",
+    "forward_backward_pipelining_without_interleaving",
+    "forward_backward_pipelining_with_interleaving",
+    "pipeline_apply",
+    "split_into_microbatches",
+    "stack_stage_params",
+    "utils",
+]

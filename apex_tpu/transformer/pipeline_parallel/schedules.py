@@ -1,0 +1,385 @@
+"""Pipeline-parallel forward/backward schedules — SPMD rotation design.
+
+Behavioral spec: ``apex/transformer/pipeline_parallel/schedules/`` —
+dispatcher ``get_forward_backward_func`` (``schedules/__init__.py:22-35``),
+no-pipelining (``fwd_bwd_no_pipelining.py:23``), 1F1B
+(``fwd_bwd_pipelining_without_interleaving.py:241-597``) and interleaved
+virtual-pipeline (``fwd_bwd_pipelining_with_interleaving.py:27-744``), with
+stage transfer in ``p2p_communication.py:168``.
+
+TPU-first design
+----------------
+The reference schedules are *host Python* state machines: each rank walks its
+own warmup/steady/cooldown sequence and posts NCCL isend/irecv per microbatch.
+Under XLA SPMD every device traces the same program, so the schedule is
+expressed instead as a **rotation pipeline** inside one ``shard_map`` over the
+``pp`` mesh axis:
+
+- stage parameters live sharded over ``pp`` (leading virtual-stage dim);
+- one ``lax.scan`` over "ticks"; each tick every stage applies its (chunk's)
+  computation to the activation in its slot and ``lax.ppermute``-shifts the
+  result to the next stage (the ``send_forward``/``recv_forward`` pair,
+  ``p2p_communication.py:385-470``, becomes a single collective-permute that
+  rides ICI);
+- microbatch ``j`` enters stage 0 at tick ``e_j`` and exits the last stage
+  ``pp*vpp`` ticks later; with ``vpp > 1`` the wrap-around edge of the same
+  ppermute carries the chunk-to-chunk transition of the **interleaved
+  (circular) schedule**, whose bubble is ``(pp-1)`` ticks versus the
+  non-interleaved ``(pp-1)*vpp`` — the same ``1/vpp`` bubble reduction as the
+  reference's interleaved schedule;
+- the backward pipeline is **not hand-written**: differentiating the scan
+  transposes every ``ppermute`` into its reverse permutation and replays the
+  ticks in reverse order, which *is* the cooldown/steady/warmup backward walk
+  of the reference (``backward_step`` ``schedules/common.py:325``).  XLA
+  overlaps the permute DMA with the next tick's compute — the latency hiding
+  the reference implements by hand with side streams and ``FutureTensor``.
+
+1F1B's reason to exist is bounding live activations to ``pp`` microbatches
+(vs GPipe's ``m``).  The JAX analog is rematerialisation: with
+``remat=True`` (default) each stage recomputes its tick's internals in
+backward from the carried activation, so live memory is the tick inputs plus
+one tick's residuals — the same O(pp)-not-O(m) footprint, without the
+asymmetric control flow that fights SPMD (SURVEY.md §7 hard part (a)).
+
+Schedule math (static, host-side): with ``period = pp*vpp``, microbatch ``j``
+enters at ``e_j = (j // pp) * period + (j % pp)``; its stream occupies slot
+``(stage = v % pp, tick = e_j + v)`` for virtual stage ``v = 0..period-1``;
+the chunk applied by stage ``s`` at tick ``t`` is ``((t - s) // pp) % vpp``.
+Distinct entry ticks occupy disjoint slot streams, so bubble slots compute
+garbage that is never read (the reference's warmup/cooldown bubbles) and
+contribute zero gradient.
+
+Stage homogeneity: every virtual stage runs the same ``stage_fn`` with its
+own parameter slice, and the activation pytree entering stage 0 must have the
+stage output's structure/shape — the reference has the very same contract in
+its fixed ``tensor_shape`` p2p protocol
+(``fwd_bwd_pipelining_without_interleaving.py:29-86``).  Embedding and loss
+head therefore live *outside* the pipelined region (computed replicated over
+``pp``, negligible vs the stage GEMMs) — see
+``apex_tpu.transformer.testing.standalone_gpt`` for the worked pattern.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from apex_tpu.parallel.mesh import PIPELINE_AXIS, get_mesh
+
+__all__ = [
+    "get_forward_backward_func",
+    "forward_backward_no_pipelining",
+    "forward_backward_pipelining_without_interleaving",
+    "forward_backward_pipelining_with_interleaving",
+    "pipeline_apply",
+    "split_into_microbatches",
+    "stack_stage_params",
+]
+
+StageFn = Callable[[Any, Any], Any]   # (stage_params, activation) -> activation
+LossFn = Callable[[Any, Any], jnp.ndarray]  # (output, target) -> scalar
+
+
+def split_into_microbatches(batch, num_microbatches: int):
+    """Reshape every leaf ``[m*b, ...] -> [m, b, ...]``.
+
+    The analog of the reference's ``get_kth_microbatch``
+    (``pipeline_parallel/utils.py:228-240``), done once up front so the
+    microbatch loop is a traced ``scan`` dimension instead of host slicing.
+    """
+    def split(leaf):
+        if leaf.shape[0] % num_microbatches != 0:
+            raise ValueError(
+                f"batch dim {leaf.shape[0]} not divisible by "
+                f"num_microbatches={num_microbatches}"
+            )
+        return leaf.reshape((num_microbatches, leaf.shape[0] // num_microbatches)
+                            + leaf.shape[1:])
+    return jax.tree_util.tree_map(split, batch)
+
+
+def stack_stage_params(per_stage_params: Sequence[Any]):
+    """Stack a list of per-virtual-stage param pytrees along a new leading dim.
+
+    The analog of ``build_model``'s per-rank module list
+    (``schedules/common.py:30-150``): virtual stage ``v`` (= chunk
+    ``v // pp`` on stage ``v % pp``) is row ``v`` — plain layer order.
+    """
+    return jax.tree_util.tree_map(lambda *ls: jnp.stack(ls), *per_stage_params)
+
+
+def _entry_ticks(m: int, pp: int, vpp: int) -> np.ndarray:
+    period = pp * vpp
+    j = np.arange(m)
+    return (j // pp) * period + (j % pp)
+
+
+def pipeline_apply(
+    stage_fn: StageFn,
+    stage_params,
+    inputs,
+    *,
+    num_chunks: int = 1,
+    axis: str = PIPELINE_AXIS,
+    mesh: Optional[Mesh] = None,
+    remat: bool = True,
+    params_already_local: bool = False,
+):
+    """Run microbatched ``inputs`` through the rotation pipeline.
+
+    ``stage_params``: pytree with leading dim ``pp*num_chunks`` (virtual-stage
+    major).  ``inputs``: activation pytree with leading microbatch dim ``m``;
+    its per-microbatch structure/shape must equal ``stage_fn``'s output.
+    Returns the last virtual stage's outputs, ``[m, ...]``, replicated over
+    ``axis`` (the reference's last-stage-only outputs, broadcast so the loss
+    can be computed SPMD).
+
+    Differentiable: use inside a ``jax.grad`` of the full train loss to get
+    the backward pipeline (see module docstring).
+
+    ``params_already_local``: for calls from inside an enclosing
+    ``shard_map`` that already bound ``axis`` — params are then the local
+    ``[num_chunks, 1, ...]`` slices and no sharding wrapper is applied.
+    """
+    if mesh is None and not params_already_local:
+        mesh = get_mesh()
+    pp = (lax.axis_size(axis) if params_already_local else mesh.shape[axis])
+    vpp = num_chunks
+    period = pp * vpp
+
+    leaves = jax.tree_util.tree_leaves(inputs)
+    if not leaves:
+        raise ValueError("inputs pytree is empty")
+    m = leaves[0].shape[0]
+    entry = _entry_ticks(m, pp, vpp)
+    total_ticks = int(entry[-1]) + period
+
+    fn = jax.checkpoint(stage_fn) if remat else stage_fn
+
+    def local_pipeline(params_local, x_mb):
+        # params_local leaves: [vpp, 1, ...] (chunk-major local slice).
+        s = lax.axis_index(axis)
+
+        def chunk_params(c):
+            return jax.tree_util.tree_map(
+                lambda l: lax.dynamic_index_in_dim(
+                    l, c, axis=0, keepdims=False
+                )[0],
+                params_local,
+            )
+
+        def tick(carry, t):
+            state, outbuf = carry
+            grp = t // period
+            r = t % period
+            j = jnp.clip(grp * pp + r, 0, m - 1)
+            entry_mb = jax.tree_util.tree_map(
+                lambda l: lax.dynamic_index_in_dim(l, j, axis=0,
+                                                   keepdims=False),
+                x_mb,
+            )
+            is_entry = jnp.logical_and(s == 0, r < pp)
+            x_in = jax.tree_util.tree_map(
+                lambda e, c_: jnp.where(is_entry, e, c_), entry_mb, state
+            )
+            c = jnp.clip(((t - s) // pp) % vpp, 0, vpp - 1)
+            y = fn(chunk_params(c), x_in)
+            # Exit bookkeeping: tick t is microbatch j_out's last-stage exit
+            # iff u = t-(period-1) is one of its entry ticks shifted by the
+            # pipe depth.  Accumulate the row into a [m, ...] buffer (O(1)
+            # rows touched per tick) instead of stacking all T tick outputs.
+            u = t - (period - 1)
+            ug, ur = u // period, u % period
+            j_out = ug * pp + ur
+            do_write = (u >= 0) & (ur < pp) & (j_out < m) & (s == pp - 1)
+            j_outc = jnp.clip(j_out, 0, m - 1)
+            outbuf = jax.tree_util.tree_map(
+                lambda buf, yl: lax.dynamic_update_index_in_dim(
+                    buf,
+                    jnp.where(
+                        do_write, yl,
+                        lax.dynamic_index_in_dim(buf, j_outc, axis=0,
+                                                 keepdims=False),
+                    ),
+                    j_outc, axis=0,
+                ),
+                outbuf, y,
+            )
+            shifted = jax.tree_util.tree_map(
+                lambda l: lax.ppermute(
+                    l, axis, [(i, (i + 1) % pp) for i in range(pp)]
+                ),
+                y,
+            )
+            return (shifted, outbuf), None
+
+        carry0 = jax.tree_util.tree_map(
+            lambda l: jnp.zeros(l.shape[1:], l.dtype), x_mb
+        )
+        out0 = jax.tree_util.tree_map(jnp.zeros_like, x_mb)
+        (_, outs), _ = lax.scan(tick, (carry0, out0),
+                                jnp.arange(total_ticks))
+        # Only the last stage wrote real exits; broadcast them so the loss
+        # computes identically on every pp rank (analog of losses living on
+        # the last stage only, schedules/common.py:297-320).
+        return jax.tree_util.tree_map(lambda l: lax.psum(l, axis), outs)
+
+    if params_already_local:
+        return local_pipeline(stage_params, inputs)
+
+    def reshape_chunk_major(l):
+        # [pp*vpp, ...] virtual-stage major -> [vpp, pp, ...]: the pp dim
+        # shards so device s holds rows (c, s) = virtual stages c*pp + s.
+        return l.reshape((vpp, pp) + l.shape[1:])
+
+    params_cm = jax.tree_util.tree_map(reshape_chunk_major, stage_params)
+
+    from apex_tpu.parallel.collectives import shard_over
+
+    f = shard_over(
+        local_pipeline,
+        mesh=mesh,
+        in_specs=(
+            jax.tree_util.tree_map(lambda _: P(None, axis), params_cm),
+            jax.tree_util.tree_map(lambda _: P(), inputs),
+        ),
+        out_specs=P(),
+    )
+    return f(params_cm, inputs)
+
+
+def forward_backward_no_pipelining(
+    stage_fn: StageFn,
+    loss_fn: LossFn,
+    stage_params,
+    inputs,
+    targets,
+    *,
+    loss_scale=None,
+    remat: bool = False,
+    **_unused,
+):
+    """Microbatched grad accumulation without pipelining.
+
+    Reference: ``fwd_bwd_no_pipelining.py:23-85`` — forward/backward per
+    microbatch with grad sync deferred to the last one (the ``no_sync``
+    context).  Under SPMD the deferral is automatic: the scan accumulates
+    local grads and XLA inserts the data-parallel reduction once, afterwards.
+
+    ``stage_fn(params, input) -> output``, ``loss_fn(output, target) ->
+    scalar``; ``inputs``/``targets`` have leading microbatch dim ``m``.
+    Returns ``(per_microbatch_losses, summed_grads)``; fold any ``1/m``
+    averaging into ``loss_fn`` exactly as the reference folds it into
+    ``loss_func`` (``schedules/common.py:297-320``).
+    """
+    fn = jax.checkpoint(stage_fn) if remat else stage_fn
+
+    def mb_loss(params, mb):
+        inp, tgt = mb
+        loss = loss_fn(fn(params, inp), tgt)
+        scaled = loss if loss_scale is None else loss * loss_scale
+        return scaled, loss
+
+    grad_fn = jax.grad(mb_loss, has_aux=True)
+
+    def step(acc, mb):
+        g, loss = grad_fn(stage_params, mb)
+        return jax.tree_util.tree_map(jnp.add, acc, g), loss
+
+    zeros = jax.tree_util.tree_map(jnp.zeros_like, stage_params)
+    grads, losses = lax.scan(step, zeros, (inputs, targets))
+    return losses, grads
+
+
+def _pipelined_fwd_bwd(stage_fn, loss_fn, stage_params, inputs, targets, *,
+                       num_chunks, axis, mesh, loss_scale, remat):
+    def total_loss(params):
+        outs = pipeline_apply(
+            stage_fn, params, inputs,
+            num_chunks=num_chunks, axis=axis, mesh=mesh, remat=remat,
+        )
+        losses = jax.vmap(loss_fn)(outs, targets)
+        total = jnp.sum(losses)
+        if loss_scale is not None:
+            total = total * loss_scale
+        return total, losses
+
+    grads, losses = jax.grad(total_loss, has_aux=True)(stage_params)
+    return losses, grads
+
+
+def forward_backward_pipelining_without_interleaving(
+    stage_fn: StageFn,
+    loss_fn: LossFn,
+    stage_params,
+    inputs,
+    targets,
+    *,
+    axis: str = PIPELINE_AXIS,
+    mesh: Optional[Mesh] = None,
+    loss_scale=None,
+    remat: bool = True,
+    **_unused,
+):
+    """1F1B-equivalent schedule
+    (``fwd_bwd_pipelining_without_interleaving.py:241``); see module
+    docstring.  Returns ``(losses[m], grads)`` with grads summed over
+    microbatches (the reference's ``main_grad`` accumulation)."""
+    return _pipelined_fwd_bwd(
+        stage_fn, loss_fn, stage_params, inputs, targets,
+        num_chunks=1, axis=axis, mesh=mesh, loss_scale=loss_scale, remat=remat,
+    )
+
+
+def forward_backward_pipelining_with_interleaving(
+    stage_fn: StageFn,
+    loss_fn: LossFn,
+    stage_params,
+    inputs,
+    targets,
+    *,
+    num_chunks: int,
+    axis: str = PIPELINE_AXIS,
+    mesh: Optional[Mesh] = None,
+    loss_scale=None,
+    remat: bool = True,
+    **_unused,
+):
+    """Interleaved virtual-pipeline schedule
+    (``fwd_bwd_pipelining_with_interleaving.py:27-744``).
+
+    ``stage_params`` leading dim is ``pp * num_chunks`` in layer order
+    (virtual-stage major): chunk ``c`` of stage ``s`` is row ``c*pp + s``,
+    matching the reference's microbatch→chunk mapping (``:221-259``).
+    """
+    if num_chunks < 2:
+        raise ValueError(
+            "interleaved schedule requires num_chunks >= 2 (use "
+            "forward_backward_pipelining_without_interleaving)"
+        )
+    return _pipelined_fwd_bwd(
+        stage_fn, loss_fn, stage_params, inputs, targets,
+        num_chunks=num_chunks, axis=axis, mesh=mesh, loss_scale=loss_scale,
+        remat=remat,
+    )
+
+
+def get_forward_backward_func(
+    virtual_pipeline_model_parallel_size: Optional[int] = None,
+    pipeline_model_parallel_size: int = 1,
+):
+    """Dispatcher, ``schedules/__init__.py:22-35``."""
+    if pipeline_model_parallel_size > 1:
+        if virtual_pipeline_model_parallel_size is not None:
+            return functools.partial(
+                forward_backward_pipelining_with_interleaving,
+                num_chunks=virtual_pipeline_model_parallel_size,
+            )
+        return forward_backward_pipelining_without_interleaving
+    return forward_backward_no_pipelining

@@ -1,0 +1,206 @@
+"""Pipeline-parallel utilities.
+
+Behavioral spec: ``apex/transformer/pipeline_parallel/utils.py`` — global
+microbatch-calculator setup (``setup_microbatch_calculator:58``,
+``get_num_microbatches:92``), loss averaging
+(``average_losses_across_data_parallel_group:242``), params L2 norm
+(``calc_params_l2_norm:213``), LM masks/position-ids
+(``get_ltor_masks_and_position_ids:303``), memory reporting
+(``report_memory:253``), rank-print helpers (``:159-177``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from apex_tpu.parallel.mesh import DATA_AXIS
+from apex_tpu.transformer.microbatches import (
+    build_num_microbatches_calculator,
+)
+from apex_tpu.utils.tree import tree_l2_norm
+
+__all__ = [
+    "setup_microbatch_calculator",
+    "get_num_microbatches",
+    "get_current_global_batch_size",
+    "update_num_microbatches",
+    "average_losses_across_data_parallel_group",
+    "calc_params_l2_norm",
+    "get_ltor_masks_and_position_ids",
+    "report_memory",
+    "print_rank_0",
+    "print_rank_last",
+]
+
+_GLOBAL_NUM_MICROBATCHES_CALCULATOR = None
+
+
+def setup_microbatch_calculator(
+    rank: int = 0,
+    rampup_batch_size=None,
+    global_batch_size: int = 1,
+    micro_batch_size: int = 1,
+    data_parallel_size: int = 1,
+) -> None:
+    """``pipeline_parallel/utils.py:58-78`` — build the global calculator."""
+    global _GLOBAL_NUM_MICROBATCHES_CALCULATOR
+    if _GLOBAL_NUM_MICROBATCHES_CALCULATOR is not None:
+        raise RuntimeError("num microbatches calculator is already initialized")
+    _GLOBAL_NUM_MICROBATCHES_CALCULATOR = build_num_microbatches_calculator(
+        rank, rampup_batch_size, global_batch_size, micro_batch_size,
+        data_parallel_size,
+    )
+
+
+def _destroy_microbatch_calculator() -> None:
+    global _GLOBAL_NUM_MICROBATCHES_CALCULATOR
+    _GLOBAL_NUM_MICROBATCHES_CALCULATOR = None
+
+
+def get_num_microbatches() -> int:
+    """``pipeline_parallel/utils.py:92-94``."""
+    return _GLOBAL_NUM_MICROBATCHES_CALCULATOR.get()
+
+
+def get_current_global_batch_size() -> int:
+    """``pipeline_parallel/utils.py:97-99``."""
+    return _GLOBAL_NUM_MICROBATCHES_CALCULATOR.get_current_global_batch_size()
+
+
+def update_num_microbatches(consumed_samples: int,
+                            consistency_check: bool = True) -> None:
+    """``pipeline_parallel/utils.py:88-90``."""
+    _GLOBAL_NUM_MICROBATCHES_CALCULATOR.update(consumed_samples,
+                                               consistency_check)
+
+
+def average_losses_across_data_parallel_group(losses,
+                                              axis: Optional[str] = None):
+    """Mean of stacked losses, reduced over the data-parallel axis.
+
+    Reference: ``pipeline_parallel/utils.py:242-250`` (all_reduce / dp world
+    size).  Under SPMD, pass ``axis=DATA_AXIS`` when called inside a bound
+    ``shard_map``; with pjit-style global arrays the dp mean is already
+    implicit and ``axis=None`` just stacks and averages.
+    """
+    averaged = jnp.stack([jnp.mean(l) for l in losses])
+    if axis is not None:
+        averaged = lax.pmean(averaged, axis)
+    return averaged
+
+
+def calc_params_l2_norm(params, per_tensor: bool = False):
+    """Global (or per-tensor) L2 norm of parameters.
+
+    Reference: ``pipeline_parallel/utils.py:213-239`` — a
+    ``multi_tensor_l2norm`` launch with TP-duplicate filtering.  Under SPMD
+    parameters are stored exactly once per shard, so no duplicate filtering
+    is needed; the flat reduction fuses in XLA.
+    """
+    if per_tensor:
+        return jax.tree_util.tree_map(
+            lambda p: jnp.sqrt(jnp.sum(jnp.square(p.astype(jnp.float32)))),
+            params,
+        )
+    return tree_l2_norm(params)
+
+
+def get_ltor_masks_and_position_ids(
+    data,
+    eod_token: Optional[int] = None,
+    reset_position_ids: bool = False,
+    reset_attention_mask: bool = False,
+    eod_mask_loss: bool = False,
+):
+    """Left-to-right (causal) masks and position ids for LM batches.
+
+    Reference: ``pipeline_parallel/utils.py:303-355``.  Returns
+    ``(attention_mask, loss_mask, position_ids)`` with the reference's
+    conventions: attention mask is boolean with **True = masked out** (the
+    ``< 0.5`` inversion at ``:353``), loss mask zeroes EOD positions when
+    ``eod_mask_loss``.
+
+    The per-document reset variants (``reset_position_ids`` /
+    ``reset_attention_mask``) rebuild positions/visibility after each EOD
+    token (``:327-351``) — implemented with cumulative document ids instead
+    of the reference's per-row host loop so the whole batch stays on device.
+    """
+    micro_batch_size, seq_length = data.shape
+
+    att_mask_batch = (
+        micro_batch_size if reset_attention_mask else 1
+    )
+    causal = ~jnp.tril(
+        jnp.ones((seq_length, seq_length), dtype=bool)
+    )  # True above diagonal = masked
+    attention_mask = jnp.broadcast_to(
+        causal, (att_mask_batch, 1, seq_length, seq_length)
+    )
+
+    loss_mask = jnp.ones(data.shape, dtype=jnp.float32)
+    if eod_mask_loss:
+        if eod_token is None:
+            raise ValueError("eod_mask_loss requires eod_token")
+        loss_mask = jnp.where(data == eod_token, 0.0, loss_mask)
+
+    position_ids = jnp.broadcast_to(
+        jnp.arange(seq_length, dtype=jnp.int32), data.shape
+    )
+
+    if reset_position_ids or reset_attention_mask:
+        if eod_token is None:
+            raise ValueError("document reset requires eod_token")
+        # Document id of each position: number of EODs strictly before it.
+        is_eod = (data == eod_token).astype(jnp.int32)
+        doc_id = jnp.cumsum(is_eod, axis=1) - is_eod  # EOD belongs to its doc
+        if reset_position_ids:
+            # Position within document: global pos minus the position just
+            # after the previous EOD (utils.py:344-350).
+            pos = jnp.arange(seq_length, dtype=jnp.int32)[None, :]
+            # The reference resets positions only *after* the EOD
+            # (utils.py:344-350): the EOD keeps its in-document position, so
+            # the document start is the cummax over strictly-earlier EODs.
+            prev_eod_pos = jnp.where(is_eod == 1, pos + 1, 0)
+            shifted = jnp.pad(prev_eod_pos[:, :-1], ((0, 0), (1, 0)))
+            doc_start = jax.lax.cummax(shifted, axis=1)
+            position_ids = pos - doc_start
+        if reset_attention_mask:
+            same_doc = doc_id[:, None, :] == doc_id[:, :, None]
+            attention_mask = attention_mask | ~same_doc[:, None, :, :]
+
+    return attention_mask, loss_mask, position_ids
+
+
+def report_memory(name: str = "") -> str:
+    """Device-memory summary, analog of ``report_memory``
+    (``pipeline_parallel/utils.py:253-263``) over ``jax.local_devices()``
+    memory stats instead of the CUDA caching allocator."""
+    lines = []
+    for d in jax.local_devices():
+        stats = d.memory_stats() or {}
+        in_use = stats.get("bytes_in_use", 0) / 2**20
+        limit = stats.get("bytes_limit", 0) / 2**20
+        peak = stats.get("peak_bytes_in_use", 0) / 2**20
+        lines.append(
+            f"[{name}] {d.platform}:{d.id} memory (MB) | in-use: {in_use:.1f}"
+            f" | peak: {peak:.1f} | limit: {limit:.1f}"
+        )
+    report = "\n".join(lines)
+    print_rank_last(report)
+    return report
+
+
+def print_rank_0(message: str) -> None:
+    """``pipeline_parallel/utils.py:159-166`` (process 0 under multi-host)."""
+    if jax.process_index() == 0:
+        print(message, flush=True)
+
+
+def print_rank_last(message: str) -> None:
+    """``pipeline_parallel/utils.py:169-177``."""
+    if jax.process_index() == jax.process_count() - 1:
+        print(message, flush=True)

@@ -1,0 +1,232 @@
+"""Pipeline-schedule numerics on the virtual CPU mesh.
+
+Mirrors the reference's
+``tests/L0/run_transformer/test_pipeline_parallel_fwd_bwd.py:99-170``:
+forward/backward parity of no-pipelining vs 1F1B vs interleaved across
+pp grids, checked against a single-device sequential reference.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu import parallel
+from apex_tpu.transformer import pipeline_parallel as pp_lib
+from apex_tpu.transformer.microbatches import (
+    ConstantNumMicroBatches,
+    RampupBatchsizeNumMicroBatches,
+    build_num_microbatches_calculator,
+)
+
+HID = 8
+MB = 2  # microbatch size
+
+
+def stage_fn(params, x):
+    """One homogeneous stage: linear + gelu + linear (same structure every
+    virtual stage, the rotation contract)."""
+    h = jnp.tanh(x @ params["w1"] + params["b1"])
+    return h @ params["w2"] + x
+
+
+def make_stage_params(key, n_stages):
+    ks = jax.random.split(key, n_stages)
+    per_stage = [
+        {
+            "w1": jax.random.normal(k, (HID, HID)) * 0.3,
+            "b1": jnp.zeros((HID,)),
+            "w2": jax.random.normal(jax.random.fold_in(k, 1), (HID, HID)) * 0.3,
+        }
+        for k in ks
+    ]
+    return pp_lib.stack_stage_params(per_stage), per_stage
+
+
+def sequential_reference(per_stage, x_mb, targets):
+    """Ground truth: apply the stages in order per microbatch, sum losses."""
+    def full(per_stage, x_mb):
+        outs = []
+        for i in range(x_mb.shape[0]):
+            h = x_mb[i]
+            for p in per_stage:
+                h = stage_fn(p, h)
+            outs.append(h)
+        return jnp.stack(outs)
+
+    def loss(per_stage):
+        outs = full(per_stage, x_mb)
+        return jnp.sum((outs - targets) ** 2), outs
+
+    grads, outs = jax.grad(loss, has_aux=True)(per_stage)
+    return outs, grads
+
+
+def loss_fn(out, tgt):
+    return jnp.sum((out - tgt) ** 2)
+
+
+@pytest.mark.parametrize("pp,vpp,m", [(4, 1, 4), (4, 1, 8), (2, 2, 4),
+                                      (2, 2, 6), (4, 2, 8), (2, 3, 4)])
+def test_pipeline_matches_sequential(pp, vpp, m):
+    parallel.initialize_model_parallel(pipeline_model_parallel_size=pp)
+    n_virtual = pp * vpp
+    key = jax.random.PRNGKey(0)
+    stacked, per_stage = make_stage_params(key, n_virtual)
+    x = jax.random.normal(jax.random.PRNGKey(1), (m, MB, HID))
+    tgt = jax.random.normal(jax.random.PRNGKey(2), (m, MB, HID))
+
+    ref_outs, ref_grads = sequential_reference(per_stage, x, tgt)
+
+    outs = pp_lib.pipeline_apply(stage_fn, stacked, x, num_chunks=vpp)
+    np.testing.assert_allclose(np.asarray(outs), np.asarray(ref_outs),
+                               rtol=1e-5, atol=1e-5)
+
+    fwd_bwd = pp_lib.get_forward_backward_func(
+        vpp if vpp > 1 else None, pp
+    )
+    losses, grads = fwd_bwd(stage_fn, loss_fn, stacked, x, tgt)
+    ref_losses = jax.vmap(loss_fn)(ref_outs, tgt)
+    np.testing.assert_allclose(np.asarray(losses), np.asarray(ref_losses),
+                               rtol=1e-5, atol=1e-5)
+    ref_stacked = pp_lib.stack_stage_params(
+        [ref_grads[v] for v in range(n_virtual)]
+    )
+    for name in ("w1", "b1", "w2"):
+        np.testing.assert_allclose(
+            np.asarray(grads[name]), np.asarray(ref_stacked[name]),
+            rtol=1e-4, atol=1e-4,
+        )
+
+
+def test_no_pipelining_matches_single_backward():
+    """fwd_bwd_no_pipelining.py:23 — grad accumulation over microbatches."""
+    key = jax.random.PRNGKey(3)
+    stacked, per_stage = make_stage_params(key, 2)
+    m = 4
+    x = jax.random.normal(jax.random.PRNGKey(4), (m, MB, HID))
+    tgt = jax.random.normal(jax.random.PRNGKey(5), (m, MB, HID))
+
+    def model_fn(params, inp):
+        h = stage_fn(jax.tree_util.tree_map(lambda l: l[0], params), inp)
+        return stage_fn(jax.tree_util.tree_map(lambda l: l[1], params), h)
+
+    fwd_bwd = pp_lib.get_forward_backward_func(None, 1)
+    losses, grads = fwd_bwd(model_fn, loss_fn, stacked, x, tgt)
+
+    def total(params):
+        outs = jax.vmap(lambda i, t: loss_fn(model_fn(params, i), t))(x, tgt)
+        return jnp.sum(outs), outs
+
+    ref_grads, ref_losses = jax.grad(total, has_aux=True)(stacked)
+    np.testing.assert_allclose(np.asarray(losses), np.asarray(ref_losses),
+                               rtol=1e-5)
+    for name in ("w1", "b1", "w2"):
+        np.testing.assert_allclose(np.asarray(grads[name]),
+                                   np.asarray(ref_grads[name]),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_pipeline_under_jit_and_loss_scale():
+    """Whole fwd_bwd must be jittable (the production path) and honor
+    loss_scale (GradScaler interop, transformer/amp/grad_scaler.py:21)."""
+    pp, m = 4, 4
+    parallel.initialize_model_parallel(pipeline_model_parallel_size=pp)
+    stacked, per_stage = make_stage_params(jax.random.PRNGKey(6), pp)
+    x = jax.random.normal(jax.random.PRNGKey(7), (m, MB, HID))
+    tgt = jax.random.normal(jax.random.PRNGKey(8), (m, MB, HID))
+
+    @jax.jit
+    def run(stacked):
+        return pp_lib.forward_backward_pipelining_without_interleaving(
+            stage_fn, loss_fn, stacked, x, tgt, loss_scale=8.0
+        )
+
+    losses, grads = run(stacked)
+    _, ref_grads = pp_lib.forward_backward_pipelining_without_interleaving(
+        stage_fn, loss_fn, stacked, x, tgt
+    )
+    np.testing.assert_allclose(np.asarray(grads["w1"]),
+                               8.0 * np.asarray(ref_grads["w1"]),
+                               rtol=1e-4, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# microbatch calculators (reference tests/L0/run_transformer/test_microbatches.py)
+# ---------------------------------------------------------------------------
+
+
+def test_constant_microbatches():
+    c = ConstantNumMicroBatches(32, 2, 4)
+    assert c.get() == 4
+    assert c.get_current_global_batch_size() == 32
+    with pytest.raises(ValueError):
+        ConstantNumMicroBatches(30, 2, 4)
+
+
+def test_rampup_microbatches():
+    c = RampupBatchsizeNumMicroBatches(
+        start_batch_size=4, batch_size_increment=4, ramup_samples=64,
+        global_batch_size=16, micro_batch_size=1, data_parallel_size=2,
+    )
+    assert c.get_current_global_batch_size() == 4
+    c.update(0, True)
+    assert c.get() == 2
+    c.update(32, True)
+    # 3 increments over 64 samples -> one increment per 21.33 samples;
+    # int(32/21.33) = 1 step -> 4 + 4 = 8 (microbatches.py:112-194 math).
+    assert c.get_current_global_batch_size() == 8
+    c.update(64, True)
+    assert c.get_current_global_batch_size() == 16
+    c.update(1000, True)
+    assert c.get_current_global_batch_size() == 16
+    assert c.get() == 8
+
+
+def test_rampup_degenerate_cases():
+    """start == global and ramup_samples == 0 must not divide by zero."""
+    c = RampupBatchsizeNumMicroBatches(16, 4, 64, 16, 1, 2)
+    assert c.get_current_global_batch_size() == 16
+    c = RampupBatchsizeNumMicroBatches(4, 4, 0, 16, 1, 2)
+    c.update(0, True)
+    assert c.get_current_global_batch_size() == 16
+
+
+def test_ltor_masks_reset_semantics():
+    """utils.py:303-355: EOD keeps its in-document position; positions reset
+    only after it; attention blocked across documents."""
+    from apex_tpu.transformer.pipeline_parallel.utils import (
+        get_ltor_masks_and_position_ids,
+    )
+    data = jnp.array([[10, 11, 99, 12, 13]])
+    am, lm, pid = get_ltor_masks_and_position_ids(
+        data, eod_token=99, reset_position_ids=True,
+        reset_attention_mask=True, eod_mask_loss=True,
+    )
+    np.testing.assert_array_equal(np.asarray(pid[0]), [0, 1, 2, 0, 1])
+    assert float(lm[0, 2]) == 0.0 and float(lm[0, 1]) == 1.0
+    # position 3 (doc 2) must not attend to position 1 (doc 1)
+    assert bool(am[0, 0, 3, 1]) is True
+    # causal within doc: position 4 attends to 3
+    assert bool(am[0, 0, 4, 3]) is False
+
+
+def test_build_factory():
+    c = build_num_microbatches_calculator(
+        0, None, global_batch_size=8, micro_batch_size=2,
+        data_parallel_size=2,
+    )
+    assert isinstance(c, ConstantNumMicroBatches)
+    c = build_num_microbatches_calculator(
+        0, [4, 4, 64], global_batch_size=16, micro_batch_size=1,
+        data_parallel_size=2,
+    )
+    assert isinstance(c, RampupBatchsizeNumMicroBatches)
+
+
+def test_split_into_microbatches():
+    batch = {"x": jnp.arange(24.0).reshape(12, 2)}
+    mbs = pp_lib.split_into_microbatches(batch, 4)
+    assert mbs["x"].shape == (4, 3, 2)
+    with pytest.raises(ValueError):
+        pp_lib.split_into_microbatches(batch, 5)
