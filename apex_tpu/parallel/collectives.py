@@ -34,6 +34,7 @@ __all__ = [
     "broadcast",
     "axis_index",
     "axis_size",
+    "bound_axis_size",
     "send_recv_next",
     "send_recv_prev",
     "shard_over",
@@ -47,6 +48,18 @@ def axis_index(axis: AxisName):
     """Rank along a mesh axis (inside shard_map). Replaces
     ``torch.distributed.get_rank(group)``."""
     return lax.axis_index(axis)
+
+
+def bound_axis_size(axis: Optional[AxisName]) -> int:
+    """Size of ``axis`` if it is bound by an enclosing ``shard_map``/``pmap``,
+    else 1.  Lets axis-parameterized modules degrade to their single-rank
+    form when traced outside any mapped context (``axis=None`` or unbound)."""
+    if axis is None:
+        return 1
+    try:
+        return lax.axis_size(axis)
+    except NameError:
+        return 1
 
 
 def axis_size(axis: AxisName) -> int:

@@ -1,0 +1,5 @@
+"""``apex_tpu.transformer.amp`` — reference ``apex/transformer/amp``."""
+
+from apex_tpu.transformer.amp.grad_scaler import GradScaler
+
+__all__ = ["GradScaler"]

@@ -1,0 +1,67 @@
+"""Model-parallel-aware gradient scaler.
+
+Behavioral spec: ``apex/transformer/amp/grad_scaler.py:21-125`` —
+``GradScaler`` subclasses the native scaler only to **all-reduce found_inf
+across the model-parallel group** in ``_maybe_opt_step:44-55`` and
+``update:57-125``: with tensor/pipeline parallelism, an overflow on any model
+shard must skip the step on *all* shards, or the replicas diverge.
+
+Under SPMD the same guarantee needs one MAX-reduction of the local overflow
+flag over every model-parallel mesh axis before the scale update — done in
+:meth:`GradScaler.all_finite` (when called inside ``shard_map`` with those
+axes bound) or implicitly (global-array grads already see every shard's
+values, so plain ``all_finite`` is already model-parallel correct — the
+common pjit path needs no reduction at all).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from apex_tpu.amp.scaler import DynamicLossScale, LossScaleState, all_finite
+from apex_tpu.parallel.mesh import PIPELINE_AXIS, TENSOR_AXIS
+
+__all__ = ["GradScaler"]
+
+
+@dataclasses.dataclass(frozen=True)
+class GradScaler(DynamicLossScale):
+    """``DynamicLossScale`` with model-parallel overflow agreement.
+
+    ``model_parallel_axes`` are reduced over in :meth:`all_finite`; pass the
+    axes bound by the enclosing ``shard_map`` (default: tensor + pipeline,
+    the reference's "model-parallel group" ``parallel_state.py:448-456``).
+    Constructor defaults mirror ``torch.cuda.amp.GradScaler`` as the
+    reference subclasses it (init 2**16, growth 2, backoff 0.5,
+    interval 2000, hysteresis 2 — ``grad_scaler.py:21-43``).
+    """
+
+    hysteresis: int = 2
+    model_parallel_axes: Tuple[str, ...] = (TENSOR_AXIS, PIPELINE_AXIS)
+
+    def all_finite(self, grads, *, axes: Optional[Sequence[str]] = None):
+        """Local overflow check + MAX-agreement over model-parallel axes.
+
+        The SPMD analog of ``all_reduce(found_inf, MAX, model_parallel_group)``
+        (``grad_scaler.py:44-55``).  ``axes`` defaults to
+        ``model_parallel_axes`` filtered to those actually bound (so the same
+        code runs under tp-only or tp+pp shard_maps and under plain jit,
+        where no axis is bound and grads are global arrays).
+        """
+        finite = all_finite(grads)
+        use = self.model_parallel_axes if axes is None else tuple(axes)
+        bound = []
+        for ax in use:
+            try:
+                lax.axis_size(ax)
+            except NameError:
+                continue
+            bound.append(ax)
+        if bound:
+            finite = lax.pmin(finite.astype(jnp.int32), tuple(bound)) > 0
+        return finite

@@ -1,0 +1,33 @@
+"""Transformer enums — reference ``apex/transformer/enums.py``.
+
+``AttnMaskType`` is defined once in :mod:`apex_tpu.ops.softmax` (the fused
+softmax family consumes it) and re-exported here at the reference's path.
+"""
+
+import enum
+
+from apex_tpu.ops.softmax import AttnMaskType
+
+__all__ = ["LayerType", "AttnType", "AttnMaskType", "ModelType"]
+
+
+class LayerType(enum.Enum):
+    """``apex/transformer/enums.py`` LayerType."""
+
+    encoder = 1
+    decoder = 2
+
+
+class AttnType(enum.Enum):
+    """``apex/transformer/enums.py`` AttnType."""
+
+    self_attn = 1
+    cross_attn = 2
+
+
+class ModelType(enum.Enum):
+    """``apex/transformer/enums.py`` ModelType (encoder/decoder split for
+    T5-style pipelines, ``parallel_state.py`` split_rank)."""
+
+    encoder_or_decoder = 1
+    encoder_and_decoder = 2

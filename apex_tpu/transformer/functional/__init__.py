@@ -1,0 +1,26 @@
+"""Fused functional ops at the reference's import path.
+
+``apex/transformer/functional/__init__.py`` exports ``FusedScaleMaskSoftmax``
+(implementation in ``fused_softmax.py``); the TPU implementations live in
+:mod:`apex_tpu.ops.softmax` and are re-exported here so migrated imports
+(``from apex.transformer.functional import FusedScaleMaskSoftmax``) work
+unchanged.
+"""
+
+from apex_tpu.ops.softmax import (
+    AttnMaskType,
+    FusedScaleMaskSoftmax,
+    generic_scaled_masked_softmax,
+    scaled_masked_softmax,
+    scaled_softmax,
+    scaled_upper_triang_masked_softmax,
+)
+
+__all__ = [
+    "AttnMaskType",
+    "FusedScaleMaskSoftmax",
+    "scaled_softmax",
+    "scaled_masked_softmax",
+    "scaled_upper_triang_masked_softmax",
+    "generic_scaled_masked_softmax",
+]

@@ -1,0 +1,19 @@
+"""``apex_tpu.transformer.layers`` — reference ``apex/transformer/layers``."""
+
+from apex_tpu.transformer.layers.layer_norm import (
+    FastLayerNorm,
+    FusedLayerNorm,
+    FusedRMSNorm,
+    MixedFusedLayerNorm,
+    MixedFusedRMSNorm,
+    allreduce_sequence_parallel_gradients,
+)
+
+__all__ = [
+    "FastLayerNorm",
+    "FusedLayerNorm",
+    "FusedRMSNorm",
+    "MixedFusedLayerNorm",
+    "MixedFusedRMSNorm",
+    "allreduce_sequence_parallel_gradients",
+]

@@ -1,0 +1,88 @@
+"""Sequence-parallel-aware LayerNorm wrappers.
+
+Behavioral spec: ``apex/transformer/layers/layer_norm.py`` — the reference
+subclasses ``FusedLayerNorm``/``MixedFusedLayerNorm`` only to stamp a
+``sequence_parallel`` attribute on weight/bias
+(``_set_sequence_parallel_enabled:26``, classes ``:33,54``) so the DDP/grad
+hooks later all-reduce those grads across the tensor-parallel group (SP
+shards activations over ``tp``, but the LN params are replicated, so each
+rank sees only its sequence shard's grad contribution).
+
+Under SPMD the *primary* fix is structural, not a hook: pass replicated
+params into ``shard_map`` with honest ``P()`` specs
+(:mod:`apex_tpu.transformer.tensor_parallel.partition`) and the shard_map
+transpose inserts the gradient psum itself.
+:func:`allreduce_sequence_parallel_gradients` remains for reference-style
+code that carries params as per-rank local trees inside one long-lived
+``shard_map`` (where no spec describes them) — the direct analog of the
+reference's backward hook.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+from jax import lax
+
+from apex_tpu.normalization.fused_layer_norm import (
+    FusedLayerNorm,
+    FusedRMSNorm,
+    MixedFusedLayerNorm,
+    MixedFusedRMSNorm,
+)
+from apex_tpu.parallel.mesh import TENSOR_AXIS
+
+__all__ = [
+    "FastLayerNorm",
+    "FusedLayerNorm",
+    "FusedRMSNorm",
+    "MixedFusedLayerNorm",
+    "MixedFusedRMSNorm",
+    "allreduce_sequence_parallel_gradients",
+    "mark_sequence_parallel_params",
+]
+
+# ``FastLayerNorm`` (apex/contrib/layer_norm/layer_norm.py) is the tuned
+# persistent-kernel variant of the same math; on TPU the fused path covers
+# all hidden sizes, so it is the same module.
+FastLayerNorm = FusedLayerNorm
+
+_SP_PARAM_PATH_MARKERS = ("layernorm", "layer_norm", "norm")
+
+
+def mark_sequence_parallel_params(path: str) -> bool:
+    """True if a param path belongs to a replicated-norm param (the set the
+    reference stamps with ``sequence_parallel=True``, ``layer_norm.py:26-52``)."""
+    lowered = path.lower()
+    return any(m in lowered for m in _SP_PARAM_PATH_MARKERS)
+
+
+def allreduce_sequence_parallel_gradients(
+    grads,
+    axis: str = TENSOR_AXIS,
+    is_sequence_parallel_param=None,
+):
+    """Sum replicated-param grads over the tensor axis under SP.
+
+    The analog of the reference's backward grad hook for
+    ``sequence_parallel``-flagged params: with activations sharded along the
+    sequence dim over ``tp``, each rank's LN/bias grad covers only its
+    sequence shard and must be summed (``layers.py:406-412`` discussion and
+    ``layer_norm.py:26``).  Call inside the ``shard_map`` that bound
+    ``axis``, after ``jax.grad``:
+
+        grads = allreduce_sequence_parallel_gradients(grads)
+
+    ``is_sequence_parallel_param(path_str) -> bool`` defaults to
+    :func:`mark_sequence_parallel_params` (path contains a norm marker).
+    """
+    pred = is_sequence_parallel_param or mark_sequence_parallel_params
+
+    def fix(path, g):
+        name = "/".join(str(getattr(k, "key", k)) for k in path)
+        if pred(name):
+            return lax.psum(g, axis)
+        return g
+
+    return jax.tree_util.tree_map_with_path(fix, grads)

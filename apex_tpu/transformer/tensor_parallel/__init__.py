@@ -14,6 +14,10 @@ from apex_tpu.transformer.tensor_parallel.layers import (
     linear_with_grad_accumulation,
     parallel_init,
 )
+from apex_tpu.transformer.tensor_parallel.partition import (
+    DEFAULT_RULES,
+    infer_param_specs,
+)
 from apex_tpu.transformer.tensor_parallel.mappings import (
     copy_to_tensor_model_parallel_region,
     gather_from_sequence_parallel_region,
@@ -40,6 +44,8 @@ from apex_tpu.transformer.tensor_parallel.utils import (
 
 __all__ = [
     "vocab_parallel_cross_entropy",
+    "DEFAULT_RULES",
+    "infer_param_specs",
     "broadcast_data",
     "ColumnParallelLinear",
     "RowParallelLinear",

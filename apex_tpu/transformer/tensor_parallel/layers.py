@@ -39,6 +39,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from apex_tpu.parallel.collectives import bound_axis_size
 from apex_tpu.parallel.mesh import TENSOR_AXIS
 from apex_tpu.transformer.tensor_parallel import mappings
 from apex_tpu.transformer.tensor_parallel.utils import VocabUtility, divide
@@ -72,7 +73,10 @@ def parallel_init(init_fn: Initializer, axis: Optional[str]) -> Initializer:
 
 
 def _axis_size(axis: Optional[str]) -> int:
-    return 1 if axis is None else lax.axis_size(axis)
+    # Degrades to the single-rank layer when the axis is not bound by an
+    # enclosing shard_map — the same module code then runs single-device
+    # (and jax.eval_shape can trace param structures outside the mesh).
+    return bound_axis_size(axis)
 
 
 def linear_with_grad_accumulation(
@@ -120,17 +124,24 @@ class VocabParallelEmbedding(nn.Module):
     dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
 
-    @nn.compact
-    def __call__(self, token_ids):
+    # setup-style (not @nn.compact) so the table is an attribute parents can
+    # share for tied LM heads (``parallel_lm_logits`` weight tying,
+    # standalone_transformer_lm.py:1130) via the flax setup-sharing pattern.
+    def setup(self):
         world = _axis_size(self.axis)
         vocab_local = divide(self.num_embeddings, world)
-        weight = self.param(
+        self.embedding = self.param(
             "embedding",
-            parallel_init(self.embedding_init, self.axis if world > 1 else None),
+            parallel_init(self.embedding_init,
+                          self.axis if world > 1 else None),
             (vocab_local, self.embedding_dim),
             self.param_dtype,
         )
-        weight = jnp.asarray(weight, self.dtype)
+
+    def __call__(self, token_ids):
+        world = _axis_size(self.axis)
+        vocab_local = divide(self.num_embeddings, world)
+        weight = jnp.asarray(self.embedding, self.dtype)
         if world == 1:
             return jnp.take(weight, token_ids, axis=0)
 
@@ -146,6 +157,12 @@ class VocabParallelEmbedding(nn.Module):
         out = jnp.take(weight, local_ids, axis=0)
         out = jnp.where(in_range[..., None], out, jnp.zeros_like(out))
         return mappings.reduce_from_tensor_model_parallel_region(out, self.axis)
+
+    def attend(self, query):
+        """Tied-head GEMM against the (vocab-sharded) table: ``[..., h] ->
+        [..., vocab_local]`` — the core of ``parallel_lm_logits``."""
+        weight = jnp.asarray(self.embedding, self.dtype)
+        return jnp.matmul(query, weight.T)
 
 
 class ColumnParallelLinear(nn.Module):

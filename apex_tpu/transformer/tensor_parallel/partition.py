@@ -1,0 +1,80 @@
+"""Parameter PartitionSpec inference — honest specs instead of grad hooks.
+
+The reference keeps replicated parameters (LayerNorm weights, row-linear
+biases) numerically consistent across tensor-parallel ranks by *stamping*
+them ``sequence_parallel`` and all-reducing their grads in a backward hook
+(``apex/transformer/layers/layer_norm.py:26-52``, ``tensor_parallel/
+layers.py:757``).  Under SPMD that machinery dissolves: pass each param into
+``shard_map`` with a spec that tells the truth — ``P()`` for replicated
+leaves, ``P(axis)`` on the sharded dim for partitioned leaves — and the
+shard_map transpose inserts the psum for replicated-leaf gradients itself.
+Wrong specs (e.g. stacking replicated params as if sharded) silently skip
+that psum and the ranks drift — exactly the bug class the reference's hooks
+guard against.
+
+:func:`infer_param_specs` builds the spec tree from path-pattern rules.
+``DEFAULT_RULES`` covers the canonical module names of the standalone LM and
+the tensor-parallel layers; models with custom names extend the rules (the
+t5x/praxis "logical axis rules" pattern, TPU-idiomatic).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Optional, Sequence, Tuple
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.parallel.mesh import TENSOR_AXIS
+
+__all__ = ["DEFAULT_RULES", "infer_param_specs"]
+
+# (path regex, spec template) — template entries: "tp" marks the sharded dim.
+# First match wins; no match = replicated.  Paths are "/".join of tree keys.
+DEFAULT_RULES: Tuple[Tuple[str, Tuple[Optional[str], ...]], ...] = (
+    # vocab-parallel embedding table: [vocab/tp, h]
+    (r"word_embeddings/embedding$", ("tp", None)),
+    # column-parallel linears (QKV, h->4h): kernel [out/tp, in], bias [out/tp]
+    (r"(query_key_value|query|key_value|dense_h_to_4h)/kernel$", ("tp", None)),
+    (r"(query_key_value|query|key_value|dense_h_to_4h)/bias$", ("tp",)),
+    # row-parallel linears (attention out, 4h->h): kernel [out, in/tp],
+    # bias replicated (added after the reduction, layers.py:806-812).
+    # NB: "dense" alone would also match the plain (replicated) pooler /
+    # BertLMHead denses, so the attention projection is matched by its
+    # parent module name.
+    (r"(self_attention/dense|inter_attention/dense|dense_4h_to_h)/kernel$",
+     (None, "tp")),
+    # BERT LM head bias is vocab-sharded like the embedding
+    (r"lm_head/bias$", ("tp",)),
+)
+
+
+def infer_param_specs(
+    params,
+    rules: Sequence[Tuple[str, Tuple[Optional[str], ...]]] = DEFAULT_RULES,
+    axis: str = TENSOR_AXIS,
+):
+    """PartitionSpec pytree for ``params`` from path-pattern ``rules``.
+
+    Rule templates use the literal string ``"tp"`` for the sharded dim; it is
+    substituted with ``axis``.  Unmatched leaves are replicated (``P()``) —
+    which is what makes their gradients correct under shard_map (see module
+    docstring).
+    """
+    compiled = [(re.compile(pat), tpl) for pat, tpl in rules]
+
+    def spec_for(path, leaf):
+        name = "/".join(str(getattr(k, "key", k)) for k in path)
+        for pat, tpl in compiled:
+            if pat.search(name):
+                resolved = tuple(axis if t == "tp" else t for t in tpl)
+                if len(resolved) > leaf.ndim:
+                    raise ValueError(
+                        f"rule {pat.pattern} spec {resolved} has more dims "
+                        f"than param {name} with shape {leaf.shape}"
+                    )
+                return P(*resolved)
+        return P()
+
+    return jax.tree_util.tree_map_with_path(spec_for, params)

@@ -1,0 +1,94 @@
+"""Standalone BERT — reference ``apex/transformer/testing/standalone_bert.py``.
+
+``BertModel``: bidirectional ``TransformerLanguageModel`` with pooler, tied
+LM head (layernorm + embedding-tied logits) and binary (NSP) head; loss =
+masked-LM CE + sentence-order CE (reference ``post_language_model_processing``
+and ``bert_extended_attention_mask``).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from apex_tpu.ops.softmax import AttnMaskType
+from apex_tpu.parallel.collectives import bound_axis_size
+from apex_tpu.transformer.layers.layer_norm import FusedLayerNorm
+from apex_tpu.transformer.testing.standalone_transformer_lm import (
+    TransformerConfig,
+    TransformerLanguageModel,
+    parallel_lm_logits,
+)
+
+__all__ = ["BertModel", "bert_extended_attention_mask"]
+
+
+def bert_extended_attention_mask(attention_mask):
+    """``[b, s]`` 1/0 padding mask → ``[b, 1, s, s]`` bool "masked-out" mask.
+
+    Reference ``standalone_bert.py`` / megatron ``bert_model.py``: attend
+    only where both query and key positions are real tokens; True = masked.
+    """
+    m = attention_mask.astype(bool)
+    both = m[:, None, :, None] & m[:, None, None, :]
+    return ~both
+
+
+class BertLMHead(nn.Module):
+    """Dense + gelu + LN, then embedding-tied logits (reference
+    ``BertLMHead``)."""
+
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, hidden, word_embeddings):
+        cfg = self.config
+        h = nn.Dense(cfg.hidden_size, dtype=cfg.dtype,
+                     param_dtype=cfg.param_dtype,
+                     kernel_init=cfg.init_method(), name="dense")(hidden)
+        h = nn.gelu(h)
+        h = FusedLayerNorm(cfg.hidden_size, eps=cfg.layernorm_epsilon,
+                           name="layernorm")(h)
+        # Bias is vocab-sharded like the embedding (reference sizes it to the
+        # local shard, megatron bert_model.py mpu_vocab_size).
+        world = bound_axis_size(cfg.tensor_axis)
+        bias = self.param(
+            "bias", nn.initializers.zeros,
+            (cfg.padded_vocab_size // world,), cfg.param_dtype,
+        )
+        return parallel_lm_logits(h, word_embeddings, cfg, bias=bias)
+
+
+class BertModel(nn.Module):
+    """Bidirectional LM + pooler + LM/NSP heads (reference
+    ``standalone_bert.py`` ``BertModel``)."""
+
+    config: TransformerConfig
+    add_binary_head: bool = True
+
+    def setup(self):
+        cfg = self.config
+        self.language_model = TransformerLanguageModel(
+            cfg, self_attn_mask_type=AttnMaskType.padding,
+            add_pooler=self.add_binary_head,
+        )
+        self.lm_head = BertLMHead(cfg)
+        if self.add_binary_head:
+            self.binary_head = nn.Dense(
+                2, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                kernel_init=cfg.init_method(),
+            )
+
+    def __call__(self, input_ids, attention_mask, position_ids=None,
+                 deterministic: bool = True):
+        ext_mask = bert_extended_attention_mask(attention_mask)
+        out = self.language_model(input_ids, position_ids, ext_mask,
+                                  deterministic=deterministic)
+        hidden, pooled = out if self.add_binary_head else (out, None)
+        lm_logits = self.lm_head(
+            hidden, self.language_model.embedding.word_embeddings
+        )
+        binary_logits = None
+        if self.add_binary_head:
+            binary_logits = self.binary_head(pooled)
+        return lm_logits, binary_logits

@@ -1,0 +1,112 @@
+"""Standalone GPT — reference ``apex/transformer/testing/standalone_gpt.py``.
+
+``GPTModel`` (reference ``:45``, wrapping ``TransformerLanguageModel`` with a
+causal mask and ``post_language_model_processing``: logits against the shared
+embedding + vocab-parallel cross entropy) plus the pipelined-stage helpers
+the SPMD schedules need (see
+:mod:`apex_tpu.transformer.pipeline_parallel.schedules` stage-homogeneity
+note).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.ops.softmax import AttnMaskType
+from apex_tpu.parallel.collectives import bound_axis_size
+from apex_tpu.ops.xentropy import softmax_cross_entropy_loss
+from apex_tpu.transformer.tensor_parallel import vocab_parallel_cross_entropy
+from apex_tpu.transformer.testing.standalone_transformer_lm import (
+    Embedding,
+    ParallelTransformerLayer,
+    TransformerConfig,
+    TransformerLanguageModel,
+    parallel_lm_logits,
+)
+
+__all__ = ["GPTModel", "gpt_loss", "init_gpt_layer_stack"]
+
+
+class GPTModel(nn.Module):
+    """GPT LM: causal ``TransformerLanguageModel`` + embedding-tied logits.
+
+    Forward returns per-token loss ``[b, s]`` when ``labels`` is given
+    (reference ``post_language_model_processing``), else logits
+    ``[s, b, vocab(/tp)]``.
+    """
+
+    config: TransformerConfig
+
+    def setup(self):
+        self.language_model = TransformerLanguageModel(
+            self.config, self_attn_mask_type=AttnMaskType.causal
+        )
+
+    def __call__(self, input_ids, position_ids=None, attention_mask=None,
+                 labels=None, deterministic: bool = True):
+        cfg = self.config
+        hidden = self.language_model(input_ids, position_ids, attention_mask,
+                                     deterministic=deterministic)
+        logits = parallel_lm_logits(
+            hidden, self.language_model.embedding.word_embeddings, cfg
+        )
+        if labels is None:
+            return logits
+        return gpt_loss(logits, labels, cfg)
+
+
+def gpt_loss(logits, labels, config: TransformerConfig):
+    """Per-token LM loss ``[b, s]`` from ``[s, b, v(/tp)]`` logits.
+
+    Vocab-parallel CE under tensor parallelism
+    (``tensor_parallel/cross_entropy.py:23-131``), fused max+logsumexp CE
+    (``apex/contrib/xentropy``) otherwise.
+    """
+    logits_bs = logits.transpose(1, 0, 2)  # [b, s, v]
+    world = bound_axis_size(config.tensor_axis)
+    if world > 1:
+        flat = logits_bs.reshape(-1, logits_bs.shape[-1])
+        loss = vocab_parallel_cross_entropy(flat, labels.reshape(-1),
+                                            axis=config.tensor_axis)
+    else:
+        loss = softmax_cross_entropy_loss(
+            logits_bs.reshape(-1, logits_bs.shape[-1]).astype(jnp.float32),
+            labels.reshape(-1),
+            padding_idx=-1,  # no padding label in LM loss
+        )
+    return loss.reshape(labels.shape)
+
+
+def init_gpt_layer_stack(key, config: TransformerConfig, sample_hidden,
+                         sample_mask=None):
+    """Init per-layer params for the pipelined GPT.
+
+    Returns ``(make_stage_fn, per_layer_params_list)``.
+    ``make_stage_fn(mask=None, deterministic=True, rngs=None)`` builds the
+    homogeneous ``stage_fn(layer_params, x)`` the rotation schedule consumes
+    — mask/dropout mode are bound per *call*, not frozen at init.
+
+    The pipelined decomposition: embedding and the loss head run outside the
+    rotation (replicated over ``pp``); the ``num_layers`` homogeneous
+    :class:`ParallelTransformerLayer` blocks are the virtual stages.
+    """
+    cfg = config
+    layer = ParallelTransformerLayer(
+        cfg, self_attn_mask_type=AttnMaskType.causal
+    )
+    keys = jax.random.split(key, cfg.num_layers)
+    per_layer = [
+        layer.init(k, sample_hidden, sample_mask)["params"] for k in keys
+    ]
+
+    def make_stage_fn(mask=None, deterministic: bool = True, rngs=None):
+        def stage_fn(layer_params, x):
+            return layer.apply({"params": layer_params}, x, mask,
+                               deterministic=deterministic, rngs=rngs)
+        return stage_fn
+
+    return make_stage_fn, per_layer

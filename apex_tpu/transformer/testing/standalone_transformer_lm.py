@@ -1,0 +1,478 @@
+"""Standalone Megatron-style transformer language model.
+
+Behavioral spec: ``apex/transformer/testing/standalone_transformer_lm.py`` —
+``ParallelMLP:165``, ``CoreAttention:213``, ``ParallelAttention:358``,
+``ParallelTransformerLayer:598``, ``ParallelTransformer:780``,
+``Embedding:1239``, ``TransformerLanguageModel:1358``,
+``parallel_lm_logits:1130`` — the reference's production-shaped GPT/BERT used
+by every distributed test and the GPT scaling harness.
+
+TPU-first notes
+---------------
+- Configuration is one dataclass (:class:`TransformerConfig`) instead of the
+  977-line Megatron argparser (``testing/arguments.py``) — SURVEY.md §5
+  config-system note.  Field names follow the reference's args.
+- Activations use the Megatron ``[s, b, h]`` layout so Megatron-style
+  sequence parallelism (first-dim sharding,
+  ``tensor_parallel/mappings.py:63-139``) applies unchanged.
+- Tensor parallelism: modules take the mesh axis name; run the model inside
+  ``shard_map`` with that axis bound (or ``tensor_model_parallel_size=1``
+  for plain jit).  XLA inserts/overlaps the collectives the reference
+  hand-schedules.
+- Pipeline parallelism: :class:`ParallelTransformerLayer` is the homogeneous
+  stage unit; stack per-layer params with
+  :func:`~apex_tpu.transformer.pipeline_parallel.stack_stage_params` and
+  drive them with :func:`~apex_tpu.transformer.pipeline_parallel.pipeline_apply`
+  (embedding/head live outside the pipelined region — see
+  ``standalone_gpt.py``).
+- Dropout uses the flax ``"dropout"`` rng; pass seeds derived with
+  :func:`apex_tpu.transformer.tensor_parallel.random.model_parallel_rng_key`
+  so tp ranks decorrelate exactly like the reference's
+  ``model_parallel_cuda_manual_seed`` (``random.py:204``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.ops.softmax import AttnMaskType, FusedScaleMaskSoftmax
+from apex_tpu.parallel.collectives import bound_axis_size
+from apex_tpu.parallel.mesh import TENSOR_AXIS
+from apex_tpu.transformer.enums import AttnType, LayerType
+from apex_tpu.transformer.layers.layer_norm import FusedLayerNorm
+from apex_tpu.transformer.tensor_parallel import (
+    ColumnParallelLinear,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+)
+from apex_tpu.transformer.tensor_parallel import mappings
+from apex_tpu.transformer.tensor_parallel.utils import divide
+
+__all__ = [
+    "TransformerConfig",
+    "ParallelMLP",
+    "CoreAttention",
+    "ParallelAttention",
+    "ParallelTransformerLayer",
+    "ParallelTransformer",
+    "Embedding",
+    "TransformerLanguageModel",
+    "parallel_lm_logits",
+    "Pooler",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    """The argparser surface the standalone LM consumes
+    (``testing/arguments.py`` defaults), as a dataclass."""
+
+    hidden_size: int = 128
+    num_layers: int = 2
+    num_attention_heads: int = 8
+    ffn_hidden_size: Optional[int] = None  # default 4*hidden
+    kv_channels: Optional[int] = None      # default hidden/heads
+    padded_vocab_size: int = 1024
+    max_position_embeddings: int = 512
+
+    hidden_dropout: float = 0.1
+    attention_dropout: float = 0.1
+    init_method_std: float = 0.02
+    layernorm_epsilon: float = 1e-5
+
+    apply_query_key_layer_scaling: bool = True
+    attention_softmax_in_fp32: bool = False
+    apply_residual_connection_post_layernorm: bool = False
+    bias_gelu_fusion: bool = True
+    masked_softmax_fusion: bool = True
+
+    sequence_parallel: bool = False
+    tensor_axis: Optional[str] = TENSOR_AXIS  # None = no tensor parallelism
+
+    dtype: Any = jnp.float32        # compute dtype (bf16 under the O2 policy)
+    param_dtype: Any = jnp.float32
+
+    @property
+    def ffn_size(self) -> int:
+        return self.ffn_hidden_size or 4 * self.hidden_size
+
+    @property
+    def head_dim(self) -> int:
+        return self.kv_channels or divide(self.hidden_size,
+                                          self.num_attention_heads)
+
+    def init_method(self):
+        """``init_method_normal`` (reference ``:96-103``)."""
+        return nn.initializers.normal(stddev=self.init_method_std)
+
+    def scaled_init_method(self):
+        """``scaled_init_method_normal`` — std/sqrt(2*num_layers) for
+        output-facing weights (reference ``:105-114``)."""
+        return nn.initializers.normal(
+            stddev=self.init_method_std / math.sqrt(2.0 * self.num_layers)
+        )
+
+
+class ParallelMLP(nn.Module):
+    """h → 4h (column, gelu) → h (row).  Reference ``ParallelMLP:165-212``:
+    the first GEMM keeps its output sharded, bias+gelu fuse
+    (``bias_gelu_fusion``), the second GEMM all-reduces (or
+    reduce-scatters under SP)."""
+
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        h, bias = ColumnParallelLinear(
+            cfg.hidden_size, cfg.ffn_size,
+            sequence_parallel=cfg.sequence_parallel,
+            skip_bias_add=True,
+            axis=cfg.tensor_axis,
+            kernel_init=cfg.init_method(),
+            dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+            name="dense_h_to_4h",
+        )(x)
+        # bias_gelu fusion (reference fused_bias_gelu.py): one fused
+        # elementwise region under XLA either way.
+        h = jax.nn.gelu(h + bias, approximate=cfg.bias_gelu_fusion)
+        out, out_bias = RowParallelLinear(
+            cfg.ffn_size, cfg.hidden_size,
+            input_is_parallel=True,
+            sequence_parallel=cfg.sequence_parallel,
+            skip_bias_add=True,
+            axis=cfg.tensor_axis,
+            kernel_init=cfg.scaled_init_method(),
+            dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+            name="dense_4h_to_h",
+        )(h)
+        return out, out_bias
+
+
+class CoreAttention(nn.Module):
+    """Scaled-dot-product attention core, reference ``CoreAttention:213-357``:
+    BMM1 → FusedScaleMaskSoftmax → attention dropout → BMM2, with
+    query-key layer scaling (scores divided by an extra ``layer_number``
+    factor, compensated inside the softmax scale — the fp16 overflow guard)."""
+
+    config: TransformerConfig
+    layer_number: int = 1
+    attn_mask_type: AttnMaskType = AttnMaskType.padding
+
+    @nn.compact
+    def __call__(self, q, k, v, mask, deterministic: bool = True):
+        cfg = self.config
+        # q/k/v: [s, b, n_local, d]
+        sq, b, n, d = q.shape
+        sk = k.shape[0]
+        norm_factor = math.sqrt(d)
+        coeff = None
+        if cfg.apply_query_key_layer_scaling:
+            coeff = max(1, self.layer_number)
+            norm_factor *= coeff
+
+        # BMM1: [b*n, sq, sk] on the MXU, accumulating fp32.
+        qt = q.transpose(1, 2, 0, 3).reshape(b * n, sq, d)
+        kt = k.transpose(1, 2, 0, 3).reshape(b * n, sk, d)
+        scores = jnp.matmul(
+            qt, kt.transpose(0, 2, 1),
+            preferred_element_type=jnp.float32,
+        ) / norm_factor
+        scores = scores.reshape(b, n, sq, sk).astype(
+            jnp.float32 if cfg.attention_softmax_in_fp32 else cfg.dtype
+        )
+
+        softmax = FusedScaleMaskSoftmax(
+            input_in_fp16=cfg.dtype == jnp.float16,
+            input_in_bf16=cfg.dtype == jnp.bfloat16,
+            attn_mask_type=self.attn_mask_type,
+            scaled_masked_softmax_fusion=cfg.masked_softmax_fusion,
+            mask_func=None,
+            softmax_in_fp32=True,
+            scale=coeff,
+        )
+        probs = softmax(scores, mask)
+        probs = nn.Dropout(rate=cfg.attention_dropout)(
+            probs, deterministic=deterministic
+        )
+        probs = probs.astype(cfg.dtype)
+
+        # BMM2 → context [s, b, n_local*d]
+        ctx = jax.lax.batch_matmul(
+            probs.reshape(b * n, sq, sk),
+            v.transpose(1, 2, 0, 3).reshape(b * n, sk, d),
+        )
+        ctx = ctx.reshape(b, n, sq, d).transpose(2, 0, 1, 3)
+        return ctx.reshape(sq, b, n * d)
+
+
+class ParallelAttention(nn.Module):
+    """Self/cross attention with TP-sharded heads.
+
+    Reference ``ParallelAttention:358-597``: fused QKV column linear
+    (3*h out-sharded), core attention over the local heads, row-linear output
+    projection with the residual-facing scaled init."""
+
+    config: TransformerConfig
+    layer_number: int = 1
+    attention_type: AttnType = AttnType.self_attn
+    attn_mask_type: AttnMaskType = AttnMaskType.padding
+
+    @nn.compact
+    def __call__(self, x, mask, encoder_output=None, deterministic=True):
+        cfg = self.config
+        world = bound_axis_size(cfg.tensor_axis)
+        n_local = divide(cfg.num_attention_heads, world)
+        d = cfg.head_dim
+        proj = cfg.num_attention_heads * d
+
+        if self.attention_type == AttnType.self_attn:
+            qkv = ColumnParallelLinear(
+                cfg.hidden_size, 3 * proj,
+                sequence_parallel=cfg.sequence_parallel,
+                axis=cfg.tensor_axis,
+                kernel_init=cfg.init_method(),
+                dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                name="query_key_value",
+            )(x)
+            s, b = qkv.shape[0], qkv.shape[1]
+            qkv = qkv.reshape(s, b, n_local, 3 * d)
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+        else:
+            q = ColumnParallelLinear(
+                cfg.hidden_size, proj,
+                sequence_parallel=cfg.sequence_parallel,
+                axis=cfg.tensor_axis, kernel_init=cfg.init_method(),
+                dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="query",
+            )(x)
+            kv = ColumnParallelLinear(
+                cfg.hidden_size, 2 * proj,
+                sequence_parallel=False, axis=cfg.tensor_axis,
+                kernel_init=cfg.init_method(),
+                dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                name="key_value",
+            )(encoder_output)
+            s, b = q.shape[0], q.shape[1]
+            q = q.reshape(s, b, n_local, d)
+            kv = kv.reshape(kv.shape[0], b, n_local, 2 * d)
+            k, v = jnp.split(kv, 2, axis=-1)
+
+        ctx = CoreAttention(
+            cfg, layer_number=self.layer_number,
+            attn_mask_type=self.attn_mask_type, name="core_attention",
+        )(q, k, v, mask, deterministic=deterministic)
+
+        out, bias = RowParallelLinear(
+            proj, cfg.hidden_size,
+            input_is_parallel=True,
+            sequence_parallel=cfg.sequence_parallel,
+            skip_bias_add=True,
+            axis=cfg.tensor_axis,
+            kernel_init=cfg.scaled_init_method(),
+            dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+            name="dense",
+        )(ctx)
+        return out, bias
+
+
+class ParallelTransformerLayer(nn.Module):
+    """Pre-LN transformer block, reference ``ParallelTransformerLayer:598-779``:
+    LN → attention → bias-dropout-residual → LN → MLP →
+    bias-dropout-residual, with optional post-LN residual source
+    (``apply_residual_connection_post_layernorm``)."""
+
+    config: TransformerConfig
+    layer_number: int = 1
+    layer_type: LayerType = LayerType.encoder
+    self_attn_mask_type: AttnMaskType = AttnMaskType.padding
+
+    @nn.compact
+    def __call__(self, x, mask, encoder_output=None, enc_dec_mask=None,
+                 deterministic: bool = True):
+        cfg = self.config
+        ln1 = FusedLayerNorm(cfg.hidden_size, eps=cfg.layernorm_epsilon,
+                             name="input_layernorm")(x)
+        attn_out, attn_bias = ParallelAttention(
+            cfg, layer_number=self.layer_number,
+            attn_mask_type=self.self_attn_mask_type, name="self_attention",
+        )(ln1, mask, deterministic=deterministic)
+        residual = ln1 if cfg.apply_residual_connection_post_layernorm else x
+        h = residual + nn.Dropout(rate=cfg.hidden_dropout)(
+            attn_out + attn_bias, deterministic=deterministic
+        )
+
+        if self.layer_type == LayerType.decoder:
+            ln_cross = FusedLayerNorm(
+                cfg.hidden_size, eps=cfg.layernorm_epsilon,
+                name="post_inter_attention_layernorm",
+            )(h)
+            cross_out, cross_bias = ParallelAttention(
+                cfg, layer_number=self.layer_number,
+                attention_type=AttnType.cross_attn,
+                attn_mask_type=AttnMaskType.padding,
+                name="inter_attention",
+            )(ln_cross, enc_dec_mask, encoder_output=encoder_output,
+              deterministic=deterministic)
+            residual = (ln_cross
+                        if cfg.apply_residual_connection_post_layernorm else h)
+            h = residual + nn.Dropout(rate=cfg.hidden_dropout)(
+                cross_out + cross_bias, deterministic=deterministic
+            )
+
+        ln2 = FusedLayerNorm(cfg.hidden_size, eps=cfg.layernorm_epsilon,
+                             name="post_attention_layernorm")(h)
+        mlp_out, mlp_bias = ParallelMLP(cfg, name="mlp")(ln2)
+        residual = ln2 if cfg.apply_residual_connection_post_layernorm else h
+        return residual + nn.Dropout(rate=cfg.hidden_dropout)(
+            mlp_out + mlp_bias, deterministic=deterministic
+        )
+
+
+class ParallelTransformer(nn.Module):
+    """Layer stack + final LN, reference ``ParallelTransformer:780-1129``.
+
+    ``post_process`` controls the final LayerNorm exactly like the
+    reference's pipeline-stage flags; the per-layer loop is a Python loop
+    (layers are distinct flax submodules with their own params — the
+    pipelined path instead stacks layer params and uses ``pipeline_apply``).
+    """
+
+    config: TransformerConfig
+    self_attn_mask_type: AttnMaskType = AttnMaskType.causal
+    pre_process: bool = True
+    post_process: bool = True
+
+    @nn.compact
+    def __call__(self, x, mask, deterministic: bool = True):
+        cfg = self.config
+        for i in range(cfg.num_layers):
+            x = ParallelTransformerLayer(
+                cfg, layer_number=i + 1,
+                self_attn_mask_type=self.self_attn_mask_type,
+                name=f"layers_{i}",
+            )(x, mask, deterministic=deterministic)
+        if self.post_process:
+            x = FusedLayerNorm(cfg.hidden_size, eps=cfg.layernorm_epsilon,
+                               name="final_layernorm")(x)
+        return x
+
+
+class Embedding(nn.Module):
+    """Word (vocab-parallel) + learned position embeddings + dropout,
+    reference ``Embedding:1239-1357``.  Output is ``[s, b, h]``; under SP the
+    caller scatters the sequence dim
+    (``scatter_to_sequence_parallel_region``)."""
+
+    config: TransformerConfig
+    add_position_embedding: bool = True
+
+    # setup-style so ``word_embeddings`` is shareable for the tied LM head.
+    def setup(self):
+        cfg = self.config
+        self.word_embeddings = VocabParallelEmbedding(
+            cfg.padded_vocab_size, cfg.hidden_size,
+            axis=cfg.tensor_axis,
+            embedding_init=cfg.init_method(),
+            dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+        )
+        if self.add_position_embedding:
+            self.position_embeddings = nn.Embed(
+                cfg.max_position_embeddings, cfg.hidden_size,
+                embedding_init=cfg.init_method(),
+                dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+            )
+        self.dropout = nn.Dropout(rate=cfg.hidden_dropout)
+
+    def __call__(self, token_ids, position_ids=None, deterministic=True):
+        cfg = self.config
+        words = self.word_embeddings(token_ids)  # [b, s, h]
+        if self.add_position_embedding:
+            if position_ids is None:
+                position_ids = jnp.arange(token_ids.shape[1])[None, :]
+            words = words + self.position_embeddings(position_ids)
+        x = words.transpose(1, 0, 2)  # [s, b, h] Megatron layout
+        if cfg.sequence_parallel and bound_axis_size(cfg.tensor_axis) > 1:
+            x = mappings.scatter_to_sequence_parallel_region(
+                x, cfg.tensor_axis
+            )
+        return self.dropout(x, deterministic=deterministic)
+
+
+class Pooler(nn.Module):
+    """Tanh pooler over a sequence index, reference ``Pooler:1190-1238``."""
+
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, hidden, sequence_index: int = 0):
+        cfg = self.config
+        pooled = hidden[sequence_index]  # [b, h]
+        return jnp.tanh(
+            nn.Dense(cfg.hidden_size, dtype=cfg.dtype,
+                     param_dtype=cfg.param_dtype,
+                     kernel_init=cfg.init_method(), name="dense")(pooled)
+        )
+
+
+def parallel_lm_logits(hidden, word_embeddings, config: TransformerConfig,
+                       bias=None):
+    """LM head sharing the (vocab-sharded) embedding matrix.
+
+    Reference ``parallel_lm_logits:1130-1189``: under SP first all-gather the
+    sequence shards, then the column-parallel GEMM against the embedding
+    table; output stays vocab-sharded for
+    :func:`~apex_tpu.transformer.tensor_parallel.vocab_parallel_cross_entropy`.
+    Input ``[s, b, h]`` → logits ``[s, b, vocab_local]``.
+    """
+    world = bound_axis_size(config.tensor_axis)
+    if config.sequence_parallel and world > 1:
+        hidden = mappings.gather_from_sequence_parallel_region(
+            hidden, config.tensor_axis, True
+        )
+    elif world > 1:
+        hidden = mappings.copy_to_tensor_model_parallel_region(
+            hidden, config.tensor_axis
+        )
+    if hasattr(word_embeddings, "attend"):
+        # Bound VocabParallelEmbedding module: tied-weight GEMM.
+        logits = word_embeddings.attend(hidden)
+    else:
+        logits = jnp.einsum("sbh,vh->sbv", hidden,
+                            jnp.asarray(word_embeddings, hidden.dtype))
+    if bias is not None:
+        logits = logits + bias
+    return logits
+
+
+class TransformerLanguageModel(nn.Module):
+    """Embedding + transformer (+ pooler), reference
+    ``TransformerLanguageModel:1358-1529``."""
+
+    config: TransformerConfig
+    self_attn_mask_type: AttnMaskType = AttnMaskType.causal
+    add_pooler: bool = False
+
+    def setup(self):
+        cfg = self.config
+        self.embedding = Embedding(cfg)
+        self.encoder = ParallelTransformer(
+            cfg, self_attn_mask_type=self.self_attn_mask_type
+        )
+        if self.add_pooler:
+            self.pooler = Pooler(cfg)
+
+    def __call__(self, token_ids, position_ids=None, attention_mask=None,
+                 deterministic: bool = True, pooling_sequence_index: int = 0):
+        x = self.embedding(token_ids, position_ids,
+                           deterministic=deterministic)
+        hidden = self.encoder(x, attention_mask, deterministic=deterministic)
+        if self.add_pooler:
+            pooled = self.pooler(hidden, pooling_sequence_index)
+            return hidden, pooled
+        return hidden
