@@ -8,6 +8,8 @@ TPU-native replacements for the reference's fused CUDA op zoo:
 - :mod:`dense` — GEMM+bias(+GeLU) epilogue fusions
   (``csrc/fused_dense_cuda.cu``, ``apex/fused_dense``)
 - :mod:`mlp` — whole-MLP forward/backward (``csrc/mlp_cuda.cu``, ``apex/mlp``)
+- :mod:`flash_attention` — Pallas blockwise attention kernels
+  (``apex/contrib/csrc/fmha``, ``apex/contrib/multihead_attn`` parity)
 - :mod:`xentropy` — softmax-cross-entropy saving only max+logsumexp
   (``apex/contrib/csrc/xentropy``)
 - :mod:`pallas_norm` — Pallas row-norm fast path
@@ -31,3 +33,8 @@ from apex_tpu.ops.dense import (  # noqa: F401
 from apex_tpu.ops.mlp import MLP, mlp_forward  # noqa: F401
 from apex_tpu.ops.xentropy import softmax_cross_entropy_loss  # noqa: F401
 from apex_tpu.ops import pallas_norm  # noqa: F401
+
+from apex_tpu.ops.flash_attention import (  # noqa: E402
+    flash_attention,
+    flash_attention_with_lse,
+)

@@ -15,12 +15,13 @@ code keeps its import path.
 """
 
 from apex_tpu.parallel import mesh as parallel_state
-from apex_tpu.transformer import pipeline_parallel, tensor_parallel
+from apex_tpu.transformer import context_parallel, pipeline_parallel, tensor_parallel
 from apex_tpu.transformer.pipeline_parallel import get_forward_backward_func
 
 __all__ = [
     "parallel_state",
     "tensor_parallel",
     "pipeline_parallel",
+    "context_parallel",
     "get_forward_backward_func",
 ]

@@ -24,6 +24,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from apex_tpu.amp.scaler import DynamicLossScale, LossScaleState, all_finite
+from apex_tpu.parallel.collectives import bound_axis_size
 from apex_tpu.parallel.mesh import PIPELINE_AXIS, TENSOR_AXIS
 
 __all__ = ["GradScaler"]
@@ -55,13 +56,7 @@ class GradScaler(DynamicLossScale):
         """
         finite = all_finite(grads)
         use = self.model_parallel_axes if axes is None else tuple(axes)
-        bound = []
-        for ax in use:
-            try:
-                lax.axis_size(ax)
-            except NameError:
-                continue
-            bound.append(ax)
+        bound = [ax for ax in use if bound_axis_size(ax) > 1]
         if bound:
             finite = lax.pmin(finite.astype(jnp.int32), tuple(bound)) > 0
         return finite

@@ -1,0 +1,216 @@
+"""Context parallelism: ring attention and Ulysses (all-to-all) attention.
+
+The reference has **no long-context support** — sequence handling caps at
+the fused softmax's 16384 keys (``apex/transformer/functional/
+fused_softmax.py:233``) and FMHA's 512 (``apex/contrib/csrc/fmha``); SURVEY.md
+§2.5/§5 designates ring/Ulysses context parallelism as the first-class
+capability-parity-plus item of the TPU build.
+
+**Ring attention** (blockwise attention over the ``cp`` mesh axis): every
+rank holds a sequence shard of q/k/v; K/V chunks rotate around the ring via
+``lax.ppermute`` (ICI neighbor hops) while each rank folds the visiting chunk
+into its flash accumulator (running lse merge).  Peak memory is one sequence
+shard + one visiting chunk; total sequence length scales linearly with the
+ring size.
+
+The backward is a custom VJP at the *ring* level — the flash-backward
+identity (a chunk's gradient depends on other chunks only through the global
+``lse`` and ``delta = rowsum(do*o)``) lets each reverse ring step re-drive
+the per-chunk Pallas kernels (:func:`apex_tpu.ops.flash_attention.dq_chunk` /
+:func:`dkv_chunk`) with the already-known global statistics: ``dq``
+accumulates locally, ``dk/dv`` travel with their chunk and arrive home after
+a full rotation.
+
+**Ulysses attention** (DeepSpeed-Ulysses style): ``all_to_all`` swaps the
+sharded dim from sequence to heads, each rank runs full-sequence flash
+attention on ``heads/cp`` heads, and a second ``all_to_all`` swaps back.
+Plain collectives, differentiable as-is (the transpose of an all-to-all is
+the reverse all-to-all).
+
+Both run inside ``shard_map`` with the ``cp`` axis bound; tensors are local
+shards ``[b, h, s_local, d]``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from apex_tpu.ops.flash_attention import (
+    dkv_chunk,
+    dq_chunk,
+    flash_attention_with_lse,
+)
+from apex_tpu.parallel.mesh import CONTEXT_AXIS
+
+__all__ = ["ring_attention", "ulysses_attention"]
+
+
+def _merge(o, lse, o_new, lse_new):
+    """Fold a partial (o_new, lse_new) into the running (o, lse)."""
+    lse_tot = jnp.logaddexp(lse, lse_new)
+    # Guard -inf - -inf when a row has seen nothing anywhere yet.
+    w_old = jnp.exp(jnp.where(lse == lse_tot, 0.0, lse - lse_tot))
+    w_old = jnp.where(jnp.isfinite(lse), w_old, 0.0)
+    w_new = jnp.exp(jnp.where(lse_new == lse_tot, 0.0, lse_new - lse_tot))
+    w_new = jnp.where(jnp.isfinite(lse_new), w_new, 0.0)
+    o_tot = o * w_old[..., None] + o_new.astype(o.dtype) * w_new[..., None]
+    return o_tot, lse_tot
+
+
+def _rotate(tree, axis):
+    n = lax.axis_size(axis)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    return jax.tree_util.tree_map(lambda l: lax.ppermute(l, axis, perm), tree)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def ring_attention(q, k, v, axis: str = CONTEXT_AXIS, causal: bool = True,
+                   scale: Optional[float] = None):
+    """Flash attention over a ring-sharded sequence.
+
+    ``q, k, v``: local shards ``[b, h, s_local, d]`` of a sequence of global
+    length ``s_local * cp``; rank ``r`` owns positions
+    ``[r*s_local, (r+1)*s_local)``.  Returns the local output shard.
+    """
+    out, _ = _ring_fwd_math(q, k, v, axis, causal, scale)
+    return out
+
+
+def _ring_fwd_math(q, k, v, axis, causal, scale):
+    cp = lax.axis_size(axis)
+    r = lax.axis_index(axis)
+    b, h, s_local, d = q.shape
+
+    o = jnp.zeros(q.shape, jnp.float32)
+    lse = jnp.full((b, h, s_local), -jnp.inf, jnp.float32)
+    kv = (k, v)
+
+    def step(t, carry):
+        o, lse, kv = carry
+        k_cur, v_cur = kv
+        chunk = (r - t) % cp  # home rank of the visiting chunk
+        o_t, lse_t = _chunk_attn(q, k_cur, v_cur, causal, scale, r, chunk)
+        o, lse = _merge(o, lse, o_t, lse_t)
+        kv = _rotate(kv, axis)
+        return o, lse, kv
+
+    o, lse, _ = lax.fori_loop(0, cp, step, (o, lse, kv))
+    return o.astype(q.dtype), lse
+
+
+def _causal_case(chunk, r):
+    """0 = fully visible (chunk < r), 1 = diagonal (==), 2 = masked (>).
+
+    Offsets are traced under the ring loop but the Pallas kernels need
+    static ones, so causal masking is decided at shard granularity: a whole
+    earlier chunk is fully visible, the home chunk masks causally with
+    offset 0, a later chunk contributes nothing.
+    """
+    return jnp.where(chunk < r, 0, jnp.where(chunk == r, 1, 2))
+
+
+def _chunk_attn(q, k_cur, v_cur, causal, scale, r, chunk):
+    if not causal:
+        return flash_attention_with_lse(q, k_cur, v_cur, False, scale)
+
+    def full(_):
+        return flash_attention_with_lse(q, k_cur, v_cur, False, scale)
+
+    def diag(_):
+        return flash_attention_with_lse(q, k_cur, v_cur, True, scale)
+
+    def masked(_):
+        b, h, s_local, _d = q.shape
+        return (jnp.zeros(q.shape, q.dtype),
+                jnp.full((b, h, s_local), -jnp.inf, jnp.float32))
+
+    return lax.switch(_causal_case(chunk, r), [full, diag, masked], None)
+
+
+def _ring_vjp_fwd(q, k, v, axis, causal, scale):
+    out, lse = _ring_fwd_math(q, k, v, axis, causal, scale)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_vjp_bwd(axis, causal, scale, res, do):
+    q, k, v, out, lse = res
+    cp = lax.axis_size(axis)
+    r = lax.axis_index(axis)
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+
+    dq = jnp.zeros(q.shape, jnp.float32)
+    # dk/dv accumulators travel with their chunk: start at home, after cp
+    # rotations they are home again.
+    state = (k, v, jnp.zeros(k.shape, jnp.float32),
+             jnp.zeros(v.shape, jnp.float32))
+
+    def step(t, carry):
+        dq, state = carry
+        k_cur, v_cur, dk_acc, dv_acc = state
+        chunk = (r - t) % cp
+
+        def grads(is_causal):
+            dq_t = dq_chunk(q, k_cur, v_cur, do, lse, delta,
+                            causal=is_causal, scale=scale)
+            dk_t, dv_t = dkv_chunk(q, k_cur, v_cur, do, lse, delta,
+                                   causal=is_causal, scale=scale)
+            return dq_t, dk_t, dv_t
+
+        if causal:
+            def zeros(_):
+                return (jnp.zeros_like(q), jnp.zeros_like(k_cur),
+                        jnp.zeros_like(v_cur))
+
+            dq_t, dk_t, dv_t = lax.switch(
+                _causal_case(chunk, r),
+                [lambda _: grads(False), lambda _: grads(True), zeros],
+                None,
+            )
+        else:
+            dq_t, dk_t, dv_t = grads(False)
+
+        dq = dq + dq_t.astype(jnp.float32)
+        dk_acc = dk_acc + dk_t.astype(jnp.float32)
+        dv_acc = dv_acc + dv_t.astype(jnp.float32)
+        state = _rotate((k_cur, v_cur, dk_acc, dv_acc), axis)
+        return dq, state
+
+    dq, state = lax.fori_loop(0, cp, step, (dq, state))
+    _, _, dk, dv = state
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+ring_attention.defvjp(_ring_vjp_fwd, _ring_vjp_bwd)
+
+
+def ulysses_attention(q, k, v, axis: str = CONTEXT_AXIS,
+                      causal: bool = True, scale: Optional[float] = None):
+    """All-to-all (DeepSpeed-Ulysses) sequence-parallel attention.
+
+    Local shards ``[b, h, s_local, d]`` with ``h % cp == 0``: a2a to
+    ``[b, h/cp, s_global, d]``, full-sequence flash attention, a2a back.
+    One a2a pair per call versus ring's ``cp`` neighbor hops — better when
+    ``h >= cp`` and the sequence fits a single rank's VMEM streaming.
+    """
+    cp = lax.axis_size(axis)
+    if q.shape[1] % cp != 0:
+        raise ValueError(
+            f"heads ({q.shape[1]}) must be divisible by cp ({cp})"
+        )
+    # [b, h, s_local, d] -> [b, h/cp, s_global, d]
+    def scatter_heads(x):
+        return lax.all_to_all(x, axis, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    def gather_heads(x):
+        return lax.all_to_all(x, axis, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    qg, kg, vg = scatter_heads(q), scatter_heads(k), scatter_heads(v)
+    out, _ = flash_attention_with_lse(qg, kg, vg, causal, scale)
+    return gather_heads(out)

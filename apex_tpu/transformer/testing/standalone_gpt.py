@@ -10,8 +10,6 @@ note).
 
 from __future__ import annotations
 
-from typing import Optional
-
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
@@ -21,7 +19,6 @@ from apex_tpu.parallel.collectives import bound_axis_size
 from apex_tpu.ops.xentropy import softmax_cross_entropy_loss
 from apex_tpu.transformer.tensor_parallel import vocab_parallel_cross_entropy
 from apex_tpu.transformer.testing.standalone_transformer_lm import (
-    Embedding,
     ParallelTransformerLayer,
     TransformerConfig,
     TransformerLanguageModel,

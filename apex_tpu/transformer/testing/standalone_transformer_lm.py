@@ -91,6 +91,9 @@ class TransformerConfig:
     apply_residual_connection_post_layernorm: bool = False
     bias_gelu_fusion: bool = True
     masked_softmax_fusion: bool = True
+    # Pallas flash attention for the causal core (no score matrix in HBM);
+    # falls back to the fused-softmax path for padding masks / dropout.
+    use_flash_attention: bool = False
 
     sequence_parallel: bool = False
     tensor_axis: Optional[str] = TENSOR_AXIS  # None = no tensor parallelism
@@ -171,6 +174,17 @@ class CoreAttention(nn.Module):
         # q/k/v: [s, b, n_local, d]
         sq, b, n, d = q.shape
         sk = k.shape[0]
+
+        if (cfg.use_flash_attention
+                and self.attn_mask_type == AttnMaskType.causal
+                and (cfg.attention_dropout == 0.0 or deterministic)):
+            from apex_tpu.ops.flash_attention import flash_attention
+            ctx = flash_attention(
+                q.transpose(1, 2, 0, 3), k.transpose(1, 2, 0, 3),
+                v.transpose(1, 2, 0, 3), causal=True,
+            )  # [b, n, sq, d]
+            return ctx.transpose(2, 0, 1, 3).reshape(sq, b, n * d)
+
         norm_factor = math.sqrt(d)
         coeff = None
         if cfg.apply_query_key_layer_scaling:

@@ -226,5 +226,7 @@ def test_reference_import_paths():
         AttnMaskType, AttnType, LayerType, ModelType,
     )
     from apex_tpu.transformer.layers import FusedLayerNorm  # noqa: F401
-    from apex_tpu.transformer import tensor_parallel as tp
-from apex_tpu.transformer.amp import GradScaler  # noqa: F401
+    from apex_tpu.transformer.tensor_parallel import (  # noqa: F401
+        infer_param_specs,
+    )
+    from apex_tpu.transformer.amp import GradScaler  # noqa: F401
