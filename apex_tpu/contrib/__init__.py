@@ -1,0 +1,1 @@
+"""apex_tpu.contrib — optional extensions (reference ``apex/contrib``)."""

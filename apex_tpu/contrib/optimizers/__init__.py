@@ -1,0 +1,11 @@
+"""Distributed (ZeRO-sharded) optimizers — reference
+``apex/contrib/optimizers``."""
+
+from apex_tpu.contrib.optimizers.distributed_fused_adam import (
+    DistributedFusedAdam,
+)
+from apex_tpu.contrib.optimizers.distributed_fused_lamb import (
+    DistributedFusedLAMB,
+)
+
+__all__ = ["DistributedFusedAdam", "DistributedFusedLAMB"]
