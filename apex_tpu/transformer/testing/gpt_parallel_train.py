@@ -1,0 +1,203 @@
+"""Full 3D-parallel GPT training step: dp × pp(×vpp) × tp(+sp).
+
+The integration point of the whole runtime — the analog of the reference's
+GPT pipeline test/production shape (``tests/L0/run_transformer/
+test_pipeline_parallel_fwd_bwd.py``, ``gpt_scaling_test.py``): vocab/tensor-
+parallel embedding and layers (``tp`` axis, Megatron sequence parallelism),
+the rotation pipeline over ``pp`` with virtual chunks, data parallelism over
+``dp``, vocab-parallel cross entropy, and a fused optimizer — all inside
+ONE ``shard_map`` over the mesh, with *honest* per-leaf PartitionSpecs so
+every gradient reduction (dp grad psum, SP replicated-param psum) is
+inserted by the shard_map transpose rather than hand-written (see
+:mod:`apex_tpu.transformer.tensor_parallel.partition`).
+
+Layer-stack layout: per-layer params are stacked virtual-stage-major
+``[L, ...]`` and reshaped to ``[vpp, pp, ...]`` so the ``pp`` dim shards
+(chunk ``c`` of stage ``s`` = virtual stage ``c*pp + s`` — the interleaved
+schedule's chunk mapping, ``fwd_bwd_pipelining_with_interleaving.py:221``).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.ops.softmax import AttnMaskType
+from apex_tpu.parallel import collectives as cc
+from apex_tpu.parallel.mesh import (
+    DATA_AXIS,
+    PIPELINE_AXIS,
+    TENSOR_AXIS,
+)
+from apex_tpu.transformer.pipeline_parallel.schedules import (
+    pipeline_apply,
+    split_into_microbatches,
+)
+from apex_tpu.transformer.tensor_parallel import infer_param_specs
+from apex_tpu.transformer.layers.layer_norm import FusedLayerNorm
+from apex_tpu.transformer.testing.standalone_gpt import gpt_loss
+from apex_tpu.transformer.testing.standalone_transformer_lm import (
+    Embedding,
+    ParallelTransformerLayer,
+    TransformerConfig,
+    parallel_lm_logits,
+)
+
+__all__ = ["GPT3DParams", "build_gpt_3d"]
+
+
+class GPT3DParams(NamedTuple):
+    embedding: dict
+    layers: dict      # stacked [vpp, pp, ...]
+    final_ln: dict
+
+
+def _prepend(spec_tree, *dims):
+    return jax.tree_util.tree_map(
+        lambda s: P(*dims, *tuple(s)), spec_tree,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def build_gpt_3d(
+    config: TransformerConfig,
+    *,
+    num_chunks: int = 1,
+    num_microbatches: int = 2,
+    mesh=None,
+    dp_axis: str = DATA_AXIS,
+    pp_axis: str = PIPELINE_AXIS,
+    tp_axis: str = TENSOR_AXIS,
+):
+    """Return ``(init_fn, train_step, param_specs_fn)``.
+
+    - ``init_fn(rng, sample_tokens) -> (params, param_specs)`` — global
+      arrays with their PartitionSpec tree (params built under a tp-only
+      shard_map so vocab/width shards initialize per-rank).
+    - ``train_step(params, opt_state, tokens, opt) -> (params, opt_state,
+      loss)`` — call under ``jax.jit``; internally one shard_map over
+      (dp, pp, tp).
+
+    ``config.num_layers`` must equal ``pp * num_chunks`` (one transformer
+    layer per virtual stage); ``tokens: [global_batch, seq]`` sharded on dp.
+    """
+    cfg = config
+    if mesh is None:
+        from apex_tpu.parallel.mesh import get_mesh
+        mesh = get_mesh()
+    pp = mesh.shape[pp_axis]
+    vpp = num_chunks
+    if cfg.num_layers != pp * vpp:
+        raise ValueError(
+            f"num_layers ({cfg.num_layers}) != pp*vpp ({pp}*{vpp})"
+        )
+
+    embed = Embedding(cfg)
+    layer = ParallelTransformerLayer(
+        cfg, self_attn_mask_type=AttnMaskType.causal
+    )
+    final_ln = FusedLayerNorm(cfg.hidden_size, eps=cfg.layernorm_epsilon)
+
+    def init_fn(rng, sample_tokens):
+        mb_tokens = sample_tokens[: max(1, sample_tokens.shape[0]
+                                        // num_microbatches)]
+
+        def local_init(tokens):
+            e = embed.init(rng, tokens)["params"]
+            h = embed.apply({"params": e}, tokens)
+            per_layer = [
+                layer.init(jax.random.fold_in(rng, i), h, None)["params"]
+                for i in range(cfg.num_layers)
+            ]
+            stacked = jax.tree_util.tree_map(
+                lambda *ls: jnp.stack(ls), *per_layer
+            )
+            ln = final_ln.init(jax.random.fold_in(rng, 10_000), h)["params"]
+            return e, stacked, ln
+
+        shapes = jax.eval_shape(local_init, mb_tokens)
+        e_specs = infer_param_specs(shapes[0], axis=tp_axis)
+        l_specs = _prepend(infer_param_specs(
+            jax.tree_util.tree_map(
+                lambda l: jax.ShapeDtypeStruct(l.shape[1:], l.dtype),
+                shapes[1],
+            ), axis=tp_axis
+        ), None)  # [L, ...] replicated stack dim at init time
+        ln_specs = jax.tree_util.tree_map(lambda _: P(), shapes[2])
+
+        e, stacked, ln = cc.shard_over(
+            local_init, mesh=mesh, in_specs=(P(),),
+            out_specs=(e_specs, l_specs, ln_specs),
+        )(mb_tokens)
+
+        # [L, ...] virtual-stage major -> [vpp, pp, ...]; pp dim shards.
+        stacked = jax.tree_util.tree_map(
+            lambda l: l.reshape((vpp, pp) + l.shape[1:]), stacked
+        )
+        layer_specs = _prepend(infer_param_specs(
+            jax.tree_util.tree_map(lambda l: l[0, 0], stacked), axis=tp_axis
+        ), None, pp_axis)
+
+        params = GPT3DParams(embedding=e, layers=stacked, final_ln=ln)
+        specs = GPT3DParams(embedding=e_specs, layers=layer_specs,
+                            final_ln=ln_specs)
+        return params, specs
+
+    def _local_loss(p: GPT3DParams, tokens):
+        """Mean LM loss of the local dp shard; runs with dp/pp/tp bound."""
+        mbs = split_into_microbatches(tokens, num_microbatches)
+
+        def embed_one(t):
+            return embed.apply({"params": p.embedding}, t)
+
+        h = jax.vmap(embed_one)(mbs)  # [m, s(/tp), mb, hid]
+
+        def stage_fn(lp, x):
+            return layer.apply({"params": lp}, x, None)
+
+        out = pipeline_apply(
+            stage_fn, p.layers, h, axis=pp_axis, num_chunks=vpp,
+            params_already_local=True,
+        )
+
+        def head_one(hid, t):
+            hid = final_ln.apply({"params": p.final_ln}, hid)
+            logits = parallel_lm_logits(
+                hid, p.embedding["word_embeddings"]["embedding"], cfg
+            )
+            return jnp.mean(gpt_loss(logits, t, cfg))
+
+        losses = jax.vmap(head_one)(out, mbs)
+        return jnp.mean(losses)
+
+    def make_loss_fn(param_specs):
+        """Global (dp-mean) loss over global arrays.
+
+        ``jax.grad`` of THIS function is the supported way to train: the
+        shard_map transpose then inserts every cross-rank gradient
+        reduction — dp psum for all params, tp psum for SP-replicated
+        norms/biases — because the specs tell the truth about replication
+        (tensor_parallel/partition.py).  Taking grads *inside* the
+        shard_map instead would silently drop the dp reduction.
+        """
+        return cc.shard_over(
+            lambda p, t: cc.all_reduce(_local_loss(p, t), dp_axis, "mean"),
+            mesh=mesh,
+            in_specs=(param_specs, P(dp_axis)),
+            out_specs=P(),
+        )
+
+    def make_train_step(opt, param_specs):
+        loss_fn = make_loss_fn(param_specs)
+
+        def step(params, state, tokens):
+            loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
+            new_p, new_state = opt.step(grads, state, params)
+            return new_p, new_state, loss
+
+        return step
+
+    return init_fn, make_loss_fn, make_train_step
