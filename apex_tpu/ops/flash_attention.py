@@ -1,10 +1,11 @@
 """Flash attention — Pallas TPU kernels with a custom VJP.
 
 Capability parity target: ``apex/contrib/fmha`` (fixed-shape fp16 fused MHA,
-seqlens ≤512, ``apex/contrib/csrc/fmha/fmha_api.cpp``) and the fused
-softmax-attention core of ``apex/contrib/multihead_attn`` — rebuilt as a
-*blockwise online-softmax* kernel family with none of the shape limits
-(any seqlen, any head dim that tiles to the MXU, fp32/bf16).
+seqlens ≤512, varlen via cu_seqlens, dropout —
+``apex/contrib/csrc/fmha/fmha_api.cpp``) and the fused softmax-attention
+core of ``apex/contrib/multihead_attn`` — rebuilt as a *blockwise
+online-softmax* kernel family with none of the shape limits (any seqlen,
+any head dim that tiles to the MXU, fp32/bf16).
 
 Design (the standard flash decomposition, mapped to TPU):
 
@@ -21,6 +22,21 @@ Design (the standard flash decomposition, mapped to TPU):
   ``dq`` (k innermost, dq in scratch), a second forms ``dk/dv`` over the
   transposed blocking (q innermost), both seeded with
   ``delta = rowsum(do * o)`` computed in plain XLA.
+- **causal block skipping**: fully-masked (q-block, k-block) pairs are
+  skipped with ``pl.when`` (no MXU work) and their K/V block index maps are
+  clamped to the last live block so Pallas elides the HBM→VMEM copy —
+  the ~2× FLOP saving of a production causal kernel.
+- **segment masking / varlen**: optional per-token integer segment ids
+  (must be ≥ 0) mask attention across segment boundaries — the TPU-native
+  form of fmha's ``cu_seqlens`` packed-varlen API (a packed batch is one
+  row with increasing segment ids; padding = any position whose id differs).
+  Non-multiple-of-block sequence lengths are handled by padding to the
+  block grid with sentinel segment ids, so any length compiles.
+- **attention dropout**: counter-based (seed, batch·head, row, col) hash →
+  keep mask, regenerated bit-identically in the backward kernels, so no
+  dropout mask is ever materialised in HBM.  Matches the reference's
+  "dropout after softmax" semantics: the row normaliser ``l`` accumulates
+  *undropped* probabilities.
 - ``q_offset``/``kv_offset`` place a q/k shard at its global sequence
   position so causal masking stays correct when the sequence is sharded —
   the hook ring attention (context parallelism,
@@ -28,13 +44,17 @@ Design (the standard flash decomposition, mapped to TPU):
   entry points (:func:`dq_chunk`, :func:`dkv_chunk`) are exposed for the
   same reason: ring backward re-drives them per visiting chunk with the
   *global* lse.
+- fully-masked q rows (reachable with offset combinations or segment ids)
+  produce **zero** output and ``lse = -1e30``: the running max is clamped
+  before the exp so masked-out scores can never contribute unit mass
+  (the ``exp(NEG_INF - NEG_INF) = 1`` failure mode).
 - ``interpret=True`` is selected automatically off-TPU so the same code runs
   in the CPU test mesh.
 
 Layouts: ``q, k, v: [batch, heads, seq, head_dim]`` (BHSD).  ``lse`` rides
 as ``[b, h, s, 1]`` inside kernels (trailing singleton keeps the TPU
 (sublane, lane) tiling rule satisfied for any block) and is squeezed at the
-API boundary.
+API boundary.  Segment ids ride as ``[b, s, 1]`` for the same reason.
 """
 
 from __future__ import annotations
@@ -58,7 +78,8 @@ __all__ = [
 DEFAULT_BLOCK_Q = 256
 DEFAULT_BLOCK_K = 512
 NEG_INF = -1e30
-_LANES = 128  # scratch minor dim (TPU lane count)
+_LANES = 128   # TPU lane count: minor-dim tile
+_SUBLANES = 8  # fp32 sublane tile
 
 
 def _interpret() -> bool:
@@ -69,18 +90,92 @@ def _scratch(shape, dtype=jnp.float32):
     return pltpu.VMEM(shape, dtype)
 
 
-def _pick_block(s, block):
-    while block > 8 and s % block != 0:
-        block //= 2
-    if s % block != 0:
-        block = s
-    return block
+def _round_up(x: int, m: int) -> int:
+    return -(-x // m) * m
 
 
-def _causal_mask(s, rows0, cols0, bq, bk):
-    rows = rows0 + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-    cols = cols0 + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-    return jnp.where(rows >= cols, s, NEG_INF)
+def _pick_blocks(sq, sk, block_q, block_k):
+    """Block sizes + padded lengths.  Blocks shrink to the (tile-aligned)
+    sequence length; sequences pad up to a whole number of blocks, so
+    non-power-of-two lengths never degrade to ``block = s`` VMEM blowups."""
+    bq = min(block_q, _round_up(sq, _SUBLANES))
+    bk = min(block_k, _round_up(sk, _LANES))
+    return bq, bk, _round_up(sq, bq), _round_up(sk, bk)
+
+
+def _pad_dim2(x, target):
+    s = x.shape[2]
+    if s == target:
+        return x
+    return jnp.pad(x, ((0, 0), (0, 0), (0, target - s), (0, 0)))
+
+
+def _prep_segments(seg_q, seg_k, b, sq, sk, sq_p, sk_p, need):
+    """Pad/create ``[b, s, 1]`` int32 segment-id arrays.  Pad sentinels
+    differ on the q (-1) and k (-2) side so padded q rows attend nothing
+    and real rows never attend padded keys."""
+    if not need:
+        return None, None
+    if seg_q is None:
+        seg_q = jnp.zeros((b, sq), jnp.int32)
+    if seg_k is None:
+        seg_k = jnp.zeros((b, sk), jnp.int32)
+    seg_q = jnp.pad(seg_q.astype(jnp.int32), ((0, 0), (0, sq_p - sq)),
+                    constant_values=-1)
+    seg_k = jnp.pad(seg_k.astype(jnp.int32), ((0, 0), (0, sk_p - sk)),
+                    constant_values=-2)
+    return seg_q[..., None], seg_k[..., None]
+
+
+# ---------------------------------------------------------------------------
+# dropout: counter-based keep mask, regenerated identically in fwd and bwd
+# ---------------------------------------------------------------------------
+
+
+def _mix32(x):
+    """murmur3 finalizer — full-avalanche 32-bit mix."""
+    x = x ^ (x >> 16)
+    x = x * jnp.uint32(0x85EBCA6B)
+    x = x ^ (x >> 13)
+    x = x * jnp.uint32(0xC2B2AE35)
+    x = x ^ (x >> 16)
+    return x
+
+
+def _keep_mask(seed, bh, rows, cols, rate):
+    """Boolean keep mask over global (row, col) coordinates.
+
+    Pure uint32 arithmetic (no pltpu PRNG) so the identical mask is
+    produced on TPU and in interpret mode, and the backward kernels can
+    regenerate it from the same (seed, coords) regardless of grid order.
+    """
+    h = _mix32(seed.astype(jnp.uint32) ^ jnp.uint32(0x9E3779B9))
+    h = _mix32(h + jnp.uint32(bh))
+    h = _mix32(h + rows.astype(jnp.uint32))  # (bq, 1)
+    h = _mix32(h + cols.astype(jnp.uint32))  # (bq, bk)
+    thresh = jnp.uint32(min(int(rate * 4294967296.0), 4294967295))
+    return h >= thresh
+
+
+def _coords(iq, jk, bq, bk, q_offset, kv_offset):
+    rows = (q_offset + iq * bq
+            + jax.lax.broadcasted_iota(jnp.int32, (bq, 1), 0))
+    cols = (kv_offset + jk * bk
+            + jax.lax.broadcasted_iota(jnp.int32, (1, bk), 1))
+    return rows, cols
+
+
+def _block_mask(iq, jk, bq, bk, causal, q_offset, kv_offset,
+                seg_q, seg_k):
+    """Combined causal+segment mask for one (q-block, k-block), or None."""
+    mask = None
+    if causal:
+        rows, cols = _coords(iq, jk, bq, bk, q_offset, kv_offset)
+        mask = rows >= cols
+    if seg_q is not None:
+        sm = seg_q[:, None] == seg_k[None, :]
+        mask = sm if mask is None else mask & sm
+    return mask
 
 
 # ---------------------------------------------------------------------------
@@ -88,10 +183,22 @@ def _causal_mask(s, rows0, cols0, bq, bk):
 # ---------------------------------------------------------------------------
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_sc, l_sc, acc_sc, *,
-                scale, causal, q_offset, kv_offset):
-    bq, d = q_ref.shape[2], q_ref.shape[3]
+def _fwd_kernel(*refs, scale, causal, q_offset, kv_offset, has_segments,
+                dropout_rate):
+    i = 3
+    q_ref, k_ref, v_ref = refs[:3]
+    seg_q_ref = seg_k_ref = seed_ref = None
+    if has_segments:
+        seg_q_ref, seg_k_ref = refs[i], refs[i + 1]
+        i += 2
+    if dropout_rate > 0.0:
+        seed_ref = refs[i]
+        i += 1
+    o_ref, lse_ref, m_sc, l_sc, acc_sc = refs[i:i + 5]
+
+    bq = q_ref.shape[2]
     bk = k_ref.shape[2]
+    bh = pl.program_id(0)
     iq = pl.program_id(1)
     jk = pl.program_id(2)
     num_kb = pl.num_programs(2)
@@ -102,29 +209,53 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_sc, l_sc, acc_sc, *,
         l_sc[...] = jnp.zeros_like(l_sc)
         acc_sc[...] = jnp.zeros_like(acc_sc)
 
-    q = q_ref[0, 0].astype(jnp.float32) * scale
-    k = k_ref[0, 0].astype(jnp.float32)
-    v = v_ref[0, 0].astype(jnp.float32)
-    s = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )
-    if causal:
-        s = _causal_mask(s, q_offset + iq * bq, kv_offset + jk * bk, bq, bk)
+    def _body():
+        q = q_ref[0, 0].astype(jnp.float32) * scale
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        seg_q = seg_q_ref[0, :, 0] if has_segments else None
+        seg_k = seg_k_ref[0, :, 0] if has_segments else None
+        mask = _block_mask(iq, jk, bq, bk, causal, q_offset, kv_offset,
+                           seg_q, seg_k)
+        if mask is not None:
+            s = jnp.where(mask, s, NEG_INF)
 
-    m = m_sc[:, 0]
-    l = l_sc[:, 0]
-    m_new = jnp.maximum(m, jnp.max(s, axis=1))
-    p = jnp.exp(s - m_new[:, None])
-    alpha = jnp.exp(m - m_new)
-    l_new = l * alpha + jnp.sum(p, axis=1)
-    acc_new = acc_sc[...] * alpha[:, None] + jax.lax.dot_general(
-        p, v, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )
-    m_sc[...] = jnp.broadcast_to(m_new[:, None], m_sc.shape)
-    l_sc[...] = jnp.broadcast_to(l_new[:, None], l_sc.shape)
-    acc_sc[...] = acc_new
+        m = m_sc[:, 0]
+        l = l_sc[:, 0]
+        m_new = jnp.maximum(m, jnp.max(s, axis=1))
+        # Guard the all-masked row: with m_new == NEG_INF, exp(s - m_new)
+        # would be exp(0) = 1 per masked entry (phantom mean(V) mass);
+        # exp(s - 0) = exp(NEG_INF) = 0 is what we want.
+        m_safe = jnp.where(m_new <= NEG_INF * 0.5, 0.0, m_new)
+        p = jnp.exp(s - m_safe[:, None])
+        alpha = jnp.exp(jnp.minimum(m - m_new, 0.0))
+        l_new = l * alpha + jnp.sum(p, axis=1)
+        if dropout_rate > 0.0:
+            rows, cols = _coords(iq, jk, bq, bk, q_offset, kv_offset)
+            keep = _keep_mask(seed_ref[0], bh, rows, cols, dropout_rate)
+            p_acc = jnp.where(keep, p * (1.0 / (1.0 - dropout_rate)), 0.0)
+        else:
+            p_acc = p
+        acc_new = acc_sc[...] * alpha[:, None] + jax.lax.dot_general(
+            p_acc, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_sc[...] = jnp.broadcast_to(m_new[:, None], m_sc.shape)
+        l_sc[...] = jnp.broadcast_to(l_new[:, None], l_sc.shape)
+        acc_sc[...] = acc_new
+
+    if causal:
+        # Causal block skipping: a block whose max row < min col is fully
+        # masked — no MXU work (its K/V copy is also elided via the index
+        # map clamp in _fwd_call).
+        run = (q_offset + (iq + 1) * bq - 1) >= (kv_offset + jk * bk)
+        pl.when(run)(_body)
+    else:
+        _body()
 
     @pl.when(jk == num_kb - 1)
     def _finalize():
@@ -141,10 +272,31 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_sc, l_sc, acc_sc, *,
 # ---------------------------------------------------------------------------
 
 
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-               dq_sc, *, scale, causal, q_offset, kv_offset):
-    bq, d = q_ref.shape[2], q_ref.shape[3]
+def _bwd_p(s, lse, mask):
+    """exp(s - lse) with the fully-masked-row guard (lse == NEG_INF)."""
+    lse_safe = jnp.where(lse <= NEG_INF * 0.5, 0.0, lse)
+    p = jnp.exp(s - lse_safe[:, None])
+    if mask is not None:
+        p = jnp.where(mask, p, 0.0)
+    return p
+
+
+def _dq_kernel(*refs, scale, causal, q_offset, kv_offset, has_segments,
+               dropout_rate):
+    i = 6
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref = refs[:6]
+    seg_q_ref = seg_k_ref = seed_ref = None
+    if has_segments:
+        seg_q_ref, seg_k_ref = refs[i], refs[i + 1]
+        i += 2
+    if dropout_rate > 0.0:
+        seed_ref = refs[i]
+        i += 1
+    dq_ref, dq_sc = refs[i:i + 2]
+
+    bq = q_ref.shape[2]
     bk = k_ref.shape[2]
+    bh = pl.program_id(0)
     iq = pl.program_id(1)
     jk = pl.program_id(2)
     num_kb = pl.num_programs(2)
@@ -153,40 +305,66 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     def _init():
         dq_sc[...] = jnp.zeros_like(dq_sc)
 
-    q = q_ref[0, 0].astype(jnp.float32)
-    k = k_ref[0, 0].astype(jnp.float32)
-    v = v_ref[0, 0].astype(jnp.float32)
-    do = do_ref[0, 0].astype(jnp.float32)
-    lse = lse_ref[0, 0, :, 0]
-    delta = delta_ref[0, 0, :, 0]
+    def _body():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0, :, 0]
+        delta = delta_ref[0, 0, :, 0]
 
-    s = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    ) * scale
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        seg_q = seg_q_ref[0, :, 0] if has_segments else None
+        seg_k = seg_k_ref[0, :, 0] if has_segments else None
+        mask = _block_mask(iq, jk, bq, bk, causal, q_offset, kv_offset,
+                           seg_q, seg_k)
+        if mask is not None:
+            s = jnp.where(mask, s, NEG_INF)
+        p = _bwd_p(s, lse, mask)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        if dropout_rate > 0.0:
+            rows, cols = _coords(iq, jk, bq, bk, q_offset, kv_offset)
+            keep = _keep_mask(seed_ref[0], bh, rows, cols, dropout_rate)
+            dp = jnp.where(keep, dp * (1.0 / (1.0 - dropout_rate)), 0.0)
+        ds = p * (dp - delta[:, None]) * scale
+        dq_sc[...] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
     if causal:
-        s = _causal_mask(s, q_offset + iq * bq, kv_offset + jk * bk, bq, bk)
-    p = jnp.exp(s - lse[:, None])
-    dp = jax.lax.dot_general(
-        do, v, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )
-    ds = p * (dp - delta[:, None]) * scale
-    dq_sc[...] += jax.lax.dot_general(
-        ds, k, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )
+        run = (q_offset + (iq + 1) * bq - 1) >= (kv_offset + jk * bk)
+        pl.when(run)(_body)
+    else:
+        _body()
 
     @pl.when(jk == num_kb - 1)
     def _finalize():
         dq_ref[0, 0] = dq_sc[...].astype(dq_ref.dtype)
 
 
-def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                dk_ref, dv_ref, dk_sc, dv_sc, *, scale, causal,
-                q_offset, kv_offset):
-    bk, d = k_ref.shape[2], k_ref.shape[3]
+def _dkv_kernel(*refs, scale, causal, q_offset, kv_offset, has_segments,
+                dropout_rate):
+    i = 6
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref = refs[:6]
+    seg_q_ref = seg_k_ref = seed_ref = None
+    if has_segments:
+        seg_q_ref, seg_k_ref = refs[i], refs[i + 1]
+        i += 2
+    if dropout_rate > 0.0:
+        seed_ref = refs[i]
+        i += 1
+    dk_ref, dv_ref, dk_sc, dv_sc = refs[i:i + 4]
+
+    bk = k_ref.shape[2]
     bq = q_ref.shape[2]
+    bh = pl.program_id(0)
     jk = pl.program_id(1)
     iq = pl.program_id(2)
     num_qb = pl.num_programs(2)
@@ -196,33 +374,52 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_sc[...] = jnp.zeros_like(dk_sc)
         dv_sc[...] = jnp.zeros_like(dv_sc)
 
-    q = q_ref[0, 0].astype(jnp.float32)
-    do = do_ref[0, 0].astype(jnp.float32)
-    lse = lse_ref[0, 0, :, 0]
-    delta = delta_ref[0, 0, :, 0]
-    k = k_ref[0, 0].astype(jnp.float32)
-    v = v_ref[0, 0].astype(jnp.float32)
+    def _body():
+        q = q_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0, :, 0]
+        delta = delta_ref[0, 0, :, 0]
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
 
-    s = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    ) * scale
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        seg_q = seg_q_ref[0, :, 0] if has_segments else None
+        seg_k = seg_k_ref[0, :, 0] if has_segments else None
+        mask = _block_mask(iq, jk, bq, bk, causal, q_offset, kv_offset,
+                           seg_q, seg_k)
+        if mask is not None:
+            s = jnp.where(mask, s, NEG_INF)
+        p = _bwd_p(s, lse, mask)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        if dropout_rate > 0.0:
+            rows, cols = _coords(iq, jk, bq, bk, q_offset, kv_offset)
+            keep = _keep_mask(seed_ref[0], bh, rows, cols, dropout_rate)
+            inv = 1.0 / (1.0 - dropout_rate)
+            p_drop = jnp.where(keep, p * inv, 0.0)
+            dp = jnp.where(keep, dp * inv, 0.0)
+        else:
+            p_drop = p
+        dv_sc[...] += jax.lax.dot_general(
+            p_drop, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta[:, None]) * scale
+        dk_sc[...] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
     if causal:
-        s = _causal_mask(s, q_offset + iq * bq, kv_offset + jk * bk, bq, bk)
-    p = jnp.exp(s - lse[:, None])
-    dv_sc[...] += jax.lax.dot_general(
-        p, do, (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )
-    dp = jax.lax.dot_general(
-        do, v, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )
-    ds = p * (dp - delta[:, None]) * scale
-    dk_sc[...] += jax.lax.dot_general(
-        ds, q, (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )
+        run = (q_offset + (iq + 1) * bq - 1) >= (kv_offset + jk * bk)
+        pl.when(run)(_body)
+    else:
+        _body()
 
     @pl.when(iq == num_qb - 1)
     def _finalize():
@@ -235,81 +432,148 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 # ---------------------------------------------------------------------------
 
 
-def _q_spec(h, block, d):
-    """q/do/o blocked on the q grid dim (dim 1), constant over dim 2."""
-    return pl.BlockSpec((1, 1, block, d),
-                        lambda bh, i, j: (bh // h, bh % h, i, 0))
+def _causal_jmax(i, bq, bk, q_offset, kv_offset, num_kb):
+    """Last k-block index with any live (unmasked) column for q-block i."""
+    jmax = (q_offset + (i + 1) * bq - 1 - kv_offset) // bk
+    return jnp.clip(jmax, 0, num_kb - 1)
 
 
-def _k_spec(h, block, d):
-    """k/v blocked on the k grid dim (dim 2)."""
-    return pl.BlockSpec((1, 1, block, d),
-                        lambda bh, i, j: (bh // h, bh % h, j, 0))
+def _causal_imin(j, bq, bk, q_offset, kv_offset, num_qb):
+    """First q-block index with any live row for k-block j."""
+    imin = -((-(kv_offset + j * bk - q_offset - bq + 1)) // bq)
+    return jnp.clip(imin, 0, num_qb - 1)
 
 
-def _q_lse_spec(h, block):
-    return pl.BlockSpec((1, 1, block, 1),
-                        lambda bh, i, j: (bh // h, bh % h, i, 0))
+def _specs_fwd(h, bq, bk, d, causal, q_offset, kv_offset, num_kb):
+    """Block specs for the (bh, i, j) grid (k innermost).  Under causal the
+    k/v (and seg_k) index maps clamp j into the live range so skipped
+    blocks re-reference the previous block and Pallas elides the copy."""
+
+    def q_idx(bh_, i, j):
+        return (bh_ // h, bh_ % h, i, 0)
+
+    def k_idx(bh_, i, j):
+        if causal:
+            j = jnp.minimum(j, _causal_jmax(i, bq, bk, q_offset, kv_offset,
+                                            num_kb))
+        return (bh_ // h, bh_ % h, j, 0)
+
+    def segq_idx(bh_, i, j):
+        return (bh_ // h, i, 0)
+
+    def segk_idx(bh_, i, j):
+        if causal:
+            j = jnp.minimum(j, _causal_jmax(i, bq, bk, q_offset, kv_offset,
+                                            num_kb))
+        return (bh_ // h, j, 0)
+
+    return {
+        "q": pl.BlockSpec((1, 1, bq, d), q_idx),
+        "k": pl.BlockSpec((1, 1, bk, d), k_idx),
+        "q_lse": pl.BlockSpec((1, 1, bq, 1), q_idx),
+        "seg_q": pl.BlockSpec((1, bq, 1), segq_idx),
+        "seg_k": pl.BlockSpec((1, bk, 1), segk_idx),
+        "seed": pl.BlockSpec(memory_space=pltpu.SMEM),
+    }
 
 
-def _kq_spec(h, block, d):
-    """q-side tensors when the *k* block is grid dim 1 and q sweeps dim 2."""
-    return pl.BlockSpec((1, 1, block, d),
-                        lambda bh, j, i: (bh // h, bh % h, i, 0))
+def _specs_dkv(h, bq, bk, d, causal, q_offset, kv_offset, num_qb):
+    """Block specs for the transposed (bh, j, i) grid (q innermost)."""
 
+    def q_idx(bh_, j, i):
+        if causal:
+            i = jnp.maximum(i, _causal_imin(j, bq, bk, q_offset, kv_offset,
+                                            num_qb))
+        return (bh_ // h, bh_ % h, i, 0)
 
-def _kk_spec(h, block, d):
-    return pl.BlockSpec((1, 1, block, d),
-                        lambda bh, j, i: (bh // h, bh % h, j, 0))
+    def k_idx(bh_, j, i):
+        return (bh_ // h, bh_ % h, j, 0)
 
+    def segq_idx(bh_, j, i):
+        if causal:
+            i = jnp.maximum(i, _causal_imin(j, bq, bk, q_offset, kv_offset,
+                                            num_qb))
+        return (bh_ // h, i, 0)
 
-def _kq_lse_spec(h, block):
-    return pl.BlockSpec((1, 1, block, 1),
-                        lambda bh, j, i: (bh // h, bh % h, i, 0))
+    def segk_idx(bh_, j, i):
+        return (bh_ // h, j, 0)
+
+    return {
+        "q": pl.BlockSpec((1, 1, bq, d), q_idx),
+        "k": pl.BlockSpec((1, 1, bk, d), k_idx),
+        "q_lse": pl.BlockSpec((1, 1, bq, 1), q_idx),
+        "seg_q": pl.BlockSpec((1, bq, 1), segq_idx),
+        "seg_k": pl.BlockSpec((1, bk, 1), segk_idx),
+        "seed": pl.BlockSpec(memory_space=pltpu.SMEM),
+    }
 
 
 def _resolve(scale, d):
     return (1.0 / (d ** 0.5)) if scale is None else scale
 
 
-def _fwd_call(q, k, v, causal, scale, block_q, block_k, q_offset, kv_offset):
+def _seed_array(dropout_seed):
+    if dropout_seed is None:
+        # Reachable only via the chunk entry points / vjp residuals, whose
+        # public callers have already validated (rate > 0) => seed given.
+        raise ValueError(
+            "dropout_rate > 0 requires an explicit dropout_seed (vary it "
+            "per training step; a silent constant seed would drop the same "
+            "attention entries forever)")
+    return jnp.asarray(dropout_seed, jnp.int32).reshape((1,))
+
+
+def _fwd_call(q, k, v, seg_q, seg_k, seed, causal, scale, block_q, block_k,
+              q_offset, kv_offset, dropout_rate):
     b, h, sq, d = q.shape
     sk = k.shape[2]
-    block_q = _pick_block(sq, block_q)
-    block_k = _pick_block(sk, block_k)
+    bq, bk, sq_p, sk_p = _pick_blocks(sq, sk, block_q, block_k)
+    seg_q, seg_k = _prep_segments(
+        seg_q, seg_k, b, sq, sk, sq_p, sk_p,
+        need=(seg_q is not None or seg_k is not None
+              or sq_p != sq or sk_p != sk))
+    has_segments = seg_q is not None
+    qp, kp, vp = _pad_dim2(q, sq_p), _pad_dim2(k, sk_p), _pad_dim2(v, sk_p)
+    num_kb = sk_p // bk
+    sp = _specs_fwd(h, bq, bk, d, causal, q_offset, kv_offset, num_kb)
+
     kernel = functools.partial(
         _fwd_kernel, scale=_resolve(scale, d), causal=causal,
-        q_offset=q_offset, kv_offset=kv_offset,
+        q_offset=q_offset, kv_offset=kv_offset, has_segments=has_segments,
+        dropout_rate=dropout_rate,
     )
+    in_specs = [sp["q"], sp["k"], sp["k"]]
+    args = [qp, kp, vp]
+    if has_segments:
+        in_specs += [sp["seg_q"], sp["seg_k"]]
+        args += [seg_q, seg_k]
+    if dropout_rate > 0.0:
+        in_specs += [sp["seed"]]
+        args += [_seed_array(seed)]
+
     out, lse4 = pl.pallas_call(
         kernel,
-        grid=(b * h, sq // block_q, sk // block_k),
-        in_specs=[
-            _q_spec(h, block_q, d),
-            _k_spec(h, block_k, d),
-            _k_spec(h, block_k, d),
-        ],
-        out_specs=[
-            _q_spec(h, block_q, d),
-            _q_lse_spec(h, block_q),
-        ],
+        grid=(b * h, sq_p // bq, num_kb),
+        in_specs=in_specs,
+        out_specs=[sp["q"], sp["q_lse"]],
         out_shape=[
-            jax.ShapeDtypeStruct(q.shape, q.dtype),
-            jax.ShapeDtypeStruct((b, h, sq, 1), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, sq_p, d), q.dtype),
+            jax.ShapeDtypeStruct((b, h, sq_p, 1), jnp.float32),
         ],
         scratch_shapes=[
-            _scratch((block_q, _LANES)),
-            _scratch((block_q, _LANES)),
-            _scratch((block_q, d)),
+            _scratch((bq, _LANES)),
+            _scratch((bq, _LANES)),
+            _scratch((bq, d)),
         ],
         interpret=_interpret(),
-    )(q, k, v)
-    return out, lse4[..., 0]
+    )(*args)
+    return out[:, :, :sq], lse4[:, :, :sq, 0]
 
 
 def dq_chunk(q, k, v, do, lse, delta, *, causal, scale=None,
              block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
-             q_offset=0, kv_offset=0):
+             q_offset=0, kv_offset=0, segment_ids_q=None,
+             segment_ids_kv=None, dropout_rate=0.0, dropout_seed=None):
     """dq contribution of one K/V chunk given the *global* ``lse``/``delta``.
 
     The flash-backward identity: each (q-block, k-block) pair's gradient
@@ -318,64 +582,92 @@ def dq_chunk(q, k, v, do, lse, delta, *, causal, scale=None,
     """
     b, h, sq, d = q.shape
     sk = k.shape[2]
-    block_q = _pick_block(sq, block_q)
-    block_k = _pick_block(sk, block_k)
+    bq, bk, sq_p, sk_p = _pick_blocks(sq, sk, block_q, block_k)
+    seg_q, seg_k = _prep_segments(
+        segment_ids_q, segment_ids_kv, b, sq, sk, sq_p, sk_p,
+        need=(segment_ids_q is not None or segment_ids_kv is not None
+              or sq_p != sq or sk_p != sk))
+    has_segments = seg_q is not None
+    num_kb = sk_p // bk
+    sp = _specs_fwd(h, bq, bk, d, causal, q_offset, kv_offset, num_kb)
+
     kernel = functools.partial(
         _dq_kernel, scale=_resolve(scale, d), causal=causal,
-        q_offset=q_offset, kv_offset=kv_offset,
+        q_offset=q_offset, kv_offset=kv_offset, has_segments=has_segments,
+        dropout_rate=dropout_rate,
     )
-    return pl.pallas_call(
+    in_specs = [sp["q"], sp["k"], sp["k"], sp["q"], sp["q_lse"],
+                sp["q_lse"]]
+    args = [_pad_dim2(q, sq_p), _pad_dim2(k, sk_p), _pad_dim2(v, sk_p),
+            _pad_dim2(do, sq_p),
+            _pad_dim2(lse[..., None], sq_p),
+            _pad_dim2(delta[..., None], sq_p)]
+    if has_segments:
+        in_specs += [sp["seg_q"], sp["seg_k"]]
+        args += [seg_q, seg_k]
+    if dropout_rate > 0.0:
+        in_specs += [sp["seed"]]
+        args += [_seed_array(dropout_seed)]
+
+    dq = pl.pallas_call(
         kernel,
-        grid=(b * h, sq // block_q, sk // block_k),
-        in_specs=[
-            _q_spec(h, block_q, d),
-            _k_spec(h, block_k, d),
-            _k_spec(h, block_k, d),
-            _q_spec(h, block_q, d),
-            _q_lse_spec(h, block_q),
-            _q_lse_spec(h, block_q),
-        ],
-        out_specs=_q_spec(h, block_q, d),
-        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
-        scratch_shapes=[_scratch((block_q, d))],
+        grid=(b * h, sq_p // bq, num_kb),
+        in_specs=in_specs,
+        out_specs=sp["q"],
+        out_shape=jax.ShapeDtypeStruct((b, h, sq_p, d), q.dtype),
+        scratch_shapes=[_scratch((bq, d))],
         interpret=_interpret(),
-    )(q, k, v, do, lse[..., None], delta[..., None])
+    )(*args)
+    return dq[:, :, :sq]
 
 
 def dkv_chunk(q, k, v, do, lse, delta, *, causal, scale=None,
               block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
-              q_offset=0, kv_offset=0):
+              q_offset=0, kv_offset=0, segment_ids_q=None,
+              segment_ids_kv=None, dropout_rate=0.0, dropout_seed=None):
     """(dk, dv) of one K/V chunk given the global ``lse``/``delta``."""
     b, h, sq, d = q.shape
     sk = k.shape[2]
-    block_q = _pick_block(sq, block_q)
-    block_k = _pick_block(sk, block_k)
+    bq, bk, sq_p, sk_p = _pick_blocks(sq, sk, block_q, block_k)
+    seg_q, seg_k = _prep_segments(
+        segment_ids_q, segment_ids_kv, b, sq, sk, sq_p, sk_p,
+        need=(segment_ids_q is not None or segment_ids_kv is not None
+              or sq_p != sq or sk_p != sk))
+    has_segments = seg_q is not None
+    num_qb = sq_p // bq
+    sp = _specs_dkv(h, bq, bk, d, causal, q_offset, kv_offset, num_qb)
+
     kernel = functools.partial(
         _dkv_kernel, scale=_resolve(scale, d), causal=causal,
-        q_offset=q_offset, kv_offset=kv_offset,
+        q_offset=q_offset, kv_offset=kv_offset, has_segments=has_segments,
+        dropout_rate=dropout_rate,
     )
-    return pl.pallas_call(
+    in_specs = [sp["q"], sp["k"], sp["k"], sp["q"], sp["q_lse"],
+                sp["q_lse"]]
+    args = [_pad_dim2(q, sq_p), _pad_dim2(k, sk_p), _pad_dim2(v, sk_p),
+            _pad_dim2(do, sq_p),
+            _pad_dim2(lse[..., None], sq_p),
+            _pad_dim2(delta[..., None], sq_p)]
+    if has_segments:
+        in_specs += [sp["seg_q"], sp["seg_k"]]
+        args += [seg_q, seg_k]
+    if dropout_rate > 0.0:
+        in_specs += [sp["seed"]]
+        args += [_seed_array(dropout_seed)]
+
+    dk, dv = pl.pallas_call(
         kernel,
-        grid=(b * h, sk // block_k, sq // block_q),
-        in_specs=[
-            _kq_spec(h, block_q, d),
-            _kk_spec(h, block_k, d),
-            _kk_spec(h, block_k, d),
-            _kq_spec(h, block_q, d),
-            _kq_lse_spec(h, block_q),
-            _kq_lse_spec(h, block_q),
-        ],
-        out_specs=[
-            _kk_spec(h, block_k, d),
-            _kk_spec(h, block_k, d),
-        ],
+        grid=(b * h, sk_p // bk, num_qb),
+        in_specs=in_specs,
+        out_specs=[sp["k"], sp["k"]],
         out_shape=[
-            jax.ShapeDtypeStruct(k.shape, k.dtype),
-            jax.ShapeDtypeStruct(v.shape, v.dtype),
+            jax.ShapeDtypeStruct((b, h, sk_p, d), k.dtype),
+            jax.ShapeDtypeStruct((b, h, sk_p, d), v.dtype),
         ],
-        scratch_shapes=[_scratch((block_k, d)), _scratch((block_k, d))],
+        scratch_shapes=[_scratch((bk, d)), _scratch((bk, d))],
         interpret=_interpret(),
-    )(q, k, v, do, lse[..., None], delta[..., None])
+    )(*args)
+    return dk[:, :, :sk], dv[:, :, :sk]
 
 
 # ---------------------------------------------------------------------------
@@ -383,7 +675,39 @@ def dkv_chunk(q, k, v, do, lse, delta, *, causal, scale=None,
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9, 10, 11, 12))
+def _flash_core(q, k, v, seg_q, seg_k, seed,
+                causal, scale, block_q, block_k, q_offset, kv_offset,
+                dropout_rate):
+    return _fwd_call(q, k, v, seg_q, seg_k, seed, causal, scale, block_q,
+                     block_k, q_offset, kv_offset, dropout_rate)
+
+
+def _flash_vjp_fwd(q, k, v, seg_q, seg_k, seed, causal, scale, block_q,
+                   block_k, q_offset, kv_offset, dropout_rate):
+    out, lse = _fwd_call(q, k, v, seg_q, seg_k, seed, causal, scale,
+                         block_q, block_k, q_offset, kv_offset,
+                         dropout_rate)
+    return (out, lse), (q, k, v, seg_q, seg_k, seed, out, lse)
+
+
+def _flash_vjp_bwd(causal, scale, block_q, block_k, q_offset, kv_offset,
+                   dropout_rate, res, cts):
+    q, k, v, seg_q, seg_k, seed, out, lse = res
+    do, _ = cts
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    kw = dict(causal=causal, scale=scale, block_q=block_q, block_k=block_k,
+              q_offset=q_offset, kv_offset=kv_offset,
+              segment_ids_q=seg_q, segment_ids_kv=seg_k,
+              dropout_rate=dropout_rate, dropout_seed=seed)
+    dq = dq_chunk(q, k, v, do, lse, delta, **kw)
+    dk, dv = dkv_chunk(q, k, v, do, lse, delta, **kw)
+    return dq, dk, dv, None, None, None
+
+
+_flash_core.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
 def flash_attention_with_lse(
     q, k, v,
     causal: bool = False,
@@ -392,45 +716,42 @@ def flash_attention_with_lse(
     block_k: int = DEFAULT_BLOCK_K,
     q_offset: int = 0,
     kv_offset: int = 0,
+    *,
+    segment_ids_q=None,
+    segment_ids_kv=None,
+    dropout_rate: float = 0.0,
+    dropout_seed=None,
 ):
     """Attention returning ``(out, lse)``.
+
+    ``segment_ids_q/kv`` (int ≥ 0, ``[b, s]``) mask attention across
+    segment boundaries — packed-varlen (fmha cu_seqlens) and padding masks
+    in one mechanism.  ``dropout_rate``/``dropout_seed`` apply attention
+    dropout after softmax (seed may be a traced scalar; vary it per step).
 
     NB: the VJP propagates the cotangent of ``out`` only; ``lse`` is a
     by-product for sharded-softmax composition (ring attention defines its
     own VJP at the ring level for exactly that reason).
     """
-    return _fwd_call(q, k, v, causal, scale, block_q, block_k, q_offset,
-                     kv_offset)
-
-
-def _flash_vjp_fwd(q, k, v, causal, scale, block_q, block_k, q_offset,
-                   kv_offset):
-    out, lse = _fwd_call(q, k, v, causal, scale, block_q, block_k, q_offset,
-                         kv_offset)
-    return (out, lse), (q, k, v, out, lse)
-
-
-def _flash_vjp_bwd(causal, scale, block_q, block_k, q_offset, kv_offset,
-                   res, cts):
-    q, k, v, out, lse = res
-    do, _ = cts
-    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
-    kw = dict(causal=causal, scale=scale, block_q=block_q, block_k=block_k,
-              q_offset=q_offset, kv_offset=kv_offset)
-    dq = dq_chunk(q, k, v, do, lse, delta, **kw)
-    dk, dv = dkv_chunk(q, k, v, do, lse, delta, **kw)
-    return dq, dk, dv
-
-
-flash_attention_with_lse.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+    seed = _seed_array(dropout_seed) if dropout_rate > 0.0 else None
+    return _flash_core(q, k, v, segment_ids_q, segment_ids_kv, seed,
+                       causal, scale, block_q, block_k, q_offset, kv_offset,
+                       float(dropout_rate))
 
 
 def flash_attention(q, k, v, causal: bool = False,
                     scale: Optional[float] = None,
                     block_q: int = DEFAULT_BLOCK_Q,
-                    block_k: int = DEFAULT_BLOCK_K):
-    """``softmax(q k^T * scale [+ causal mask]) v`` without materialising
-    the score matrix.  ``q,k,v: [batch, heads, seq, head_dim]``."""
-    out, _ = flash_attention_with_lse(q, k, v, causal, scale, block_q,
-                                      block_k, 0, 0)
+                    block_k: int = DEFAULT_BLOCK_K,
+                    *,
+                    segment_ids_q=None,
+                    segment_ids_kv=None,
+                    dropout_rate: float = 0.0,
+                    dropout_seed=None):
+    """``softmax(q k^T * scale [+ masks]) v`` without materialising the
+    score matrix.  ``q,k,v: [batch, heads, seq, head_dim]``."""
+    out, _ = flash_attention_with_lse(
+        q, k, v, causal, scale, block_q, block_k, 0, 0,
+        segment_ids_q=segment_ids_q, segment_ids_kv=segment_ids_kv,
+        dropout_rate=dropout_rate, dropout_seed=dropout_seed)
     return out

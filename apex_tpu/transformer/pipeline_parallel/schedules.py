@@ -35,11 +35,16 @@ expressed instead as a **rotation pipeline** inside one ``shard_map`` over the
   the reference implements by hand with side streams and ``FutureTensor``.
 
 1F1B's reason to exist is bounding live activations to ``pp`` microbatches
-(vs GPipe's ``m``).  The JAX analog is rematerialisation: with
+(vs GPipe's ``m``).  The JAX analog here is rematerialisation: with
 ``remat=True`` (default) each stage recomputes its tick's internals in
-backward from the carried activation, so live memory is the tick inputs plus
-one tick's residuals — the same O(pp)-not-O(m) footprint, without the
-asymmetric control flow that fights SPMD (SURVEY.md §7 hard part (a)).
+backward, so the per-tick *residuals* are not stored.  The scan backward
+does still store one carried boundary activation per tick (~``m*vpp``
+ticks), so the live-activation footprint is **O(m*vpp) boundary tensors +
+one tick's recomputed internals** — GPipe-with-remat behavior, smaller
+than storing full per-layer residuals but not 1F1B's O(pp) bound.  The
+trade buys SPMD-friendly homogeneous control flow (SURVEY.md §7 hard
+part (a)); nest an outer ``jax.checkpoint`` over tick groups if the O(pp)
+bound is required.
 
 Schedule math (static, host-side): with ``period = pp*vpp``, microbatch ``j``
 enters at ``e_j = (j // pp) * period + (j % pp)``; its stream occupies slot

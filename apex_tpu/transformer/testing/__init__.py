@@ -15,6 +15,7 @@ from apex_tpu.transformer.testing.standalone_transformer_lm import (
 from apex_tpu.transformer.testing.standalone_gpt import (
     GPTModel,
     gpt_loss,
+    gpt_next_token_loss,
     init_gpt_layer_stack,
 )
 from apex_tpu.transformer.testing.standalone_bert import (
@@ -35,6 +36,7 @@ __all__ = [
     "parallel_lm_logits",
     "GPTModel",
     "gpt_loss",
+    "gpt_next_token_loss",
     "init_gpt_layer_stack",
     "BertModel",
     "bert_extended_attention_mask",

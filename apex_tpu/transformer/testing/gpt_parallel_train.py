@@ -38,7 +38,7 @@ from apex_tpu.transformer.pipeline_parallel.schedules import (
 )
 from apex_tpu.transformer.tensor_parallel import infer_param_specs
 from apex_tpu.transformer.layers.layer_norm import FusedLayerNorm
-from apex_tpu.transformer.testing.standalone_gpt import gpt_loss
+from apex_tpu.transformer.testing.standalone_gpt import gpt_next_token_loss
 from apex_tpu.transformer.testing.standalone_transformer_lm import (
     Embedding,
     ParallelTransformerLayer,
@@ -168,7 +168,7 @@ def build_gpt_3d(
             logits = parallel_lm_logits(
                 hid, p.embedding["word_embeddings"]["embedding"], cfg
             )
-            return jnp.mean(gpt_loss(logits, t, cfg))
+            return jnp.mean(gpt_next_token_loss(logits, t, cfg))
 
         losses = jax.vmap(head_one)(out, mbs)
         return jnp.mean(losses)

@@ -25,15 +25,22 @@ from apex_tpu.transformer.testing.standalone_transformer_lm import (
     parallel_lm_logits,
 )
 
-__all__ = ["GPTModel", "gpt_loss", "init_gpt_layer_stack"]
+__all__ = ["GPTModel", "gpt_loss", "gpt_next_token_loss",
+           "init_gpt_layer_stack"]
 
 
 class GPTModel(nn.Module):
     """GPT LM: causal ``TransformerLanguageModel`` + embedding-tied logits.
 
-    Forward returns per-token loss ``[b, s]`` when ``labels`` is given
-    (reference ``post_language_model_processing``), else logits
-    ``[s, b, vocab(/tp)]``.
+    Forward returns per-token next-token loss ``[b, s-1]`` when
+    ``labels`` is given, else logits ``[s, b, vocab(/tp)]``.
+
+    Deliberate API divergence from the reference
+    ``post_language_model_processing``: there the *data pipeline* pre-shifts
+    labels; this framework has no mandatory data pipeline, so ``labels``
+    are the **raw tokens** and the shift happens centrally in
+    :func:`gpt_next_token_loss` — every caller (tests, bench, 3D trainer)
+    gets the same non-degenerate objective.
     """
 
     config: TransformerConfig
@@ -53,7 +60,18 @@ class GPTModel(nn.Module):
         )
         if labels is None:
             return logits
-        return gpt_loss(logits, labels, cfg)
+        return gpt_next_token_loss(logits, labels, cfg)
+
+
+def gpt_next_token_loss(logits, tokens, config: TransformerConfig):
+    """Shifted LM objective: position ``t`` predicts token ``t+1``.
+
+    ``logits [s, b, v(/tp)]`` (full sequence — ``parallel_lm_logits`` has
+    already gathered SP shards), ``tokens [b, s]`` raw; returns ``[b, s-1]``
+    per-token losses.  Without the shift the objective is trivially
+    learnable through the tied embedding (round-1 ADVICE).
+    """
+    return gpt_loss(logits[:-1], tokens[:, 1:], config)
 
 
 def gpt_loss(logits, labels, config: TransformerConfig):

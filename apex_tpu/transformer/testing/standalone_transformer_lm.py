@@ -176,12 +176,22 @@ class CoreAttention(nn.Module):
         sk = k.shape[0]
 
         if (cfg.use_flash_attention
-                and self.attn_mask_type == AttnMaskType.causal
-                and (cfg.attention_dropout == 0.0 or deterministic)):
+                and self.attn_mask_type == AttnMaskType.causal):
             from apex_tpu.ops.flash_attention import flash_attention
+            if cfg.attention_dropout > 0.0 and not deterministic:
+                # In-kernel counter-based dropout: derive a per-call scalar
+                # seed from the flax "dropout" rng stream (the analog of
+                # the reference's CUDA philox offsets).
+                seed = jax.random.randint(
+                    self.make_rng("dropout"), (), 0, jnp.iinfo(jnp.int32).max
+                )
+                drop = dict(dropout_rate=cfg.attention_dropout,
+                            dropout_seed=seed)
+            else:
+                drop = {}
             ctx = flash_attention(
                 q.transpose(1, 2, 0, 3), k.transpose(1, 2, 0, 3),
-                v.transpose(1, 2, 0, 3), causal=True,
+                v.transpose(1, 2, 0, 3), causal=True, **drop,
             )  # [b, n, sq, d]
             return ctx.transpose(2, 0, 1, 3).reshape(sq, b, n * d)
 

@@ -1,0 +1,102 @@
+"""Backend-availability probing for driver/bench entry points.
+
+The sandbox's sitecustomize can force an experimental TPU PJRT plugin whose
+backend init either *errors* ("Unable to initialize backend") or *wedges*
+indefinitely.  Probing in a subprocess with a timeout catches both without
+poisoning the caller's process (backend init is once-per-process), so the
+caller can pin ``JAX_PLATFORMS=cpu`` and continue.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import subprocess
+import sys
+import time
+from typing import Callable, Optional
+
+__all__ = ["force_host_device_count", "pin_cpu", "probe_default_platform",
+           "resolve_platform"]
+
+
+def force_host_device_count(n: int) -> None:
+    """Set (or raise to ``n``) ``--xla_force_host_platform_device_count``.
+
+    Only effective before this process initializes a JAX backend.
+    """
+    flags = os.environ.get("XLA_FLAGS", "")
+    pat = r"--xla_force_host_platform_device_count=(\d+)"
+    m = re.search(pat, flags)
+    if m:
+        if int(m.group(1)) < n:
+            flags = re.sub(
+                pat, f"--xla_force_host_platform_device_count={n}", flags)
+            os.environ["XLA_FLAGS"] = flags
+    else:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n}"
+        ).strip()
+
+
+def pin_cpu() -> None:
+    """Pin the CPU platform (env + config) before backend init; harmless
+    after (``jax.devices("cpu")`` keeps working either way)."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass  # backend may already be initialized
+
+
+def probe_default_platform(
+    max_tries: int = 1,
+    timeout: float = 150.0,
+    sleep_s: float = 10.0,
+    log: Optional[Callable[[str], None]] = None,
+) -> Optional[str]:
+    """Return the default JAX platform name ("tpu", "cpu", ...) if its
+    backend initializes cleanly in a fresh subprocess, else ``None``."""
+    for i in range(max_tries):
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; print(jax.devices()[0].platform)"],
+                timeout=timeout, capture_output=True,
+            )
+            if proc.returncode == 0:
+                out = proc.stdout.decode().strip().splitlines()
+                if out:
+                    return out[-1]
+            elif log:
+                log("probe rc=%d: %s" % (
+                    proc.returncode,
+                    proc.stderr.decode(errors="replace")[-500:]))
+        except Exception as e:  # TimeoutExpired = wedged plugin
+            if log:
+                log(f"probe attempt {i + 1} raised {e!r}")
+        if i + 1 < max_tries:
+            time.sleep(sleep_s)
+    return None
+
+
+def resolve_platform(
+    max_tries: int = 1,
+    timeout: float = 150.0,
+    log: Optional[Callable[[str], None]] = None,
+) -> str:
+    """The full fallback policy shared by the driver/bench entry points:
+    honor an explicit CPU pin, otherwise probe the default backend and
+    return its platform, degrading to "cpu" (without pinning — callers pin
+    or set child env as appropriate) when it errors or wedges."""
+    if os.environ.get("JAX_PLATFORMS", "").lower() == "cpu":
+        return "cpu"
+    platform = probe_default_platform(max_tries=max_tries, timeout=timeout,
+                                      log=log)
+    if platform is None:
+        if log:
+            log("default backend unusable; falling back to cpu")
+        return "cpu"
+    return platform

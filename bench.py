@@ -1,115 +1,535 @@
-"""Headline benchmark: ResNet-50 mixed-precision (O2) training throughput.
+"""apex_tpu benchmark suite over the BASELINE.json config matrix.
 
-Runs the reference's headline config (``examples/imagenet/main_amp.py``:
-ResNet-50, amp O2, FusedSGD) as apex_tpu's SPMD train step on whatever
-devices are attached and prints ONE JSON line:
-
-    {"metric": ..., "value": N, "unit": "images/sec/chip", "vs_baseline": N}
-
+Headline (the ONE JSON line, driver contract): ResNet-50 mixed-precision
+(O2) training throughput in images/sec/chip — the reference's flagship
+config (``examples/imagenet/main_amp.py``: ResNet-50, amp O2, FusedSGD).
 ``vs_baseline`` normalizes against an adopted per-A100 figure for Apex RN50
-AMP training (the repo itself publishes no numbers — BASELINE.md): NVIDIA NGC
-PyTorch+Apex RN50 AMP convergence runs report ~2.5k images/sec per A100-80GB
-at batch 256 with DALI input.  We record throughput per chip so the number is
-comparable across mesh sizes.
+AMP training (the repo publishes no numbers — BASELINE.md): NVIDIA NGC
+PyTorch+Apex RN50 AMP convergence runs report ~2.5k images/sec per A100-80GB.
+
+The ``extras`` field carries the rest of the BASELINE.json matrix, each
+individually guarded so one failure cannot empty the record:
+
+- ``resnet50_lamb_syncbn``  — RN50 + FusedLAMB + SyncBatchNorm (32k-style)
+- ``bert_large``            — BERT-large encoder train step (fused
+                              LN/dense/Adam), tokens/sec
+- ``gpt_flash``             — flagship GPT with Pallas flash attention,
+                              tokens/sec and **MFU**
+- ``tp_gpt``                — tensor-parallel GPT train step (shard_map over
+                              the tp axis; tp=#devices)
+- ``fused_adam_step``       — optimizer step-time microbench (the
+                              "fused-optimizer step time" BASELINE metric)
+
+Backend hardening (round-1 postmortem: BENCH_r01 rc=1 at ``jax.devices()``,
+"Unable to initialize backend 'axon'"; round-2 observation: backend init can
+also *hang* indefinitely mid-session): every bench runs in its own
+subprocess (``bench.py --one <name>``) under a hard timeout, so the parent
+process never initializes a backend and one wedged bench cannot empty the
+record.  The platform is probed the same way; if the TPU plugin is
+unusable, children run pinned to CPU with tiny shapes so a record is always
+emitted.
 """
 
 import json
+import os
+import subprocess
+import sys
 import time
 from functools import partial
 
-import jax
-import jax.numpy as jnp
-import numpy as np
+
+def _log(msg: str) -> None:
+    print(f"bench[{time.strftime('%H:%M:%S')}]: {msg}", file=sys.stderr,
+          flush=True)
 
 APEX_A100_IMAGES_PER_SEC = 2500.0  # adopted baseline, see module docstring
 
+# bf16 peak FLOP/s per chip by device kind (public TPU specs).
+_PEAK_FLOPS = (
+    ("v6", 918e12),   # Trillium / v6e
+    ("v5p", 459e12),
+    ("v5", 197e12),   # v5e / "v5 lite"
+    ("v4", 275e12),
+)
 
-def main():
+
+def probe_platform(max_tries: int = 3, timeout: float = 150.0) -> str:
+    """Decide the platform for bench children without initializing any
+    backend in this process.  Returns "cpu" when the default plugin errors
+    *or wedges* (both observed failure modes of the tunneled TPU)."""
+    from apex_tpu.utils.platform import resolve_platform
+
+    return resolve_platform(max_tries=max_tries, timeout=timeout, log=_log)
+
+
+def _peak_flops(device) -> float:
+    kind = getattr(device, "device_kind", "").lower()
+    for tag, peak in _PEAK_FLOPS:
+        if tag in kind:
+            return peak
+    return 197e12  # conservative default (v5e)
+
+
+def _timeit(jax, step, state, steps):
+    """Run ``state = step(*state)`` ``steps`` times; return (dt, state)."""
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state = step(*state)
+    jax.block_until_ready(state)
+    return time.perf_counter() - t0, state
+
+
+# ---------------------------------------------------------------------------
+# ResNet-50 benches
+# ---------------------------------------------------------------------------
+
+def _resnet_bench(jax, on_tpu, optimizer_name):
+    import jax.numpy as jnp
+    import numpy as np
+
     from apex_tpu import amp
     from apex_tpu.models import ResNet50
-    from apex_tpu.optimizers import FusedSGD
+    from apex_tpu.optimizers import FusedLAMB, FusedSGD
     from apex_tpu.parallel import dp_shard_batch, mesh as mesh_lib, replicate
 
     n_chips = len(jax.devices())
-    on_tpu = jax.devices()[0].platform == "tpu"
     batch_per_chip = 128 if on_tpu else 4
     image_size = 224 if on_tpu else 32
-    steps = 30 if on_tpu else 3
+    steps = 20 if on_tpu else 3
     batch = batch_per_chip * n_chips
 
     mesh = mesh_lib.initialize_model_parallel()
-    policy = amp.policy("O2")
-    model = ResNet50(num_classes=1000, axis_name=None,
-                     dtype=policy.compute_dtype)
+    try:
+        policy = amp.policy("O2")
+        model = ResNet50(num_classes=1000, axis_name=None,
+                         dtype=policy.compute_dtype)
 
-    x0 = jnp.zeros((2, image_size, image_size, 3), jnp.float32)
-    variables = model.init(jax.random.PRNGKey(0), x0, train=True)
-    params = policy.cast_to_param(variables["params"])
-    batch_stats = variables["batch_stats"]
-    opt = FusedSGD(lr=0.1, momentum=0.9, weight_decay=1e-4,
-                   master_weights=policy.master_weights)
-    opt_state = opt.init(params)
+        x0 = jnp.zeros((2, image_size, image_size, 3), jnp.float32)
+        variables = model.init(jax.random.PRNGKey(0), x0, train=True)
+        params = policy.cast_to_param(variables["params"])
+        batch_stats = variables["batch_stats"]
+        if optimizer_name == "lamb":
+            opt = FusedLAMB(lr=1e-3, weight_decay=1e-2,
+                            master_weights=policy.master_weights)
+        else:
+            opt = FusedSGD(lr=0.1, momentum=0.9, weight_decay=1e-4,
+                           master_weights=policy.master_weights)
+        opt_state = opt.init(params)
 
-    def loss_fn(params, batch_stats, batch):
-        x, y = batch
-        logits, mutated = model.apply(
-            {"params": params, "batch_stats": batch_stats},
-            policy.cast_to_compute(x),
-            train=True,
-            mutable=["batch_stats"],
+        def loss_fn(params, batch_stats, batch):
+            x, y = batch
+            logits, mutated = model.apply(
+                {"params": params, "batch_stats": batch_stats},
+                policy.cast_to_compute(x),
+                train=True,
+                mutable=["batch_stats"],
+            )
+            logp = jax.nn.log_softmax(logits)
+            loss = -jnp.mean(logp[jnp.arange(y.shape[0]), y])
+            return loss, mutated["batch_stats"]
+
+        @partial(jax.jit, donate_argnums=(0, 1, 2))
+        def train_step(params, batch_stats, opt_state, batch):
+            (loss, new_stats), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, batch_stats, batch)
+            params, opt_state = opt.step(grads, opt_state, params)
+            return params, new_stats, opt_state, batch
+
+        params = replicate(params, mesh)
+        batch_stats = replicate(batch_stats, mesh)
+        opt_state = replicate(opt_state, mesh)
+
+        rng = np.random.RandomState(0)
+        x = jnp.asarray(rng.randn(batch, image_size, image_size, 3),
+                        jnp.float32)
+        y = jnp.asarray(rng.randint(0, 1000, size=(batch,)))
+        sharded = dp_shard_batch((x, y), mesh)
+
+        _log(f"resnet50({optimizer_name}): compile start")
+        t0 = time.perf_counter()
+        state = train_step(params, batch_stats, opt_state, sharded)
+        jax.block_until_ready(state)
+        _log(f"resnet50({optimizer_name}): compiled in "
+             f"{time.perf_counter() - t0:.1f}s; timing {steps} steps")
+        dt, _ = _timeit(jax, train_step, state, steps)
+
+        ips_per_chip = batch * steps / dt / n_chips
+        return {
+            "value": round(ips_per_chip, 1),
+            "unit": "images/sec/chip",
+            "n_chips": n_chips,
+            "batch_per_chip": batch_per_chip,
+            "image_size": image_size,
+            "optimizer": optimizer_name,
+        }
+    finally:
+        mesh_lib.destroy_model_parallel()
+
+
+def bench_resnet50_o2(jax, on_tpu):
+    return _resnet_bench(jax, on_tpu, "sgd")
+
+
+def bench_resnet50_lamb_syncbn(jax, on_tpu):
+    # Single-chip SyncBN degrades to plain BN (axis_name=None); the LAMB
+    # large-batch optimizer is the point of this config (BASELINE.json
+    # "RN50 FusedLAMB 32k+SyncBN").
+    return _resnet_bench(jax, on_tpu, "lamb")
+
+
+# ---------------------------------------------------------------------------
+# Transformer benches
+# ---------------------------------------------------------------------------
+
+def _lm_train_flops(cfg, n_params, batch, seq):
+    """fwd+bwd FLOPs per step: 6*N*tokens + attention 12*L*h*B*S^2."""
+    return (6.0 * n_params * batch * seq
+            + 12.0 * cfg.num_layers * cfg.hidden_size * batch * seq * seq)
+
+
+def bench_bert_large(jax, on_tpu):
+    import jax.numpy as jnp
+
+    from apex_tpu.optimizers import FusedAdam
+    from apex_tpu.ops.xentropy import softmax_cross_entropy_loss
+    from apex_tpu.transformer.testing import BertModel, TransformerConfig
+
+    if on_tpu:
+        cfg = TransformerConfig(
+            hidden_size=1024, num_layers=24, num_attention_heads=16,
+            padded_vocab_size=30592, max_position_embeddings=512,
+            hidden_dropout=0.0, attention_dropout=0.0, tensor_axis=None,
+            dtype=jnp.bfloat16,
         )
-        logp = jax.nn.log_softmax(logits)
-        loss = -jnp.mean(logp[jnp.arange(y.shape[0]), y])
-        return loss, mutated["batch_stats"]
-
-    @partial(jax.jit, donate_argnums=(0, 1, 2))
-    def train_step(params, batch_stats, opt_state, batch):
-        (loss, new_stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-            params, batch_stats, batch
+        batch, seq, steps = 8, 512, 10
+    else:
+        cfg = TransformerConfig(
+            hidden_size=64, num_layers=2, num_attention_heads=4,
+            padded_vocab_size=512, max_position_embeddings=64,
+            hidden_dropout=0.0, attention_dropout=0.0, tensor_axis=None,
         )
-        params, opt_state = opt.step(grads, opt_state, params)
-        return params, new_stats, opt_state, loss
+        batch, seq, steps = 2, 32, 2
 
-    params = replicate(params, mesh)
-    batch_stats = replicate(batch_stats, mesh)
-    opt_state = replicate(opt_state, mesh)
+    model = BertModel(cfg)
+    tokens = jnp.zeros((batch, seq), jnp.int32)
+    mask = jnp.ones((batch, seq), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), tokens, mask)["params"]
+    n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
+    opt = FusedAdam(lr=1e-4)
+    state = opt.init(params)
 
-    rng = np.random.RandomState(0)
-    x = jnp.asarray(rng.randn(batch, image_size, image_size, 3),
-                    jnp.float32)
-    y = jnp.asarray(rng.randint(0, 1000, size=(batch,)))
-    sharded = dp_shard_batch((x, y), mesh)
+    def loss_fn(p):
+        lm_logits, bin_logits = model.apply({"params": p}, tokens, mask)
+        lm = softmax_cross_entropy_loss(
+            jnp.transpose(lm_logits, (1, 0, 2)), tokens, padding_idx=-1)
+        sop = -jax.nn.log_softmax(bin_logits)[:, 0]
+        return jnp.mean(lm) + jnp.mean(sop)
 
-    # warmup / compile
-    params, batch_stats, opt_state, loss = train_step(
-        params, batch_stats, opt_state, sharded
-    )
-    jax.block_until_ready(loss)
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def step(params, state):
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        params, state = opt.step(grads, state, params)
+        return params, state
 
+    _log("compile start")
+    t0 = time.perf_counter()
+    st = step(params, state)
+    jax.block_until_ready(st)
+    _log(f"compiled in {time.perf_counter() - t0:.1f}s; timing %d steps"
+         % steps)
+    dt, _ = _timeit(jax, step, st, steps)
+
+    tps = batch * seq * steps / dt
+    flops = _lm_train_flops(cfg, n_params, batch, seq) * steps / dt
+    return {
+        "value": round(tps, 1),
+        "unit": "tokens/sec/chip",
+        "mfu": round(flops / _peak_flops(jax.devices()[0]), 4)
+        if on_tpu else None,
+        "params": int(n_params),
+        "batch": batch,
+        "seq": seq,
+    }
+
+
+def bench_gpt_flash(jax, on_tpu):
+    import jax.numpy as jnp
+
+    from apex_tpu.optimizers import FusedAdam
+    from apex_tpu.transformer.testing import GPTModel, TransformerConfig
+
+    if on_tpu:
+        cfg = TransformerConfig(
+            hidden_size=768, num_layers=12, num_attention_heads=12,
+            padded_vocab_size=50304, max_position_embeddings=1024,
+            hidden_dropout=0.0, attention_dropout=0.0, tensor_axis=None,
+            use_flash_attention=True, dtype=jnp.bfloat16,
+        )
+        batch, seq, steps = 8, 1024, 10
+    else:
+        cfg = TransformerConfig(
+            hidden_size=64, num_layers=2, num_attention_heads=4,
+            padded_vocab_size=512, max_position_embeddings=128,
+            hidden_dropout=0.0, attention_dropout=0.0, tensor_axis=None,
+            use_flash_attention=True,
+        )
+        batch, seq, steps = 2, 128, 2
+
+    model = GPTModel(cfg)
+    tokens = jnp.zeros((batch, seq), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+    n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
+    opt = FusedAdam(lr=1e-4)
+    state = opt.init(params)
+
+    def loss_fn(p):
+        losses = model.apply({"params": p}, tokens, labels=tokens)
+        return jnp.mean(losses)
+
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def step(params, state):
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        params, state = opt.step(grads, state, params)
+        return params, state
+
+    _log("compile start")
+    t0 = time.perf_counter()
+    st = step(params, state)
+    jax.block_until_ready(st)
+    _log(f"compiled in {time.perf_counter() - t0:.1f}s; timing %d steps"
+         % steps)
+    dt, _ = _timeit(jax, step, st, steps)
+
+    tps = batch * seq * steps / dt
+    flops = _lm_train_flops(cfg, n_params, batch, seq) * steps / dt
+    return {
+        "value": round(tps, 1),
+        "unit": "tokens/sec/chip",
+        "mfu": round(flops / _peak_flops(jax.devices()[0]), 4)
+        if on_tpu else None,
+        "params": int(n_params),
+        "batch": batch,
+        "seq": seq,
+        "flash_attention": True,
+    }
+
+
+def bench_tp_gpt(jax, on_tpu):
+    """Tensor-parallel GPT train step via shard_map over the tp axis
+    (tp = all attached devices; tp=1 on the single bench chip still
+    exercises the TP code path)."""
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from apex_tpu import parallel
+    from apex_tpu.optimizers import FusedAdam
+    from apex_tpu.parallel import collectives as cc
+    from apex_tpu.transformer import tensor_parallel as tp
+    from apex_tpu.transformer.testing import GPTModel, TransformerConfig
+
+    n = len(jax.devices())
+    parallel.initialize_model_parallel(tensor_model_parallel_size=n)
+    try:
+        if on_tpu:
+            cfg = TransformerConfig(
+                hidden_size=1024, num_layers=4, num_attention_heads=16,
+                padded_vocab_size=50304, max_position_embeddings=1024,
+                hidden_dropout=0.0, attention_dropout=0.0,
+                tensor_axis="tp", sequence_parallel=n > 1,
+                dtype=jnp.bfloat16,
+            )
+            batch, seq, steps = 8, 1024, 10
+        else:
+            cfg = TransformerConfig(
+                hidden_size=64, num_layers=2, num_attention_heads=4,
+                padded_vocab_size=512, max_position_embeddings=64,
+                hidden_dropout=0.0, attention_dropout=0.0,
+                tensor_axis="tp", sequence_parallel=n > 1,
+            )
+            batch, seq, steps = 2, 32, 2
+
+        model = GPTModel(cfg)
+        tokens = jnp.zeros((batch, seq), jnp.int32)
+
+        def tp_init(tokens):
+            return model.init(jax.random.PRNGKey(0), tokens)["params"]
+
+        param_specs = tp.infer_param_specs(jax.eval_shape(tp_init, tokens))
+        params = cc.shard_over(tp_init, in_specs=P(),
+                               out_specs=param_specs)(tokens)
+
+        def tp_loss(p, t):
+            losses = model.apply({"params": p}, t, labels=t)
+            return jax.lax.pmean(jnp.mean(losses), "tp")
+
+        opt = FusedAdam(lr=1e-4)
+        state0 = jax.eval_shape(opt.init, params)
+        state_specs = type(state0)(
+            step=P(),
+            slots={k: param_specs for k in state0.slots},
+            master=param_specs if state0.master is not None else None,
+        )
+        state = cc.shard_over(opt.init, in_specs=(param_specs,),
+                              out_specs=state_specs)(params)
+
+        @partial(jax.jit, donate_argnums=(0, 1))
+        def step(params, state, tokens):
+            def local(p, s, t):
+                g = jax.grad(tp_loss)(p, t)
+                return opt.step(g, s, p)
+            return cc.shard_over(
+                local,
+                in_specs=(param_specs, state_specs, P()),
+                out_specs=(param_specs, state_specs),
+            )(params, state, tokens)
+
+        _log("tp_gpt: compile start")
+        t0 = time.perf_counter()
+        st = step(params, state, tokens)
+        jax.block_until_ready(st)
+        _log(f"tp_gpt: compiled in {time.perf_counter() - t0:.1f}s")
+        dt, _ = _timeit(jax, lambda p, s: step(p, s, tokens), st, steps)
+
+        tps = batch * seq * steps / dt
+        return {
+            "value": round(tps, 1),
+            "unit": "tokens/sec",
+            "tp": n,
+            "sequence_parallel": n > 1,
+            "batch": batch,
+            "seq": seq,
+        }
+    finally:
+        parallel.mesh.destroy_model_parallel()
+
+
+def bench_fused_adam_step(jax, on_tpu):
+    """Optimizer step-time microbench: FusedAdam over a resnet-sized tree
+    (the BASELINE "fused-optimizer step time" metric)."""
+    import jax.numpy as jnp
+
+    from apex_tpu.optimizers import FusedAdam
+
+    n_tensors = 161  # RN50-ish tree
+    size = 160_000 if on_tpu else 1_000
+    keys = [f"w{i}" for i in range(n_tensors)]
+    params = {k: jnp.ones((size,), jnp.float32) * 0.01 for k in keys}
+    grads = {k: jnp.full((size,), 1e-4, jnp.float32) for k in keys}
+    opt = FusedAdam(lr=1e-3, weight_decay=1e-2, adam_w_mode=True)
+    state = opt.init(params)
+
+    @partial(jax.jit, donate_argnums=(1, 2))
+    def step(grads, state, params):
+        return opt.step(grads, state, params)
+
+    params, state = step(grads, state, params)  # compile (returns new trees)
+    jax.block_until_ready((params, state))
+    steps = 50 if on_tpu else 5
     t0 = time.perf_counter()
     for _ in range(steps):
-        params, batch_stats, opt_state, loss = train_step(
-            params, batch_stats, opt_state, sharded
-        )
-    jax.block_until_ready(loss)
+        params, state = step(grads, state, params)
+    jax.block_until_ready((params, state))
     dt = time.perf_counter() - t0
+    return {
+        "value": round(dt / steps * 1e6, 1),
+        "unit": "us/step",
+        "n_tensors": n_tensors,
+        "n_elements": n_tensors * size,
+    }
 
-    ips_per_chip = batch * steps / dt / n_chips
+
+# ---------------------------------------------------------------------------
+
+BENCHES = {
+    "resnet50_o2": bench_resnet50_o2,
+    "resnet50_lamb_syncbn": bench_resnet50_lamb_syncbn,
+    "bert_large": bench_bert_large,
+    "gpt_flash": bench_gpt_flash,
+    "tp_gpt": bench_tp_gpt,
+    "fused_adam_step": bench_fused_adam_step,
+}
+# headline first: if the deadline hits, the most important number exists.
+BENCH_ORDER = ["resnet50_o2", "gpt_flash", "bert_large",
+               "resnet50_lamb_syncbn", "tp_gpt", "fused_adam_step"]
+
+
+def run_one(name: str) -> None:
+    """Child mode: init the backend, run one bench, print its JSON."""
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS", "").lower() == "cpu":
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass
+    _log(f"{name}: initializing backend")
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+    _log(f"{name}: backend up ({dev.platform} {getattr(dev, 'device_kind', '')})")
+    rec = BENCHES[name](jax, on_tpu)
+    rec["platform"] = dev.platform
+    _log(f"{name}: done -> {rec.get('value')} {rec.get('unit')}")
+    print(json.dumps(rec), flush=True)
+
+
+def _run_child(name: str, platform: str, timeout: float) -> dict:
+    env = dict(os.environ)
+    if platform == "cpu":
+        env["JAX_PLATFORMS"] = "cpu"
+    _log(f"launching {name} (timeout {timeout:.0f}s)")
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--one", name],
+            timeout=timeout, capture_output=True, env=env,
+        )
+    except subprocess.TimeoutExpired:
+        _log(f"{name}: TIMEOUT after {timeout:.0f}s")
+        return {"error": f"timeout after {timeout:.0f}s"}
+    err_tail = proc.stderr.decode(errors="replace")[-1500:]
+    if proc.returncode != 0:
+        _log(f"{name}: rc={proc.returncode}\n{err_tail}")
+        return {"error": f"rc={proc.returncode}: {err_tail[-300:]}"}
+    try:
+        return json.loads(proc.stdout.decode().strip().splitlines()[-1])
+    except Exception as e:
+        _log(f"{name}: unparseable output ({e!r})\n{err_tail}")
+        return {"error": f"unparseable output: {e!r}"}
+
+
+def main():
+    platform = probe_platform()
+    on_tpu = platform == "tpu"
+    per_bench = float(os.environ.get(
+        "BENCH_TIMEOUT_S", "900" if on_tpu else "300"))
+    deadline = time.monotonic() + float(os.environ.get(
+        "BENCH_DEADLINE_S", "2700" if on_tpu else "900"))
+
+    results = {}
+    for name in BENCH_ORDER:
+        budget = min(per_bench, deadline - time.monotonic())
+        if budget < 60:
+            _log(f"{name}: skipped (deadline)")
+            results[name] = {"error": "skipped: global deadline"}
+            continue
+        results[name] = _run_child(name, platform, budget)
+
+    headline = results["resnet50_o2"]
+    ok = "error" not in headline
     record = {
         "metric": "resnet50_o2_train_throughput",
-        "value": round(ips_per_chip, 1),
+        "value": headline.get("value", 0.0) if ok else 0.0,
         "unit": "images/sec/chip",
-        "vs_baseline": round(ips_per_chip / APEX_A100_IMAGES_PER_SEC, 3),
-        "platform": jax.devices()[0].platform,
-        "n_chips": n_chips,
-        "batch_per_chip": batch_per_chip,
-        "image_size": image_size,
+        "vs_baseline": (round(headline["value"] / APEX_A100_IMAGES_PER_SEC, 3)
+                        if ok and on_tpu else None),
+        "platform": platform,
+        "headline": headline,
+        "extras": {k: v for k, v in results.items() if k != "resnet50_o2"},
     }
-    if not on_tpu:
-        # toy CPU-fallback shapes: the A100 comparison is meaningless there
-        record["vs_baseline"] = None
     print(json.dumps(record))
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) >= 3 and sys.argv[1] == "--one":
+        run_one(sys.argv[2])
+    else:
+        main()

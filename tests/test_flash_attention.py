@@ -148,3 +148,169 @@ def test_gpt_flash_attention_matches_fused_softmax():
     l1 = m1.apply({"params": params}, tokens, labels=tokens)
     np.testing.assert_allclose(np.asarray(l0), np.asarray(l1),
                                rtol=2e-5, atol=2e-5)
+
+
+def naive_attention_masked(q, k, v, causal, seg_q=None, seg_k=None,
+                           scale=None):
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / np.sqrt(d)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
+    sq, sk = s.shape[-2:]
+    mask = jnp.ones((q.shape[0], 1, sq, sk), bool)
+    if causal:
+        mask = mask & jnp.tril(jnp.ones((sq, sk), bool))
+    if seg_q is not None:
+        mask = mask & (seg_q[:, None, :, None] == seg_k[:, None, None, :])
+    s = jnp.where(mask, s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    p = jnp.where(jnp.isnan(p), 0.0, p)  # fully-masked rows -> zero output
+    return jnp.einsum("bhqk,bhkd->bhqd", p.astype(q.dtype), v)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_segment_ids_match_naive(causal):
+    """Packed-varlen via segment ids (fmha cu_seqlens parity)."""
+    b, h, s, d = 2, 2, 64, 8
+    ks = jax.random.split(jax.random.PRNGKey(1), 3)
+    q, k, v = (jax.random.normal(kk, (b, h, s, d)) for kk in ks)
+    # two packed sequences of length 24 and 40 per row
+    seg = jnp.concatenate([jnp.zeros((b, 24), jnp.int32),
+                           jnp.ones((b, 40), jnp.int32)], axis=1)
+
+    out = flash_attention(q, k, v, causal=causal,
+                          segment_ids_q=seg, segment_ids_kv=seg)
+    ref = naive_attention_masked(q, k, v, causal, seg, seg)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+    w = jax.random.normal(jax.random.PRNGKey(5), (b, h, s, d))
+    g = jax.grad(lambda q, k, v: jnp.sum(
+        flash_attention(q, k, v, causal=causal, segment_ids_q=seg,
+                        segment_ids_kv=seg) * w), argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(lambda q, k, v: jnp.sum(
+        naive_attention_masked(q, k, v, causal, seg, seg) * w),
+        argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(g, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("s", [17, 100, 130])
+def test_flash_non_power_of_two_lengths(s):
+    """Odd lengths pad to the block grid instead of degrading to block=s."""
+    b, h, d = 1, 2, 8
+    ks = jax.random.split(jax.random.PRNGKey(2), 3)
+    q, k, v = (jax.random.normal(kk, (b, h, s, d)) for kk in ks)
+    for causal in (False, True):
+        out = flash_attention(q, k, v, causal=causal)
+        ref = naive_attention(q, k, v, causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+    g = jax.grad(lambda q: jnp.sum(flash_attention(q, k, v, causal=True)))(q)
+    gr = jax.grad(lambda q: jnp.sum(naive_attention(q, k, v, True)))(q)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(gr),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_flash_cross_attention_lengths():
+    """sq != sk, both non-multiples of the block."""
+    b, h, d = 2, 2, 8
+    q = jax.random.normal(jax.random.PRNGKey(0), (b, h, 33, d))
+    k = jax.random.normal(jax.random.PRNGKey(1), (b, h, 57, d))
+    v = jax.random.normal(jax.random.PRNGKey(2), (b, h, 57, d))
+    out = flash_attention(q, k, v, causal=False)
+    ref = naive_attention(q, k, v, False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_flash_fully_masked_rows_zero():
+    """A q shard strictly before the kv shard under causal masking must
+    produce zero output / NEG_INF lse, not mean(V) (round-1 ADVICE)."""
+    from apex_tpu.ops.flash_attention import NEG_INF, flash_attention_with_lse
+
+    b, h, s, d = 1, 1, 32, 8
+    ks = jax.random.split(jax.random.PRNGKey(3), 3)
+    q, k, v = (jax.random.normal(kk, (b, h, s, d)) for kk in ks)
+    # kv chunk lives entirely *after* the q chunk: every row fully masked
+    out, lse = flash_attention_with_lse(q, k, v, True, None, 256, 512,
+                                        0, s + 64)
+    assert np.allclose(np.asarray(out), 0.0)
+    assert np.all(np.asarray(lse) <= NEG_INF * 0.5)
+
+    # gradients through the chunk entry points are zero too
+    from apex_tpu.ops.flash_attention import dkv_chunk, dq_chunk
+    do = jax.random.normal(jax.random.PRNGKey(4), (b, h, s, d))
+    delta = jnp.sum(do * out, axis=-1)
+    dq = dq_chunk(q, k, v, do, lse, delta, causal=True, kv_offset=s + 64)
+    dk, dv = dkv_chunk(q, k, v, do, lse, delta, causal=True,
+                       kv_offset=s + 64)
+    assert np.allclose(np.asarray(dq), 0.0)
+    assert np.allclose(np.asarray(dk), 0.0)
+    assert np.allclose(np.asarray(dv), 0.0)
+
+
+def test_flash_dropout_statistics_and_determinism():
+    b, h, s, d = 2, 2, 64, 8
+    ks = jax.random.split(jax.random.PRNGKey(6), 3)
+    q, k, v = (jax.random.normal(kk, (b, h, s, d)) for kk in ks)
+    rate = 0.3
+
+    o1 = flash_attention(q, k, v, dropout_rate=rate, dropout_seed=7)
+    o2 = flash_attention(q, k, v, dropout_rate=rate, dropout_seed=7)
+    o3 = flash_attention(q, k, v, dropout_rate=rate, dropout_seed=8)
+    np.testing.assert_array_equal(np.asarray(o1), np.asarray(o2))
+    assert not np.allclose(np.asarray(o1), np.asarray(o3))
+
+    # E[dropout(attn)] == attn: average over seeds approaches the clean out
+    outs = [flash_attention(q, k, v, dropout_rate=rate, dropout_seed=i)
+            for i in range(64)]
+    mean = np.mean([np.asarray(o) for o in outs], axis=0)
+    clean = np.asarray(flash_attention(q, k, v))
+    np.testing.assert_allclose(mean, clean, atol=0.15)
+
+    # gradient determinism (bwd regenerates the identical mask)
+    g1 = jax.grad(lambda q: jnp.sum(flash_attention(
+        q, k, v, dropout_rate=rate, dropout_seed=7)))(q)
+    g2 = jax.grad(lambda q: jnp.sum(flash_attention(
+        q, k, v, dropout_rate=rate, dropout_seed=7)))(q)
+    np.testing.assert_array_equal(np.asarray(g1), np.asarray(g2))
+
+
+def test_flash_dropout_grad_matches_masked_reference():
+    """Grads under dropout == grads of an explicitly-masked naive attention
+    built from the kernel's own keep mask."""
+    from apex_tpu.ops.flash_attention import _keep_mask
+
+    b, h, s, d = 1, 2, 32, 8
+    ks = jax.random.split(jax.random.PRNGKey(8), 3)
+    q, k, v = (jax.random.normal(kk, (b, h, s, d)) for kk in ks)
+    rate, seed = 0.25, 11
+
+    rows = jnp.arange(s, dtype=jnp.int32)[:, None]
+    cols = jnp.arange(s, dtype=jnp.int32)[None, :]
+    keeps = jnp.stack([
+        jnp.stack([_keep_mask(jnp.int32(seed), bh, rows, cols, rate)
+                   for bh in range(b * h)]).reshape(h, s, s)
+    ])  # b=1
+
+    def ref(q, k, v):
+        sc = 1.0 / np.sqrt(d)
+        sm = jax.nn.softmax(
+            jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * sc,
+            axis=-1)
+        sm = jnp.where(keeps, sm / (1 - rate), 0.0)
+        return jnp.einsum("bhqk,bhkd->bhqd", sm.astype(q.dtype), v)
+
+    out = flash_attention(q, k, v, dropout_rate=rate, dropout_seed=seed)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref(q, k, v)),
+                               rtol=2e-5, atol=2e-5)
+    w = jax.random.normal(jax.random.PRNGKey(9), (b, h, s, d))
+    g = jax.grad(lambda q, k, v: jnp.sum(flash_attention(
+        q, k, v, dropout_rate=rate, dropout_seed=seed) * w),
+        argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(lambda q, k, v: jnp.sum(ref(q, k, v) * w),
+                  argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(g, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=2e-4, atol=2e-4)

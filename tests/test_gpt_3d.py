@@ -38,7 +38,7 @@ def serial_loss(cfg, params, tokens):
     """Same modules, same global params, no mesh (degraded single-rank)."""
     from apex_tpu.ops.softmax import AttnMaskType
     from apex_tpu.transformer.layers.layer_norm import FusedLayerNorm
-    from apex_tpu.transformer.testing.standalone_gpt import gpt_loss
+    from apex_tpu.transformer.testing.standalone_gpt import gpt_next_token_loss
     from apex_tpu.transformer.testing.standalone_transformer_lm import (
         Embedding, ParallelTransformerLayer, parallel_lm_logits,
     )
@@ -60,7 +60,7 @@ def serial_loss(cfg, params, tokens):
         h = ln.apply({"params": params.final_ln}, h)
         logits = parallel_lm_logits(
             h, params.embedding["word_embeddings"]["embedding"], cfg)
-        losses.append(jnp.mean(gpt_loss(logits, t, cfg)))
+        losses.append(jnp.mean(gpt_next_token_loss(logits, t, cfg)))
     return jnp.mean(jnp.stack(losses))
 
 
