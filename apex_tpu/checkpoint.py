@@ -1,0 +1,185 @@
+"""Checkpoint / resume for full training state.
+
+Behavioral spec: the reference's checkpoint surfaces — ``amp.state_dict``
+(``apex/amp/frontend.py:365-404``), the imagenet example's model+optimizer
+resume (``examples/imagenet/main_amp.py:177-193``), ``FP16_Optimizer.
+state_dict`` (``apex/fp16_utils/fp16_optimizer.py:212-273``), and
+``DistributedFusedAdam.state_dict(gather_on_root=...)`` /
+``load_state_dict`` (``apex/contrib/optimizers/distributed_fused_adam.py``)
+which gather the ZeRO-sharded optimizer shards into one portable dict.
+
+TPU-first design: a checkpoint is "any pytree, restored against a
+template".  ``save_checkpoint`` flattens the tree and writes the leaves
+(host numpy) plus a path manifest; ``restore_checkpoint`` unflattens
+against a ``like`` tree and verifies the manifest — so params, optimizer
+``OptState``s, loss-scaler state, and custom counters all ride the same
+two functions (no per-class state_dict plumbing).  ZeRO portability is
+handled by :func:`gather_zero_state`/:func:`scatter_zero_state`: because
+the SPMD shard layout is just "rank-major padded ravel", gathering is a
+host-side reshape of the global arrays — no collectives, unlike the
+reference's rank-0 NCCL gather.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "save_checkpoint",
+    "restore_checkpoint",
+    "gather_zero_state",
+    "scatter_zero_state",
+]
+
+
+def _path_str(path) -> str:
+    parts = []
+    for p in path:
+        key = getattr(p, "key", None)
+        if key is None:
+            key = getattr(p, "idx", None)
+        parts.append(str(key) if key is not None else str(p))
+    return "/".join(parts)
+
+
+def save_checkpoint(path: str, tree: Any, step: Optional[int] = None) -> None:
+    """Write ``tree`` (any pytree of arrays/scalars) to ``path`` (.npz).
+
+    Leaves are fetched to host (works on sharded global arrays — JAX
+    assembles the full array) and stored with a manifest of tree paths,
+    shapes, and dtypes for restore-time verification.
+    """
+    flat = jax.tree_util.tree_leaves_with_path(tree)
+    manifest = {
+        "version": 1,
+        "step": step,
+        "leaves": [
+            {"path": _path_str(p), "shape": list(np.shape(x)),
+             "dtype": str(np.asarray(jax.device_get(x)).dtype)}
+            for p, x in flat
+        ],
+    }
+    arrays = {f"leaf_{i}": np.asarray(jax.device_get(x))
+              for i, (_, x) in enumerate(flat)}
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        np.savez(f, __manifest__=json.dumps(manifest), **arrays)
+    os.replace(tmp, path)  # atomic: no torn checkpoints on preemption
+
+
+def restore_checkpoint(path: str, like: Any):
+    """Read a checkpoint into the structure of ``like``.
+
+    Returns ``(tree, step)``.  Leaf count and per-leaf paths must match
+    the template (shape mismatches raise with the offending path, the
+    reference's load_state_dict strictness).
+    """
+    with np.load(path, allow_pickle=False) as data:
+        manifest = json.loads(str(data["__manifest__"]))
+        leaves = [data[f"leaf_{i}"] for i in range(len(manifest["leaves"]))]
+
+    like_flat, treedef = jax.tree_util.tree_flatten(like)
+    if len(like_flat) != len(leaves):
+        raise ValueError(
+            f"checkpoint has {len(leaves)} leaves, template has "
+            f"{len(like_flat)}")
+    like_paths = [
+        _path_str(p)
+        for p, _ in jax.tree_util.tree_leaves_with_path(like)
+    ]
+    out = []
+    for i, (rec, arr, tpath, tleaf) in enumerate(
+            zip(manifest["leaves"], leaves, like_paths, like_flat)):
+        if rec["path"] != tpath:
+            raise ValueError(
+                f"leaf {i} path mismatch: checkpoint {rec['path']!r} vs "
+                f"template {tpath!r}")
+        if tuple(rec["shape"]) != tuple(np.shape(tleaf)):
+            raise ValueError(
+                f"{tpath}: checkpoint shape {rec['shape']} vs template "
+                f"{list(np.shape(tleaf))}")
+        out.append(jnp.asarray(arr))
+    return jax.tree_util.tree_unflatten(treedef, out), manifest["step"]
+
+
+# ---------------------------------------------------------------------------
+# ZeRO (DistributedFusedAdam/LAMB) portability
+# ---------------------------------------------------------------------------
+
+
+def _unshard_host(chunked, param):
+    """Global rank-major chunked leaf -> full leaf shaped like ``param``."""
+    flat = jnp.asarray(chunked).ravel()
+    n = int(np.prod(np.shape(param))) if np.ndim(param) else 1
+    return flat[:n].reshape(np.shape(param))
+
+
+def _shard_host(full, chunked_like):
+    """Full leaf -> padded rank-major layout shaped like the sharded
+    global leaf."""
+    target = np.shape(chunked_like)
+    flat = jnp.asarray(full).ravel().astype(jnp.asarray(chunked_like).dtype)
+    pad = int(np.prod(target)) - flat.size
+    return jnp.pad(flat, (0, pad)).reshape(target)
+
+
+def gather_zero_state(opt, state, params):
+    """Portable (unsharded, fp32-master) state dict for a ZeRO-sharded
+    optimizer — the ``state_dict(gather_on_root=True)`` analog.
+
+    ``state`` holds *global* arrays whose leaves are the rank-major
+    concatenation of per-rank chunks (the shape they have outside the
+    training ``shard_map``), so gathering is pure reshaping.
+    """
+    from apex_tpu.contrib.optimizers.distributed_fused_adam import join_fp32
+
+    slots = {
+        name: jax.tree_util.tree_map(_unshard_host, tree, params)
+        for name, tree in state.slots.items()
+    }
+    master = None
+    if state.master is not None:
+        if getattr(opt, "store_param_remainders", False):
+            def join(lo, p):
+                hi = jnp.asarray(p, jnp.bfloat16).ravel()
+                lo_flat = jnp.asarray(lo).ravel()[: hi.size]
+                return join_fp32(hi, lo_flat).reshape(np.shape(p))
+
+            master = jax.tree_util.tree_map(join, state.master, params)
+        else:
+            master = jax.tree_util.tree_map(_unshard_host, state.master,
+                                            params)
+    return {"step": state.step, "slots": slots, "master": master}
+
+
+def scatter_zero_state(opt, portable, state_like, params):
+    """Inverse of :func:`gather_zero_state`: re-shard a portable state
+    dict into the layout of ``state_like`` (possibly under a different
+    dp world size — the point of portable ZeRO checkpoints)."""
+    from apex_tpu.contrib.optimizers.distributed_fused_adam import split_fp32
+
+    slots = {
+        name: jax.tree_util.tree_map(
+            _shard_host, portable["slots"][name], tree)
+        for name, tree in state_like.slots.items()
+    }
+    master = None
+    if state_like.master is not None:
+        if getattr(opt, "store_param_remainders", False):
+            def split(m32, like):
+                _, lo = split_fp32(jnp.asarray(m32, jnp.float32).ravel())
+                return _shard_host(lo, like)
+
+            master = jax.tree_util.tree_map(
+                split, portable["master"], state_like.master)
+        else:
+            master = jax.tree_util.tree_map(
+                _shard_host, portable["master"], state_like.master)
+    return type(state_like)(step=jnp.asarray(portable["step"]),
+                            slots=slots, master=master)

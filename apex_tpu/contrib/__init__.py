@@ -1,1 +1,15 @@
-"""apex_tpu.contrib — optional extensions (reference ``apex/contrib``)."""
+"""apex_tpu.contrib — optional extensions (reference ``apex/contrib``).
+
+Subpackages/modules: ``optimizers`` (ZeRO-sharded DistributedFusedAdam/
+LAMB), ``sparsity`` (ASP 2:4), ``group_norm`` (NHWC + SiLU),
+``focal_loss``, ``index_mul_2d``, ``transducer`` (joint + loss).
+"""
+
+from apex_tpu.contrib.focal_loss import focal_loss  # noqa: F401
+from apex_tpu.contrib.group_norm import GroupNorm, group_norm_nhwc  # noqa: F401
+from apex_tpu.contrib.index_mul_2d import index_mul_2d  # noqa: F401
+from apex_tpu.contrib.transducer import (  # noqa: F401
+    TransducerJoint,
+    transducer_joint,
+    transducer_loss,
+)

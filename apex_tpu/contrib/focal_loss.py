@@ -1,0 +1,67 @@
+"""Fused sigmoid focal loss (detection).
+
+Behavioral spec: ``apex/contrib/focal_loss/focal_loss.py:6-60`` +
+``apex/contrib/csrc/focal_loss/focal_loss_cuda_kernel.cu:16-131``:
+per-anchor integer targets ``y`` with the EfficientDet conventions —
+``y >= 0``: positive match at class ``y``; ``y == -1``: all-negative
+anchor; ``y == -2``: ignored anchor (zero loss/grad); classes past
+``num_real_classes`` are padding and contribute nothing.  Loss is summed
+over all elements and normalized by ``num_positives_sum``; label smoothing
+redistributes ``smoothing/K`` mass exactly as the kernel's
+``nn/np/pn/pp_norm`` coefficients.
+
+TPU-first: the kernel's stabilized ``base + off_a`` decomposition is just
+the standard softplus-form BCE with a soft target ``q``::
+
+    bce   = softplus(x) - q * x          # = -(q log σ + (1-q) log(1-σ))
+    coeff = α·(1-σ)^γ  (positives)  |  (1-α)·σ^γ  (negatives)
+    loss  = Σ coeff · bce / num_positives_sum
+
+One fused XLA elementwise chain + reduction; gradients come from autodiff
+of the same expression (the CUDA side saves ``partial_grad`` in forward —
+unnecessary under XLA, recompute is a fused flop, not an HBM trip).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["focal_loss"]
+
+
+def focal_loss(
+    cls_output,
+    cls_targets_at_level,
+    num_positives_sum,
+    num_real_classes: int,
+    alpha: float,
+    gamma: float,
+    label_smoothing: float = 0.0,
+):
+    """Scalar focal loss.
+
+    ``cls_output: [..., K_pad]`` logits (fp32/bf16/fp16),
+    ``cls_targets_at_level: [...]`` int targets (-2 ignore, -1 negative,
+    >=0 positive class), ``num_positives_sum``: scalar normalizer.
+    """
+    x = cls_output.astype(jnp.float32)
+    y = cls_targets_at_level
+    K = x.shape[-1]
+
+    cls_idx = jax.lax.broadcasted_iota(jnp.int32, x.shape, x.ndim - 1)
+    pos = (y[..., None] >= 0) & (cls_idx == y[..., None])
+    valid = (y[..., None] != -2) & (cls_idx < num_real_classes)
+
+    s = label_smoothing
+    q_pos = 1.0 - s + s / num_real_classes
+    q_neg = s / num_real_classes
+    q = jnp.where(pos, q_pos, q_neg)
+
+    bce = jax.nn.softplus(x) - q * x
+    sig = jax.nn.sigmoid(x)
+    coeff = jnp.where(pos,
+                      alpha * (1.0 - sig) ** gamma,
+                      (1.0 - alpha) * sig ** gamma)
+    loss = jnp.where(valid, coeff * bce, 0.0)
+    return jnp.sum(loss) / jnp.asarray(num_positives_sum, jnp.float32).reshape(())

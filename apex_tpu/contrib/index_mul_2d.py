@@ -1,0 +1,36 @@
+"""index_mul_2d: ``out = in1[idx] * in2`` fused gather-multiply.
+
+Behavioral spec: ``apex/contrib/index_mul_2d/index_mul_2d.py`` — 2D
+``in1 [N1, H]``, ``in2 [N2, H]``, ``idx [N2]`` indexing dim 0 of ``in1``;
+backward scatter-adds ``grad_out * in2`` into ``grad_in1`` and gathers for
+``grad_in2`` (their dedicated CUDA kernels incl. a fp16 variant with fp32
+atomics).
+
+TPU-first: ``jnp.take`` + multiply is one fused XLA gather-mul, and the
+autodiff transpose of the gather *is* the scatter-add the reference hand
+writes — no custom kernels, identical semantics, fp32 accumulation for
+low-precision inputs via ``preferred`` upcast of the scatter (XLA default).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["index_mul_2d"]
+
+
+def index_mul_2d(in1, in2, idx1):
+    """``out[i, :] = in1[idx1[i], :] * in2[i, :]``.
+
+    Shape/dtype checks mirror the reference's (2D float tensors, matching
+    dtypes, 1D int index).
+    """
+    if in1.ndim != 2 or in2.ndim != 2:
+        raise ValueError("in1 and in2 must be 2-dimension tensors")
+    if idx1.ndim != 1:
+        raise ValueError("idx1 must be a 1-dimension tensor")
+    if in2.shape[0] != idx1.shape[0]:
+        raise ValueError("in2 and idx1 must agree on dim 0")
+    if in1.dtype != in2.dtype:
+        raise ValueError("in1 and in2 must share a dtype")
+    return jnp.take(in1, idx1, axis=0) * in2

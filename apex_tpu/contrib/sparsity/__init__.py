@@ -1,0 +1,35 @@
+"""ASP 2:4 structured sparsity (reference ``apex/contrib/sparsity``)."""
+
+from apex_tpu.contrib.sparsity.asp import (
+    ASP,
+    SparseOptimizer,
+    apply_masks,
+    mask_sparsity,
+)
+from apex_tpu.contrib.sparsity.masklib import (
+    create_mask,
+    m4n2_1d,
+    m4n2_2d_best,
+    mn_1d_best,
+    mn_2d_best,
+)
+from apex_tpu.contrib.sparsity.permutation import (
+    kept_magnitude,
+    permuted_mask,
+    search_permutation,
+)
+
+__all__ = [
+    "ASP",
+    "SparseOptimizer",
+    "apply_masks",
+    "mask_sparsity",
+    "create_mask",
+    "m4n2_1d",
+    "m4n2_2d_best",
+    "mn_1d_best",
+    "mn_2d_best",
+    "kept_magnitude",
+    "permuted_mask",
+    "search_permutation",
+]

@@ -1,0 +1,183 @@
+"""ASP — automatic structured (2:4) sparsity for training.
+
+Behavioral spec: ``apex/contrib/sparsity/asp.py`` —
+``init_model_for_pruning`` (whitelist-module selection + mask buffers,
+``:40-116``), ``init_optimizer_for_pruning`` (mask grads before / params
+after the step, ``:185-211``), ``compute_sparse_masks``/
+``restore_pruned_weights``/``is_sparsity_enabled`` (``:213-290``),
+``prune_trained_model`` (``:292``).
+
+TPU-first redesign: no monkey-patching or module mutation.  Masks are an
+explicit pytree mirroring ``params`` (scalar ``1.0`` for non-pruned
+leaves, so ``apply_masks`` is a plain fused tree-multiply under jit), and
+the optimizer hook is :class:`SparseOptimizer`, a wrapper honoring the
+framework's ``opt.step(grads, state, params, ...)`` protocol — the
+functional analog of the reference's patched ``optimizer.step``.
+Restoring dense weights is the caller keeping the pre-pruning params (pure
+functions make ``allow_recompute_mask`` storage unnecessary).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.contrib.sparsity.masklib import create_mask
+
+__all__ = ["ASP", "SparseOptimizer", "apply_masks", "mask_sparsity"]
+
+
+def _path_str(path) -> str:
+    parts = []
+    for p in path:
+        key = getattr(p, "key", None)
+        parts.append(str(key) if key is not None else str(p))
+    return "/".join(parts)
+
+
+def apply_masks(tree, masks):
+    """Elementwise ``tree * masks`` (masks carry scalar 1.0 off the pruned
+    leaves); jit-friendly."""
+    return jax.tree_util.tree_map(
+        lambda x, m: x * jnp.asarray(m, jnp.asarray(x).dtype), tree, masks)
+
+
+def mask_sparsity(masks) -> dict:
+    """{path: fraction_zero} for the pruned leaves."""
+    out = {}
+    for path, m in jax.tree_util.tree_leaves_with_path(masks):
+        m = jnp.asarray(m)
+        if m.ndim == 0:
+            continue
+        out[_path_str(path)] = float(1.0 - m.sum() / m.size)
+    return out
+
+
+class SparseOptimizer:
+    """Masked-step wrapper: grads are masked before the inner step and the
+    stepped params are re-masked after (the reference's ``__step`` patch,
+    ``asp.py:197-211``), so pruned weights stay exactly zero through
+    momentum/weight-decay updates."""
+
+    def __init__(self, opt, masks):
+        self.opt = opt
+        self.masks = masks
+
+    def init(self, params):
+        return self.opt.init(params)
+
+    def step(self, grads, state, params, **kwargs):
+        grads = apply_masks(grads, self.masks)
+        new_params, new_state = self.opt.step(grads, state, params, **kwargs)
+        return apply_masks(new_params, self.masks), new_state
+
+    def __getattr__(self, name):
+        return getattr(self.opt, name)
+
+
+@dataclasses.dataclass
+class ASP:
+    """Functional ASP.
+
+    ``mask_calculator``: pattern string (``"m4n2_1d"``, ``"m4n2_2d_best"``)
+    or a callable ``weight -> mask``; ``allow_permutation`` routes through
+    the channel-permutation search
+    (:func:`apex_tpu.contrib.sparsity.permutation.permuted_mask`).
+    Eligibility mirrors the reference whitelist (Linear/Conv weights): leaf
+    name in ``param_names``, ndim ≥ 2, and both matrix dims ≥ ``m`` after
+    the [out, reduction] view; ``allowed/disallowed_layer_names`` filter on
+    path substrings.
+    """
+
+    mask_calculator: Union[str, Callable] = "m4n2_1d"
+    param_names: Sequence[str] = ("kernel",)
+    allowed_layer_names: Optional[Sequence[str]] = None
+    disallowed_layer_names: Sequence[str] = ()
+    allow_permutation: bool = False
+    m: int = 4
+    n: int = 2
+
+    def _eligible(self, path, leaf) -> bool:
+        s = _path_str(path)
+        name = s.rsplit("/", 1)[-1]
+        if name not in self.param_names:
+            return False
+        x = jnp.asarray(leaf)
+        if x.ndim < 2 or x.shape[-1] < self.m:
+            return False
+        red = 1
+        for d in x.shape[:-1]:
+            red *= d
+        if red < self.m:
+            return False
+        if self.allowed_layer_names is not None and not any(
+                a in s for a in self.allowed_layer_names):
+            return False
+        if any(d in s for d in self.disallowed_layer_names):
+            return False
+        return True
+
+    def eligible_paths(self, params):
+        return [_path_str(p)
+                for p, leaf in jax.tree_util.tree_leaves_with_path(params)
+                if self._eligible(p, leaf)]
+
+    def compute_sparse_masks(self, params):
+        """Masks pytree for ``params`` (reference
+        ``compute_sparse_masks``); non-pruned leaves get scalar 1.0."""
+        if self.allow_permutation:
+            from apex_tpu.contrib.sparsity.permutation import permuted_mask
+
+            def calc(w):
+                return permuted_mask(
+                    w,
+                    pattern=self.mask_calculator
+                    if isinstance(self.mask_calculator, str) else "m4n2_1d",
+                    m=self.m, n=self.n)
+        else:
+            def calc(w):
+                return create_mask(w, self.mask_calculator)
+
+        def leaf_mask(path, leaf):
+            if self._eligible(path, leaf):
+                return calc(leaf)
+            return jnp.ones((), jnp.asarray(leaf).dtype)
+
+        return jax.tree_util.tree_map_with_path(leaf_mask, params)
+
+    def prune(self, params) -> Tuple:
+        """(pruned_params, masks)."""
+        masks = self.compute_sparse_masks(params)
+        return apply_masks(params, masks), masks
+
+    def wrap_optimizer(self, opt, masks) -> SparseOptimizer:
+        return SparseOptimizer(opt, masks)
+
+    def prune_trained_model(self, params, opt):
+        """One-call recipe (reference ``prune_trained_model:292``):
+        returns ``(pruned_params, masks, sparse_opt)`` — fine-tune with
+        ``sparse_opt`` to recover accuracy at 2:4 sparsity."""
+        pruned, masks = self.prune(params)
+        return pruned, masks, self.wrap_optimizer(opt, masks)
+
+    @staticmethod
+    def is_sparsity_enabled(masks) -> bool:
+        """True if every pruned leaf is at the n:m ratio, False if all are
+        dense; inconsistent mixes raise (reference
+        ``is_sparsity_enabled:271-289``)."""
+        ratios = []
+        for _, m in jax.tree_util.tree_leaves_with_path(masks):
+            m = jnp.asarray(m)
+            if m.ndim == 0:
+                continue
+            ratios.append(float(m.sum() / m.size))
+        if not ratios:
+            return False
+        if all(abs(r - 1.0) < 1e-6 for r in ratios):
+            return False
+        if all(abs(r - 0.5) < 1e-6 for r in ratios):
+            return True
+        raise AssertionError("Inconsistent model sparsity")

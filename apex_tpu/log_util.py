@@ -1,0 +1,37 @@
+"""Per-rank logging accessors — ``apex/transformer/log_util.py:5-18``
+parity (``get_transformer_logger``, ``set_logging_level``).
+
+The rank-stamped root handler itself lives in ``apex_tpu/__init__.py``
+(``RankInfoFormatter`` — the ``apex/__init__.py:31-43`` analog, with
+backend-init-safe rank lookup); this module only exposes the reference's
+accessor surface, so importing it never adds a second handler.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+__all__ = ["get_logger", "get_transformer_logger", "set_logging_level"]
+
+
+def get_logger(name: str = "apex_tpu") -> logging.Logger:
+    """The library logger (children inherit the rank-stamped handler)."""
+    import apex_tpu  # ensures the handler is installed
+
+    del apex_tpu
+    return logging.getLogger(name)
+
+
+def get_transformer_logger(name: str) -> logging.Logger:
+    """Reference ``get_transformer_logger`` — pass ``__name__`` (or a
+    filename; the extension is stripped)."""
+    base = os.path.splitext(name)[0]
+    if not base.startswith("apex_tpu"):
+        base = f"apex_tpu.{base}"
+    return get_logger(base)
+
+
+def set_logging_level(verbosity) -> None:
+    """Reference ``set_logging_level`` (``log_util.py:12-18``)."""
+    get_logger().setLevel(verbosity)

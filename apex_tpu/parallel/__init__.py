@@ -28,6 +28,8 @@ from apex_tpu.parallel.mesh import (  # noqa: F401
     CONTEXT_AXIS,
 )
 from apex_tpu.parallel import collectives  # noqa: F401
+from apex_tpu.parallel import launch  # noqa: F401
+from apex_tpu.parallel.launch import initialize_distributed  # noqa: F401
 from apex_tpu.parallel.distributed import (  # noqa: F401
     DistributedDataParallel,
     all_reduce_gradients,

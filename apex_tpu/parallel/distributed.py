@@ -83,15 +83,19 @@ def all_reduce_gradients(
 
 
 def dp_shard_batch(batch, mesh=None):
-    """Place a host batch sharded along the dp axis (leading dim)."""
+    """Place a host batch sharded along the data-parallel axes (leading
+    dim over ``(dcn, dp)`` — the outer/cross-slice axis is size 1 on a
+    single slice, so this is correct at any scale)."""
     if mesh is None:
         mesh = mesh_lib.get_mesh()
+    dp_axes = tuple(a for a in (mesh_lib.DCN_AXIS, mesh_lib.DATA_AXIS)
+                    if a in mesh.shape)
 
     def leaf(x):
         if jnp.ndim(x) == 0:  # scalars (e.g. a mixup lambda) replicate
             spec = P()
         else:
-            spec = P(mesh_lib.DATA_AXIS, *([None] * (jnp.ndim(x) - 1)))
+            spec = P(dp_axes, *([None] * (jnp.ndim(x) - 1)))
         return jax.device_put(x, NamedSharding(mesh, spec))
 
     return jax.tree_util.tree_map(leaf, batch)
@@ -123,12 +127,16 @@ class DistributedDataParallel:
     gradient_average: bool = True
     gradient_predivide_factor: float = 1.0
     allreduce_always_fp32: bool = False
-    axis: str = mesh_lib.DATA_AXIS
+    # Default covers the outer (cross-slice DCN) data axis too, matching
+    # dp_shard_batch — on a single slice dcn has size 1 and is a no-op.
+    axis: Any = (mesh_lib.DCN_AXIS, mesh_lib.DATA_AXIS)
 
     def build(self, mesh=None):
         if mesh is None:
             mesh = mesh_lib.get_mesh()
-        ndim_axis = self.axis
+        ndim_axis = tuple(a for a in (
+            self.axis if isinstance(self.axis, (tuple, list))
+            else (self.axis,)) if a in mesh.shape)
 
         def per_shard(params, batch):
             loss, grads = self.grad_fn(params, batch)

@@ -16,14 +16,24 @@ interleaved schedule needs (reference ``parallel_state.py:521-545``).
 
 Axis layout (innermost = fastest-varying device index = best ICI locality):
 
-    (dp, pp, cp, tp)
+    (dcn, dp, pp, cp, tp)
 
 ``tp`` is innermost so tensor-parallel collectives (the most
 bandwidth-hungry, fired inside every linear layer) ride adjacent-chip ICI
-links; ``dp`` is outermost so data-parallel gradient reduction can span the
-slower DCN axis on multi-slice systems.  This mirrors the reference's rank
-grid documentation (``parallel_state.py:186-200``) with the GPU "ranks
-8..15 = second DP replica" layout replaced by mesh-axis ordering.
+links; ``dp`` is outermost within a slice so data-parallel gradient
+reduction uses whole-slice ICI; ``dcn`` is the *outer* data-parallel axis
+spanning slices/hosts over the data-center network — the analog of the
+reference's hybrid IB-vs-socket NCCL group split
+(``parallel_state.py:83-153``, ``NUM_GPUS_PER_IB_BLOCK``).  ``dcn`` is
+always present (size 1 single-slice), so code that reduces gradients over
+``("dcn", "dp")`` is correct at any scale.  This mirrors the reference's
+rank grid documentation (``parallel_state.py:186-200``) with the GPU
+"ranks 8..15 = second DP replica" layout replaced by mesh-axis ordering.
+
+Multi-process bring-up lives in :mod:`apex_tpu.parallel.launch`
+(``jax.distributed.initialize`` — the ``apex.parallel.multiproc`` analog);
+once initialized, ``jax.devices()`` spans all processes and this builder
+lays the dcn axis across process boundaries.
 """
 
 from __future__ import annotations
@@ -37,6 +47,7 @@ import numpy as np
 from jax.sharding import Mesh
 
 __all__ = [
+    "DCN_AXIS",
     "DATA_AXIS",
     "TENSOR_AXIS",
     "PIPELINE_AXIS",
@@ -57,12 +68,13 @@ __all__ = [
 ]
 
 # Canonical axis names.  Everything in apex_tpu refers to mesh axes by these.
+DCN_AXIS = "dcn"
 DATA_AXIS = "dp"
 PIPELINE_AXIS = "pp"
 CONTEXT_AXIS = "cp"
 TENSOR_AXIS = "tp"
 
-_AXIS_ORDER = (DATA_AXIS, PIPELINE_AXIS, CONTEXT_AXIS, TENSOR_AXIS)
+_AXIS_ORDER = (DCN_AXIS, DATA_AXIS, PIPELINE_AXIS, CONTEXT_AXIS, TENSOR_AXIS)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -77,6 +89,7 @@ class MeshSpec:
     pipeline_model_parallel_size: int = 1
     context_parallel_size: int = 1
     data_parallel_size: Optional[int] = None  # None = fill remaining devices
+    dcn_data_parallel_size: int = 1           # outer (cross-slice) dp axis
     virtual_pipeline_model_parallel_size: Optional[int] = None
     pipeline_model_parallel_split_rank: Optional[int] = None
 
@@ -85,12 +98,14 @@ class MeshSpec:
             self.tensor_model_parallel_size
             * self.pipeline_model_parallel_size
             * self.context_parallel_size
+            * self.dcn_data_parallel_size
         )
         if n_devices % model != 0:
             raise ValueError(
                 f"world size {n_devices} not divisible by "
-                f"tp*pp*cp={model} "
-                f"(tp={self.tensor_model_parallel_size}, "
+                f"dcn*tp*pp*cp={model} "
+                f"(dcn={self.dcn_data_parallel_size}, "
+                f"tp={self.tensor_model_parallel_size}, "
                 f"pp={self.pipeline_model_parallel_size}, "
                 f"cp={self.context_parallel_size})"
             )
@@ -118,6 +133,7 @@ def initialize_model_parallel(
     virtual_pipeline_model_parallel_size: Optional[int] = None,
     pipeline_model_parallel_split_rank: Optional[int] = None,
     context_parallel_size: int = 1,
+    dcn_data_parallel_size: Optional[int] = None,
     *,
     devices: Optional[Sequence[jax.Device]] = None,
 ) -> Mesh:
@@ -130,19 +146,43 @@ def initialize_model_parallel(
 
     ``devices`` defaults to ``jax.devices()``; pass an explicit list to build
     a sub-mesh (e.g. for tests) or to control device order.
+
+    ``dcn_data_parallel_size``: outer data-parallel axis laid across
+    process/slice boundaries (defaults to ``jax.process_count()`` when the
+    job is multi-process and the axes divide, else 1).  ``jax.devices()``
+    orders devices process-major, so a plain reshape puts the dcn axis
+    exactly on the process boundary — cross-slice traffic is confined to
+    the outermost axis (gradient all-reduce), everything else rides ICI.
     """
     if devices is None:
         devices = jax.devices()
     devices = list(devices)
+    if dcn_data_parallel_size is None:
+        nproc = jax.process_count()
+        model = (tensor_model_parallel_size * pipeline_model_parallel_size
+                 * context_parallel_size)
+        per_proc = len(devices) // max(nproc, 1)
+        # Auto-lay dcn on the process boundary ONLY for the full
+        # process-major jax.devices() list — for an explicit sub-list the
+        # reshape could put a "slice" across two processes, silently
+        # defeating the DCN-locality guarantee the axis exists for.
+        is_full_list = devices == list(jax.devices())
+        dcn_data_parallel_size = (
+            nproc if nproc > 1 and is_full_list
+            and per_proc * nproc == len(devices)
+            and per_proc % model == 0 else 1
+        )
     spec = MeshSpec(
         tensor_model_parallel_size=tensor_model_parallel_size,
         pipeline_model_parallel_size=pipeline_model_parallel_size,
         context_parallel_size=context_parallel_size,
+        dcn_data_parallel_size=dcn_data_parallel_size,
         virtual_pipeline_model_parallel_size=virtual_pipeline_model_parallel_size,
         pipeline_model_parallel_split_rank=pipeline_model_parallel_split_rank,
     )
     dp = spec.resolve_dp(len(devices))
     shape = (
+        dcn_data_parallel_size,
         dp,
         pipeline_model_parallel_size,
         context_parallel_size,
@@ -187,8 +227,13 @@ def _axis_size(axis: str) -> int:
 
 
 def get_data_parallel_world_size() -> int:
-    """Analog of ``parallel_state.get_data_parallel_world_size`` (``:730``)."""
-    return _axis_size(DATA_AXIS)
+    """Analog of ``parallel_state.get_data_parallel_world_size`` (``:730``) —
+    the *total* replica count, inner (ICI) × outer (DCN) axes."""
+    return _axis_size(DATA_AXIS) * _axis_size(DCN_AXIS)
+
+
+def get_dcn_data_parallel_world_size() -> int:
+    return _axis_size(DCN_AXIS)
 
 
 def get_tensor_model_parallel_world_size() -> int:
@@ -247,7 +292,8 @@ def get_rank_info() -> str:
         return "mesh uninitialized"
     m = get_mesh()
     return (
-        f"mesh(dp={m.shape[DATA_AXIS]}, pp={m.shape[PIPELINE_AXIS]}, "
-        f"cp={m.shape[CONTEXT_AXIS]}, tp={m.shape[TENSOR_AXIS]}) "
+        f"mesh(dcn={m.shape[DCN_AXIS]}, dp={m.shape[DATA_AXIS]}, "
+        f"pp={m.shape[PIPELINE_AXIS]}, cp={m.shape[CONTEXT_AXIS]}, "
+        f"tp={m.shape[TENSOR_AXIS]}) "
         f"process {jax.process_index()}/{jax.process_count()}"
     )

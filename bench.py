@@ -83,14 +83,21 @@ def _timeit(jax, step, state, steps):
 # ResNet-50 benches
 # ---------------------------------------------------------------------------
 
-def _resnet_bench(jax, on_tpu, optimizer_name):
+def _resnet_bench(jax, on_tpu, optimizer_name, sync_bn=False):
     import jax.numpy as jnp
     import numpy as np
+    from jax.sharding import PartitionSpec as P
 
     from apex_tpu import amp
     from apex_tpu.models import ResNet50
     from apex_tpu.optimizers import FusedLAMB, FusedSGD
-    from apex_tpu.parallel import dp_shard_batch, mesh as mesh_lib, replicate
+    from apex_tpu.parallel import (
+        collectives as cc,
+        dp_shard_batch,
+        mesh as mesh_lib,
+        replicate,
+    )
+    from apex_tpu.parallel.distributed import all_reduce_gradients
 
     n_chips = len(jax.devices())
     batch_per_chip = 128 if on_tpu else 4
@@ -101,7 +108,9 @@ def _resnet_bench(jax, on_tpu, optimizer_name):
     mesh = mesh_lib.initialize_model_parallel()
     try:
         policy = amp.policy("O2")
-        model = ResNet50(num_classes=1000, axis_name=None,
+        dp_axes = ("dcn", "dp")
+        model = ResNet50(num_classes=1000,
+                         axis_name="dp" if sync_bn else None,
                          dtype=policy.compute_dtype)
 
         x0 = jnp.zeros((2, image_size, image_size, 3), jnp.float32)
@@ -128,12 +137,35 @@ def _resnet_bench(jax, on_tpu, optimizer_name):
             loss = -jnp.mean(logp[jnp.arange(y.shape[0]), y])
             return loss, mutated["batch_stats"]
 
-        @partial(jax.jit, donate_argnums=(0, 1, 2))
-        def train_step(params, batch_stats, opt_state, batch):
+        def local_step(params, batch_stats, opt_state, batch):
             (loss, new_stats), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(params, batch_stats, batch)
+            if sync_bn:
+                # shard_map path: explicit dp gradient reduction (the pjit
+                # path gets it implicitly from the global-mean loss).
+                grads = all_reduce_gradients(grads, dp_axes)
             params, opt_state = opt.step(grads, opt_state, params)
             return params, new_stats, opt_state, batch
+
+        if sync_bn:
+            rep = lambda tree: jax.tree_util.tree_map(lambda _: P(), tree)
+
+            def sharded_step(params, batch_stats, opt_state, batch):
+                bspec = jax.tree_util.tree_map(
+                    lambda x: P(dp_axes, *([None] * (jnp.ndim(x) - 1))),
+                    batch)
+                return cc.shard_over(
+                    local_step, mesh=mesh,
+                    in_specs=(rep(params), rep(batch_stats),
+                              rep(opt_state), bspec),
+                    out_specs=(rep(params), rep(batch_stats),
+                               rep(opt_state), bspec),
+                )(params, batch_stats, opt_state, batch)
+
+            train_step = jax.jit(sharded_step, donate_argnums=(0, 1, 2))
+        else:
+            train_step = partial(jax.jit, donate_argnums=(0, 1, 2))(
+                local_step)
 
         params = replicate(params, mesh)
         batch_stats = replicate(batch_stats, mesh)
@@ -171,10 +203,10 @@ def bench_resnet50_o2(jax, on_tpu):
 
 
 def bench_resnet50_lamb_syncbn(jax, on_tpu):
-    # Single-chip SyncBN degrades to plain BN (axis_name=None); the LAMB
-    # large-batch optimizer is the point of this config (BASELINE.json
-    # "RN50 FusedLAMB 32k+SyncBN").
-    return _resnet_bench(jax, on_tpu, "lamb")
+    # BASELINE.json "RN50 FusedLAMB 32k+SyncBN": SyncBatchNorm with the dp
+    # axis genuinely bound (shard_map), cross-replica Welford psum included
+    # in the measured step (a single chip binds a size-1 axis).
+    return _resnet_bench(jax, on_tpu, "lamb", sync_bn=True)
 
 
 # ---------------------------------------------------------------------------
@@ -458,10 +490,9 @@ def run_one(name: str) -> None:
     import jax
 
     if os.environ.get("JAX_PLATFORMS", "").lower() == "cpu":
-        try:
-            jax.config.update("jax_platforms", "cpu")
-        except Exception:
-            pass
+        from apex_tpu.utils.platform import pin_cpu
+
+        pin_cpu()
     _log(f"{name}: initializing backend")
     dev = jax.devices()[0]
     on_tpu = dev.platform == "tpu"

@@ -44,3 +44,11 @@ def devices8():
     if len(devs) < 8:
         pytest.skip("needs 8 virtual devices")
     return devs[:8]
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running distributed/model tests (deselect with "
+        "-m 'not slow' for the fast tier)",
+    )

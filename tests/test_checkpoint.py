@@ -1,0 +1,168 @@
+"""Checkpoint/resume: bit-exact round trips for full train state, incl.
+gathering/scattering ZeRO-sharded optimizer state (the reference's
+``DistributedFusedAdam.state_dict(gather_on_root)`` contract)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu import parallel
+from apex_tpu.checkpoint import (
+    gather_zero_state,
+    restore_checkpoint,
+    save_checkpoint,
+    scatter_zero_state,
+)
+from apex_tpu.contrib.optimizers import DistributedFusedAdam
+from apex_tpu.optimizers import FusedAdam
+from apex_tpu.parallel import collectives as cc
+
+pytestmark = pytest.mark.slow
+
+
+def test_roundtrip_bit_exact_resume(tmp_path):
+    """Save at step 3, train to 6; restore at 3, train to 6: identical."""
+    import flax.linen as nn
+
+    from apex_tpu import amp
+
+    class MLP(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            x = nn.Dense(16)(x)
+            x = nn.relu(x)
+            return nn.Dense(4)(x)
+
+    model = MLP()
+    x = jax.random.normal(jax.random.PRNGKey(0), (32, 8))
+    y = jax.random.randint(jax.random.PRNGKey(1), (32,), 0, 4)
+    params = model.init(jax.random.PRNGKey(2), x)["params"]
+    opt = FusedAdam(lr=1e-2)
+    scaler = amp.DynamicLossScale()
+
+    @jax.jit
+    def step(params, opt_state, sstate):
+        def loss_fn(p):
+            logits = model.apply({"params": p}, x)
+            losses = -jax.nn.log_softmax(logits)[jnp.arange(32), y]
+            return scaler.scale(jnp.mean(losses), sstate)
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        grads = scaler.unscale(grads, sstate)
+        finite = amp.all_finite(grads)
+        sstate = scaler.update(sstate, finite)
+        params, opt_state = opt.step(grads, opt_state, params,
+                                     skip_update=~finite)
+        return params, opt_state, sstate, loss
+
+    opt_state = opt.init(params)
+    sstate = scaler.init()
+    for _ in range(3):
+        params, opt_state, sstate, _ = step(params, opt_state, sstate)
+
+    ckpt = {"params": params, "opt": opt_state, "scaler": sstate}
+    save_checkpoint(str(tmp_path / "ck.npz"), ckpt, step=3)
+
+    cont = []
+    p2, o2, s2 = params, opt_state, sstate
+    for _ in range(3):
+        p2, o2, s2, loss = step(p2, o2, s2)
+        cont.append(np.asarray(loss))
+
+    restored, at = restore_checkpoint(str(tmp_path / "ck.npz"), ckpt)
+    assert at == 3
+    p3, o3, s3 = restored["params"], restored["opt"], restored["scaler"]
+    resumed = []
+    for _ in range(3):
+        p3, o3, s3, loss = step(p3, o3, s3)
+        resumed.append(np.asarray(loss))
+
+    np.testing.assert_array_equal(np.stack(cont), np.stack(resumed))
+    for a, b in zip(jax.tree_util.tree_leaves(p2),
+                    jax.tree_util.tree_leaves(p3)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_restore_mismatch_raises(tmp_path):
+    tree = {"a": jnp.ones((3,)), "b": jnp.zeros((2, 2))}
+    save_checkpoint(str(tmp_path / "c.npz"), tree)
+    with pytest.raises(ValueError, match="shape"):
+        restore_checkpoint(str(tmp_path / "c.npz"),
+                           {"a": jnp.ones((4,)), "b": jnp.zeros((2, 2))})
+    with pytest.raises(ValueError, match="leaves"):
+        restore_checkpoint(str(tmp_path / "c.npz"), {"a": jnp.ones((3,))})
+
+
+@pytest.mark.parametrize("remainders", [False, True])
+def test_zero_state_gather_scatter(remainders):
+    """Portable ZeRO state: gather -> full fp32 per-param state; scatter
+    back -> bitwise-identical sharded state; resumed sharded training
+    matches uninterrupted training exactly."""
+    mesh = parallel.initialize_model_parallel()  # dp=8
+    try:
+        dtype = jnp.bfloat16 if remainders else jnp.float32
+        params = {
+            "w": jax.random.normal(jax.random.PRNGKey(0), (13, 7), dtype),
+            "b": jax.random.normal(jax.random.PRNGKey(1), (8,), dtype),
+        }
+        grads = {
+            "w": jax.random.normal(jax.random.PRNGKey(2), (13, 7)),
+            "b": jax.random.normal(jax.random.PRNGKey(3), (8,)),
+        }
+        opt = DistributedFusedAdam(lr=1e-2,
+                                   store_param_remainders=remainders)
+
+        def train(params, grads, steps):
+            def local(p, g):
+                state = opt.init(p)
+                for _ in range(steps):
+                    p, state = opt.step(g, state, p)
+                return p, state
+            return local
+
+        from apex_tpu.optimizers._common import OptState
+
+        chunk_spec = jax.tree_util.tree_map(lambda _: P("dp"), params)
+        state_specs = OptState(
+            step=P(),
+            slots={"exp_avg": chunk_spec, "exp_avg_sq": chunk_spec},
+            master=chunk_spec,
+        )
+
+        p1, s1 = cc.shard_over(
+            train(params, grads, 2), in_specs=(P(), P()),
+            out_specs=(P(), state_specs))(params, grads)
+
+        portable = gather_zero_state(opt, s1, p1)
+        for name, tree in portable["slots"].items():
+            for leaf, p in zip(jax.tree_util.tree_leaves(tree),
+                               jax.tree_util.tree_leaves(p1)):
+                assert leaf.shape == p.shape
+        if remainders:
+            assert portable["master"]["w"].dtype == jnp.float32
+
+        resharded = scatter_zero_state(opt, portable, s1, p1)
+        for a, b in zip(jax.tree_util.tree_leaves(s1),
+                        jax.tree_util.tree_leaves(resharded)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+        # resume from the re-scattered state == uninterrupted run
+        def resume(p, g, state):
+            def local(p, g, state):
+                for _ in range(2):
+                    p, state = opt.step(g, state, p)
+                return p
+            return cc.shard_over(
+                local, in_specs=(P(), P(), state_specs), out_specs=P()
+            )(p, g, state)
+
+        p_resumed = resume(p1, grads, resharded)
+        p_straight, _ = cc.shard_over(
+            train(params, grads, 4), in_specs=(P(), P()),
+            out_specs=(P(), state_specs))(params, grads)
+        for a, b in zip(jax.tree_util.tree_leaves(p_resumed),
+                        jax.tree_util.tree_leaves(p_straight)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    finally:
+        parallel.destroy_model_parallel()

@@ -1,0 +1,229 @@
+"""Contrib kernel tier numerics: GroupNorm NHWC(+SiLU), focal loss,
+index_mul_2d, transducer joint+loss — each vs a pure-jnp/numpy reference
+(the reference tests them against python impls the same way,
+``apex/contrib/test/*``)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.contrib.focal_loss import focal_loss
+from apex_tpu.contrib.group_norm import GroupNorm, group_norm_nhwc
+from apex_tpu.contrib.index_mul_2d import index_mul_2d
+from apex_tpu.contrib.transducer import (
+    TransducerJoint,
+    transducer_joint,
+    transducer_loss,
+)
+
+
+# ---------------------------------------------------------------- group norm
+
+@pytest.mark.parametrize("act", ["", "silu"])
+def test_group_norm_matches_reference(act):
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 4, 4, 16).astype(np.float32)
+    w = rng.randn(16).astype(np.float32)
+    b = rng.randn(16).astype(np.float32)
+    out = group_norm_nhwc(jnp.asarray(x), 4, w, b, eps=1e-5, act=act)
+
+    # reference: torch.nn.GroupNorm semantics in numpy (NCHW order)
+    xr = x.transpose(0, 3, 1, 2).reshape(2, 4, 4 * 4 * 4)
+    mean = xr.mean(axis=2, keepdims=True)
+    var = xr.var(axis=2, keepdims=True)
+    ref = ((xr - mean) / np.sqrt(var + 1e-5)).reshape(2, 16, 4, 4)
+    ref = ref * w[None, :, None, None] + b[None, :, None, None]
+    if act == "silu":
+        ref = ref * (1 / (1 + np.exp(-ref)))
+    ref = ref.transpose(0, 2, 3, 1)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-5, atol=2e-5)
+
+
+def test_group_norm_module_and_bf16():
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 8, 8, 32), jnp.bfloat16)
+    gn = GroupNorm(num_groups=8, num_channels=32, act="silu")
+    params = gn.init(jax.random.PRNGKey(1), x)
+    out = gn.apply(params, x)
+    assert out.dtype == jnp.bfloat16 and out.shape == x.shape
+    # stats in fp32: per-group mean ~0 before affine regardless of bf16 input
+    plain = group_norm_nhwc(x, 8)
+    g = np.asarray(plain, np.float32).reshape(2, 64, 8, 4)
+    assert abs(g.mean()) < 1e-2
+
+
+# ---------------------------------------------------------------- focal loss
+
+def _focal_ref(x, y, npos, K_real, alpha, gamma, s):
+    """Direct per-element reference following the CUDA kernel conventions."""
+    total = 0.0
+    N, K = x.shape
+    for i in range(N):
+        if y[i] == -2:
+            continue
+        for c in range(min(K, K_real)):
+            p = float(x[i, c])
+            sig = 1 / (1 + np.exp(-p))
+            pos = y[i] >= 0 and c == y[i]
+            q = (1 - s + s / K_real) if pos else s / K_real
+            bce = np.log1p(np.exp(-abs(p))) + max(p, 0) - q * p
+            coeff = alpha * (1 - sig) ** gamma if pos \
+                else (1 - alpha) * sig ** gamma
+            total += coeff * bce
+    return total / npos
+
+
+@pytest.mark.parametrize("smoothing", [0.0, 0.1])
+def test_focal_loss_matches_reference(smoothing):
+    rng = np.random.RandomState(1)
+    N, K = 32, 8
+    x = rng.randn(N, K).astype(np.float32) * 2
+    y = rng.randint(-2, K - 1, size=(N,))  # mix of ignore/negative/positive
+    npos = max((y >= 0).sum(), 1)
+    got = focal_loss(jnp.asarray(x), jnp.asarray(y), float(npos),
+                     num_real_classes=K - 1, alpha=0.25, gamma=2.0,
+                     label_smoothing=smoothing)
+    ref = _focal_ref(x, y, float(npos), K - 1, 0.25, 2.0, smoothing)
+    np.testing.assert_allclose(float(got), ref, rtol=1e-5)
+
+    # ignored anchors contribute zero gradient
+    g = jax.grad(lambda x: focal_loss(x, jnp.asarray(y), float(npos),
+                                      K - 1, 0.25, 2.0, smoothing))(
+        jnp.asarray(x))
+    g = np.asarray(g)
+    assert np.all(g[y == -2] == 0)
+    assert np.all(g[:, K - 1:] == 0)  # pad class
+    assert np.any(g[y != -2][:, :K - 1] != 0)
+
+
+# ------------------------------------------------------------- index_mul_2d
+
+def test_index_mul_2d_forward_and_grads():
+    rng = np.random.RandomState(2)
+    in1 = jnp.asarray(rng.randn(10, 8).astype(np.float32))
+    in2 = jnp.asarray(rng.randn(6, 8).astype(np.float32))
+    idx = jnp.asarray(rng.randint(0, 10, size=(6,)))
+
+    out = index_mul_2d(in1, in2, idx)
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(in1)[np.asarray(idx)] * np.asarray(in2))
+
+    w = jnp.asarray(rng.randn(6, 8).astype(np.float32))
+    g1, g2 = jax.grad(lambda a, b: jnp.sum(index_mul_2d(a, b, idx) * w),
+                      argnums=(0, 1))(in1, in2)
+    # scatter-add reference for grad_in1
+    ref1 = np.zeros((10, 8), np.float32)
+    np.add.at(ref1, np.asarray(idx), np.asarray(w) * np.asarray(in2))
+    np.testing.assert_allclose(np.asarray(g1), ref1, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(g2),
+                               np.asarray(in1)[np.asarray(idx)] * np.asarray(w),
+                               rtol=1e-6)
+
+    with pytest.raises(ValueError):
+        index_mul_2d(in1[0], in2, idx)
+
+
+# ----------------------------------------------------------------- transducer
+
+def test_transducer_joint():
+    rng = np.random.RandomState(3)
+    f = jnp.asarray(rng.randn(2, 5, 8).astype(np.float32))
+    g = jnp.asarray(rng.randn(2, 4, 8).astype(np.float32))
+    out = transducer_joint(f, g)
+    ref = np.asarray(f)[:, :, None, :] + np.asarray(g)[:, None, :, :]
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-6)
+
+    f_len = jnp.asarray([5, 3])
+    g_len = jnp.asarray([3, 2])
+    joint = TransducerJoint(relu=True)
+    out = joint(f, g, f_len, g_len)
+    assert np.all(np.asarray(out) >= 0)
+    assert np.all(np.asarray(out)[1, 3:] == 0)      # t >= f_len zeroed
+    assert np.all(np.asarray(out)[1, :, 3:] == 0)   # u >= g_len+1 zeroed
+
+    with pytest.raises(NotImplementedError):
+        TransducerJoint(pack_output=True)
+
+
+def _naive_rnnt_loss(logp, label, T, U):
+    """Plain-python alpha recursion on log-probs [T, U+1, K]."""
+    import math
+    alpha = np.full((T, U + 1), -np.inf)
+    alpha[0, 0] = 0.0
+    blank = logp[..., -1]  # tests put blank at the last index
+    for t in range(T):
+        for u in range(U + 1):
+            cands = []
+            if t > 0:
+                cands.append(alpha[t - 1, u] + blank[t - 1, u])
+            if u > 0:
+                cands.append(alpha[t, u - 1] + logp[t, u - 1, label[u - 1]])
+            if cands:
+                m = max(cands)
+                alpha[t, u] = m + math.log(sum(math.exp(c - m)
+                                               for c in cands))
+    return -(alpha[T - 1, U] + blank[T - 1, U])
+
+
+def test_transducer_loss_matches_naive_dp():
+    rng = np.random.RandomState(4)
+    B, T, U, K = 3, 6, 4, 5
+    x = rng.randn(B, T, U + 1, K).astype(np.float32)
+    label = rng.randint(0, K - 1, size=(B, U))
+    f_len = np.array([6, 4, 5])
+    y_len = np.array([4, 2, 3])
+    blank = K - 1
+
+    got = transducer_loss(jnp.asarray(x), jnp.asarray(label),
+                          jnp.asarray(f_len), jnp.asarray(y_len), blank)
+    logp = np.asarray(jax.nn.log_softmax(jnp.asarray(x), axis=-1))
+    for b in range(B):
+        ref = _naive_rnnt_loss(logp[b, :f_len[b], :y_len[b] + 1],
+                               label[b], f_len[b], y_len[b])
+        np.testing.assert_allclose(float(got[b]), ref, rtol=1e-4)
+
+
+def test_transducer_loss_gradients_match_naive():
+    """Autodiff through the wavefront scan == autodiff through an unrolled
+    python DP (same math, independent structure)."""
+    rng = np.random.RandomState(5)
+    B, T, U, K = 2, 4, 3, 4
+    x = jnp.asarray(rng.randn(B, T, U + 1, K).astype(np.float32))
+    label = jnp.asarray(rng.randint(0, K - 1, size=(B, U)))
+    f_len = jnp.asarray([4, 3])
+    y_len = jnp.asarray([3, 2])
+    blank = K - 1
+
+    def unrolled(x):
+        logp = jax.nn.log_softmax(x, axis=-1)
+        total = 0.0
+        for b in range(B):
+            Tb, Ub = int(f_len[b]), int(y_len[b])
+            alpha = {}
+            alpha[(0, 0)] = 0.0
+            for t in range(Tb):
+                for u in range(Ub + 1):
+                    if t == 0 and u == 0:
+                        continue
+                    cands = []
+                    if t > 0:
+                        cands.append(alpha[(t - 1, u)]
+                                     + logp[b, t - 1, u, blank])
+                    if u > 0:
+                        cands.append(alpha[(t, u - 1)]
+                                     + logp[b, t, u - 1, label[b, u - 1]])
+                    alpha[(t, u)] = (cands[0] if len(cands) == 1
+                                     else jnp.logaddexp(*cands))
+            total = total - (alpha[(Tb - 1, Ub)]
+                             + logp[b, Tb - 1, Ub, blank])
+        return total
+
+    def scanned(x):
+        return jnp.sum(transducer_loss(x, label, f_len, y_len, blank))
+
+    np.testing.assert_allclose(float(scanned(x)), float(unrolled(x)),
+                               rtol=1e-5)
+    g_scan = jax.grad(scanned)(x)
+    g_ref = jax.grad(unrolled)(x)
+    np.testing.assert_allclose(np.asarray(g_scan), np.asarray(g_ref),
+                               rtol=1e-4, atol=1e-5)

@@ -23,6 +23,8 @@ from apex_tpu.parallel import (
 )
 from apex_tpu.parallel import collectives as cc
 
+pytestmark = pytest.mark.slow
+
 
 class TestDDP:
     def test_explicit_ddp_matches_single_device(self):

@@ -23,6 +23,8 @@ from apex_tpu.contrib.optimizers.distributed_fused_adam import (
 from apex_tpu.optimizers import FusedAdam, FusedLAMB
 from apex_tpu.parallel import collectives as cc
 
+pytestmark = pytest.mark.slow
+
 DP = 8
 
 

@@ -11,6 +11,8 @@ from apex_tpu.models import ResNet18
 from apex_tpu.optimizers import FusedSGD
 from apex_tpu.parallel import dp_shard_batch, replicate
 
+pytestmark = pytest.mark.slow
+
 
 class TestSimpleDistributed:
     def test_example_trains(self):

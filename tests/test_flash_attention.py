@@ -20,6 +20,8 @@ from apex_tpu.transformer.context_parallel import (
     ulysses_attention,
 )
 
+pytestmark = pytest.mark.slow
+
 
 def naive_attention(q, k, v, causal, scale=None):
     d = q.shape[-1]

@@ -8,11 +8,14 @@ parallel grids against the serial model).
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from apex_tpu import parallel
 from apex_tpu.optimizers import FusedAdam
 from apex_tpu.transformer.testing import TransformerConfig
 from apex_tpu.transformer.testing.gpt_parallel_train import build_gpt_3d
+
+pytestmark = pytest.mark.slow
 
 VOCAB, SEQ = 64, 16
 DPW, PP, TP, VPP = 2, 2, 2, 2

@@ -65,7 +65,7 @@ def test_mesh_axis_order_tp_innermost():
     """tp must be the innermost (fastest-varying) axis for ICI locality."""
     parallel.initialize_model_parallel(tensor_model_parallel_size=2)
     m = parallel.get_mesh()
-    assert m.axis_names == ("dp", "pp", "cp", "tp")
+    assert m.axis_names == ("dcn", "dp", "pp", "cp", "tp")
     devs = m.devices
     # Along tp, device ids should be adjacent.
     flat = devs.reshape(-1, devs.shape[-1])

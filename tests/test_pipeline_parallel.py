@@ -19,6 +19,8 @@ from apex_tpu.transformer.microbatches import (
     build_num_microbatches_calculator,
 )
 
+pytestmark = pytest.mark.slow
+
 HID = 8
 MB = 2  # microbatch size
 

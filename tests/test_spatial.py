@@ -1,0 +1,99 @@
+"""Spatial parallelism: halo exchange + H-split bottleneck vs the
+unsharded computation (reference tests the halo exchanger and
+SpatialBottleneck against single-GPU runs the same way,
+``apex/contrib/test/bottleneck``)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu import parallel
+from apex_tpu.contrib.spatial import (
+    SpatialBottleneck,
+    halo_exchange_1d,
+    spatial_conv_nhwc,
+)
+from apex_tpu.parallel import collectives as cc
+
+pytestmark = pytest.mark.slow
+
+SP = 8
+
+
+@pytest.fixture()
+def mesh():
+    m = parallel.initialize_model_parallel(context_parallel_size=SP)
+    yield m
+    parallel.destroy_model_parallel()
+
+
+def test_halo_exchange_matches_manual(mesh):
+    H = 32
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, H, 4, 3))
+
+    def local(x):
+        return halo_exchange_1d(x, "cp", 2, dim=1)
+
+    out = cc.shard_over(local, in_specs=P(None, "cp"),
+                        out_specs=P(None, "cp"))(x)
+    out = np.asarray(out)  # [2, 8*(4+4), 4, 3] concat of padded shards
+    xs = np.asarray(x)
+    hs = H // SP
+    padded = out.reshape(2, SP, hs + 4, 4, 3)
+    for r in range(SP):
+        lo = xs[:, r * hs - 2:r * hs] if r > 0 else np.zeros((2, 2, 4, 3))
+        hi = (xs[:, (r + 1) * hs:(r + 1) * hs + 2]
+              if r < SP - 1 else np.zeros((2, 2, 4, 3)))
+        np.testing.assert_allclose(padded[:, r, :2], lo)
+        np.testing.assert_allclose(padded[:, r, 2:-2],
+                                   xs[:, r * hs:(r + 1) * hs])
+        np.testing.assert_allclose(padded[:, r, -2:], hi)
+
+
+def test_spatial_conv_matches_unsharded(mesh):
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 32, 8, 4))
+    k = jax.random.normal(jax.random.PRNGKey(2), (3, 3, 4, 6)) * 0.1
+
+    ref = jax.lax.conv_general_dilated(
+        x, k, (1, 1), "SAME", dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+    out = cc.shard_over(
+        lambda x: spatial_conv_nhwc(x, k, "cp"),
+        in_specs=P(None, "cp"), out_specs=P(None, "cp"))(x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_spatial_bottleneck_matches_serial(mesh):
+    x = jax.random.normal(jax.random.PRNGKey(3), (2, 32, 8, 16))
+
+    serial = SpatialBottleneck(in_channels=16, bottleneck_channels=8,
+                               out_channels=32, axis=None)
+    params = serial.init(jax.random.PRNGKey(4), x)["params"]
+    # graft the serial conv2 into the spatial variant's param layout
+    sp_params = dict(params)
+    sp_params["conv2_kernel"] = params["conv2"]["kernel"]
+    del sp_params["conv2"]
+    ref = serial.apply({"params": params}, x)
+
+    spatial = SpatialBottleneck(in_channels=16, bottleneck_channels=8,
+                                out_channels=32, axis="cp")
+    out = cc.shard_over(
+        lambda p, x: spatial.apply({"params": p}, x),
+        in_specs=(P(), P(None, "cp")), out_specs=P(None, "cp"),
+    )(sp_params, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+    # gradients flow through the halo exchange (ppermute transpose)
+    def loss(p, x):
+        out = cc.shard_over(
+            lambda p, x: spatial.apply({"params": p}, x),
+            in_specs=(P(), P(None, "cp")), out_specs=P(None, "cp"))(p, x)
+        return jnp.sum(out ** 2)
+
+    g = jax.grad(loss)(sp_params, x)
+    assert np.all(np.isfinite(np.asarray(g["conv2_kernel"])))
+    assert float(jnp.sum(jnp.abs(g["conv2_kernel"]))) > 0

@@ -17,6 +17,8 @@ from apex_tpu import parallel
 from apex_tpu.parallel import collectives as cc
 from apex_tpu.transformer import tensor_parallel as tp
 
+pytestmark = pytest.mark.slow
+
 TP = 8
 
 

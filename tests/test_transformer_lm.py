@@ -24,6 +24,8 @@ from apex_tpu.transformer.testing import (
     init_gpt_layer_stack,
 )
 
+pytestmark = pytest.mark.slow
+
 VOCAB = 64
 SEQ = 16
 BATCH = 4
