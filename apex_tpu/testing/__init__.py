@@ -1,0 +1,3 @@
+"""Test/validation harnesses (L1 stored-baseline traces)."""
+
+from apex_tpu.testing import l1  # noqa: F401

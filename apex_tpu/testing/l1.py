@@ -1,0 +1,163 @@
+"""L1-style stored-baseline training traces.
+
+Behavioral spec: ``tests/L1/common/run_test.sh`` + ``compare.py`` in the
+reference — instrumented training runs record per-iteration loss and
+gradient norms, and CI diffs them against checked-in baselines, which
+catches silent numerics regressions that "loss decreases" tests cannot.
+
+Two deterministic smoke configs mirror the reference's L1 workloads:
+``rn50_smoke`` (ResNet-50-style conv net, O2 policy, FusedSGD — the
+imagenet config shrunk to smoke size) and ``gpt_smoke`` (standalone GPT,
+FusedAdam).  Synthetic data, fixed seeds, fp32 accumulation — traces are
+reproducible to fp tolerance across XLA releases on the same platform.
+
+Regenerate baselines after an *intended* numerics change::
+
+    python -m apex_tpu.testing.l1 record tests/L1/baselines
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from typing import Dict, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["run_trace", "compare_traces", "CONFIGS"]
+
+ITERS = 10
+
+
+def _global_grad_norm(grads) -> float:
+    total = sum(jnp.sum(jnp.square(jnp.asarray(g, jnp.float32)))
+                for g in jax.tree_util.tree_leaves(grads))
+    return float(jnp.sqrt(total))
+
+
+def _trace_rn50() -> Dict[str, List[float]]:
+    from apex_tpu import amp
+    from apex_tpu.models import ResNet50
+    from apex_tpu.optimizers import FusedSGD
+
+    policy = amp.policy("O2")
+    model = ResNet50(num_classes=10, axis_name=None,
+                     dtype=policy.compute_dtype)
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(8, 32, 32, 3), jnp.float32)
+    y = jnp.asarray(rng.randint(0, 10, size=(8,)))
+    variables = model.init(jax.random.PRNGKey(0), x[:2], train=True)
+    params = policy.cast_to_param(variables["params"])
+    stats = variables["batch_stats"]
+    opt = FusedSGD(lr=0.005, momentum=0.9, weight_decay=1e-4,
+                   master_weights=policy.master_weights)
+    state = opt.init(params)
+
+    def loss_fn(p, stats):
+        logits, mut = model.apply(
+            {"params": p, "batch_stats": stats},
+            policy.cast_to_compute(x), train=True,
+            mutable=["batch_stats"])
+        logp = jax.nn.log_softmax(logits)
+        return -jnp.mean(logp[jnp.arange(8), y]), mut["batch_stats"]
+
+    @jax.jit
+    def step(p, stats, state):
+        (loss, stats), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(p, stats)
+        p, state = opt.step(grads, state, p)
+        return p, stats, state, loss, grads
+
+    losses, gnorms = [], []
+    for _ in range(ITERS):
+        params, stats, state, loss, grads = step(params, stats, state)
+        losses.append(float(loss))
+        gnorms.append(_global_grad_norm(grads))
+    return {"loss": losses, "grad_norm": gnorms}
+
+
+def _trace_gpt() -> Dict[str, List[float]]:
+    from apex_tpu.optimizers import FusedAdam
+    from apex_tpu.transformer.testing import GPTModel, TransformerConfig
+
+    cfg = TransformerConfig(
+        hidden_size=64, num_layers=2, num_attention_heads=4,
+        padded_vocab_size=128, max_position_embeddings=32,
+        hidden_dropout=0.0, attention_dropout=0.0, tensor_axis=None)
+    model = GPTModel(cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 32), 0, 128)
+    params = model.init(jax.random.PRNGKey(2), tokens)["params"]
+    opt = FusedAdam(lr=1e-3)
+    state = opt.init(params)
+
+    @jax.jit
+    def step(p, state):
+        def loss_fn(p):
+            return jnp.mean(model.apply({"params": p}, tokens,
+                                        labels=tokens))
+        loss, grads = jax.value_and_grad(loss_fn)(p)
+        p, state = opt.step(grads, state, p)
+        return p, state, loss, grads
+
+    losses, gnorms = [], []
+    for _ in range(ITERS):
+        params, state, loss, grads = step(params, state)
+        losses.append(float(loss))
+        gnorms.append(_global_grad_norm(grads))
+    return {"loss": losses, "grad_norm": gnorms}
+
+
+CONFIGS = {"rn50_smoke": _trace_rn50, "gpt_smoke": _trace_gpt}
+
+
+def run_trace(name: str) -> Dict[str, List[float]]:
+    return CONFIGS[name]()
+
+
+def compare_traces(got: Dict[str, List[float]],
+                   baseline: Dict[str, List[float]],
+                   loss_rtol: float = 1e-4,
+                   grad_rtol: float = 1e-3) -> List[str]:
+    """Per-iteration diff (reference ``tests/L1/common/compare.py``);
+    returns a list of mismatch descriptions (empty = pass)."""
+    problems = []
+    for key, rtol in (("loss", loss_rtol), ("grad_norm", grad_rtol)):
+        a, b = got.get(key, []), baseline.get(key, [])
+        if len(a) != len(b):
+            problems.append(f"{key}: {len(a)} iters vs baseline {len(b)}")
+            continue
+        for i, (x, y) in enumerate(zip(a, b)):
+            if not np.isclose(x, y, rtol=rtol, atol=1e-7):
+                problems.append(
+                    f"{key}[{i}]: {x!r} vs baseline {y!r} (rtol {rtol})")
+    return problems
+
+
+def _main(argv):
+    # Recording ALWAYS pins the test environment (CPU + 8 virtual
+    # devices, matching tests/conftest.py): the virtual-device count
+    # partitions the CPU thread pool, which changes fp reduction order,
+    # so traces recorded under any other flags fail the comparison.
+    from apex_tpu.utils.platform import force_host_device_count, pin_cpu
+
+    force_host_device_count(8)
+    pin_cpu()
+    if len(argv) >= 1 and argv[0] == "record":
+        outdir = argv[1] if len(argv) > 1 else "tests/L1/baselines"
+        os.makedirs(outdir, exist_ok=True)
+        for name in CONFIGS:
+            trace = run_trace(name)
+            path = os.path.join(outdir, f"{name}.json")
+            with open(path, "w") as f:
+                json.dump(trace, f, indent=1)
+            print(f"recorded {path}: loss {trace['loss'][0]:.4f} -> "
+                  f"{trace['loss'][-1]:.4f}")
+    else:
+        print(__doc__)
+
+
+if __name__ == "__main__":
+    _main(sys.argv[1:])

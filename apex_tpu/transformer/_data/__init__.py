@@ -1,0 +1,6 @@
+"""Data-pipeline utilities (reference ``apex/transformer/_data``)."""
+
+from apex_tpu.transformer._data._batchsampler import (  # noqa: F401
+    MegatronPretrainingRandomSampler,
+    MegatronPretrainingSampler,
+)

@@ -1,0 +1,87 @@
+"""Megatron pretraining samplers (reference
+``tests/L0/run_transformer/test_batch_sampler.py`` style)."""
+
+import numpy as np
+import pytest
+
+from apex_tpu.transformer._data import (
+    MegatronPretrainingRandomSampler,
+    MegatronPretrainingSampler,
+)
+
+
+def test_contiguous_sampler_disjoint_cover():
+    dp, lmb, total = 4, 2, 32
+    per_rank = [list(MegatronPretrainingSampler(
+        total_samples=total, consumed_samples=0, local_minibatch_size=lmb,
+        data_parallel_rank=r, data_parallel_size=dp)) for r in range(dp)]
+    # every rank yields the same number of equal-size minibatches
+    assert all(len(b) == total // (dp * lmb) for b in per_rank)
+    for step in range(total // (dp * lmb)):
+        got = sorted(i for r in range(dp) for i in per_rank[r][step])
+        lo = step * dp * lmb
+        assert got == list(range(lo, lo + dp * lmb))
+
+
+def test_contiguous_sampler_resume_and_drop_last():
+    s = MegatronPretrainingSampler(
+        total_samples=10, consumed_samples=4, local_minibatch_size=2,
+        data_parallel_rank=0, data_parallel_size=2, drop_last=False)
+    batches = list(s)
+    assert batches[0] == [4, 5]   # resumes at consumed_samples
+    # tail: samples 8,9 form a partial global batch; rank 0 gets [8, 9]
+    assert batches[-1] == [8, 9]
+    # with drop_last (default) the partial tail disappears
+    s2 = MegatronPretrainingSampler(
+        total_samples=10, consumed_samples=4, local_minibatch_size=2,
+        data_parallel_rank=0, data_parallel_size=2)
+    assert list(s2) == [[4, 5]]
+
+
+def test_random_sampler_determinism_and_shards():
+    dp, lmb, total = 2, 4, 64
+    runs = []
+    for r in range(dp):
+        s = MegatronPretrainingRandomSampler(
+            total_samples=total, consumed_samples=0,
+            local_minibatch_size=lmb, data_parallel_rank=r,
+            data_parallel_size=dp)
+        runs.append(list(s))
+    # same epoch seed -> rerun identical
+    s0b = list(MegatronPretrainingRandomSampler(
+        total_samples=total, consumed_samples=0, local_minibatch_size=lmb,
+        data_parallel_rank=0, data_parallel_size=dp))
+    assert runs[0] == s0b
+    # ranks draw from disjoint contiguous buckets
+    flat = [set(i for b in run for i in b) for run in runs]
+    assert flat[0].isdisjoint(flat[1])
+    assert all(i < 32 for i in flat[0]) and all(i >= 32 for i in flat[1])
+
+
+def test_random_sampler_epoch_reshuffle_and_resume():
+    total, lmb = 64, 4
+    a = MegatronPretrainingRandomSampler(
+        total_samples=total, consumed_samples=0, local_minibatch_size=lmb,
+        data_parallel_rank=0, data_parallel_size=2)
+    epoch0 = list(a)
+    # consumed a full epoch -> next iteration reshuffles with new seed
+    b = MegatronPretrainingRandomSampler(
+        total_samples=total, consumed_samples=total,
+        local_minibatch_size=lmb, data_parallel_rank=0,
+        data_parallel_size=2)
+    epoch1 = list(b)
+    assert epoch0 != epoch1
+    # mid-epoch resume: consumed 16 (= 8 per rank) skips first 2 batches
+    c = MegatronPretrainingRandomSampler(
+        total_samples=total, consumed_samples=16, local_minibatch_size=lmb,
+        data_parallel_rank=0, data_parallel_size=2)
+    assert list(c) == epoch0[2:]
+
+
+def test_sampler_validation():
+    with pytest.raises(ValueError):
+        MegatronPretrainingSampler(0, 0, 2, 0, 2)
+    with pytest.raises(ValueError):
+        MegatronPretrainingSampler(8, 8, 2, 0, 2)
+    with pytest.raises(ValueError):
+        MegatronPretrainingSampler(8, 0, 2, 2, 2)
