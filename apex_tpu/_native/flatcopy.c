@@ -1,0 +1,38 @@
+/* flatcopy — host-side flatten/unflatten of tensor lists.
+ *
+ * Behavioral spec: the reference's apex_C extension
+ * (csrc/flatten_unflatten.cpp:15-17 — flatten/unflatten over torch's
+ * _flatten_dense_tensors), the one native module apex always builds.
+ * On TPU the *device*-side use dissolves (XLA owns layout), but the
+ * host-side use survives: assembling/splitting checkpoint and
+ * host-transfer buffers without Python-loop copy overhead.
+ *
+ * Plain C + OpenMP, driven through ctypes (no pybind11 in this image).
+ * Serial prefix pass for offsets, parallel memcpy over tensors.
+ */
+#include <stdint.h>
+#include <stdlib.h>
+#include <string.h>
+
+void flat_gather(char *dst, void **srcs, const int64_t *sizes, int64_t n) {
+    int64_t *offs = (int64_t *)malloc((size_t)n * sizeof(int64_t));
+    int64_t acc = 0;
+    for (int64_t i = 0; i < n; i++) { offs[i] = acc; acc += sizes[i]; }
+    int64_t i;
+#pragma omp parallel for schedule(static)
+    for (i = 0; i < n; i++)
+        memcpy(dst + offs[i], (const char *)srcs[i], (size_t)sizes[i]);
+    free(offs);
+}
+
+void flat_scatter(const char *src, void **dsts, const int64_t *sizes,
+                  int64_t n) {
+    int64_t *offs = (int64_t *)malloc((size_t)n * sizeof(int64_t));
+    int64_t acc = 0;
+    for (int64_t i = 0; i < n; i++) { offs[i] = acc; acc += sizes[i]; }
+    int64_t i;
+#pragma omp parallel for schedule(static)
+    for (i = 0; i < n; i++)
+        memcpy((char *)dsts[i], src + offs[i], (size_t)sizes[i]);
+    free(offs);
+}

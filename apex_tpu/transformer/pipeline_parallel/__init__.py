@@ -16,6 +16,7 @@ from apex_tpu.transformer.pipeline_parallel.schedules import (
     split_into_microbatches,
     stack_stage_params,
 )
+from apex_tpu.transformer.pipeline_parallel import p2p_communication  # noqa: F401
 from apex_tpu.transformer.pipeline_parallel import utils
 
 __all__ = [

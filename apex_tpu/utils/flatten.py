@@ -1,0 +1,130 @@
+"""Flatten/unflatten dense tensor lists — the ``apex_C`` analog.
+
+Behavioral spec: ``csrc/flatten_unflatten.cpp:15-17`` (pybind'd
+``flatten``/``unflatten`` over torch ``_flatten_dense_tensors``) — the one
+native extension every apex install builds (``setup.py:118``).
+
+TPU-first split of responsibilities: on-device flattening is XLA's job
+(donated buffers, fused reshapes — ``utils/tree.py``), so the native path
+here serves the *host* side: assembling/splitting contiguous checkpoint
+and host-transfer buffers.  The C kernel (``_native/flatcopy.c``,
+OpenMP-parallel memcpy) is compiled on first use with the system
+toolchain and loaded via ctypes; a pure-numpy path keeps the API working
+when no compiler is available.
+
+Measured honesty note: unlike the CUDA side the reference accelerates,
+host numpy slicing is already memcpy-speed, so the native kernel only
+*ties* numpy on large buffers and loses on many tiny tensors (ctypes
+pointer-array setup dominates).  Routing therefore picks numpy for
+many-small-tensor trees and the native kernel for few-large-buffer
+gathers; the extension otherwise exists for apex_C API parity and as the
+build scaffolding for future native host components.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["flatten_dense_tensors", "unflatten_dense_tensors",
+           "native_available"]
+
+_LOCK = threading.Lock()
+_LIB: Optional[ctypes.CDLL] = None
+_TRIED = False
+
+
+def _build_and_load() -> Optional[ctypes.CDLL]:
+    global _LIB, _TRIED
+    if _TRIED:  # lock-free fast path for the hot helpers
+        return _LIB
+    with _LOCK:
+        if _TRIED:
+            return _LIB
+        here = os.path.dirname(os.path.abspath(__file__))
+        src = os.path.join(os.path.dirname(here), "_native", "flatcopy.c")
+        so = os.path.join(os.path.dirname(here), "_native",
+                          "libflatcopy.so")
+        try:
+            needs_build = os.path.exists(src) and (
+                not os.path.exists(so)
+                or os.path.getmtime(so) < os.path.getmtime(src))
+            if needs_build:
+                # compile to a temp name and rename: atomic publish, so
+                # concurrent processes never load a half-written .so
+                tmp = f"{so}.{os.getpid()}.tmp"
+                subprocess.run(
+                    ["cc", "-O3", "-shared", "-fPIC", "-fopenmp",
+                     src, "-o", tmp],
+                    check=True, capture_output=True, timeout=120)
+                os.replace(tmp, so)
+            lib = ctypes.CDLL(so)
+            lib.flat_gather.argtypes = [
+                ctypes.c_void_p, ctypes.POINTER(ctypes.c_void_p),
+                ctypes.POINTER(ctypes.c_int64), ctypes.c_int64]
+            lib.flat_scatter.argtypes = [
+                ctypes.c_void_p, ctypes.POINTER(ctypes.c_void_p),
+                ctypes.POINTER(ctypes.c_int64), ctypes.c_int64]
+            _LIB = lib
+        except Exception:
+            _LIB = None
+        _TRIED = True
+        return _LIB
+
+
+def native_available() -> bool:
+    return _build_and_load() is not None
+
+
+def flatten_dense_tensors(tensors: Sequence[np.ndarray]) -> np.ndarray:
+    """Concatenate 1D-raveled host arrays into one contiguous buffer
+    (``apex_C.flatten``).  All inputs must share a dtype."""
+    arrs = [np.ascontiguousarray(t) for t in tensors]
+    if not arrs:
+        return np.empty((0,), np.float32)
+    dtype = arrs[0].dtype
+    if any(a.dtype != dtype for a in arrs):
+        raise ValueError("flatten_dense_tensors requires a uniform dtype")
+    total = sum(a.size for a in arrs)
+    out = np.empty((total,), dtype)
+    lib = _build_and_load()
+    if lib is None or len(arrs) > 64:  # pointer-array setup dominates
+        off = 0
+        for a in arrs:
+            out[off:off + a.size] = a.ravel()
+            off += a.size
+        return out
+    n = len(arrs)
+    srcs = (ctypes.c_void_p * n)(*[a.ctypes.data for a in arrs])
+    sizes = (ctypes.c_int64 * n)(*[a.nbytes for a in arrs])
+    lib.flat_gather(ctypes.c_void_p(out.ctypes.data), srcs, sizes, n)
+    return out
+
+
+def unflatten_dense_tensors(flat: np.ndarray,
+                            like: Sequence[np.ndarray]) -> List[np.ndarray]:
+    """Split a flat buffer back into arrays shaped like ``like``
+    (``apex_C.unflatten``)."""
+    flat = np.ascontiguousarray(flat)
+    total = sum(int(np.prod(t.shape)) for t in like)
+    if flat.size != total:
+        raise ValueError(
+            f"flat buffer has {flat.size} elements, templates need {total}")
+    outs = [np.empty(t.shape, flat.dtype) for t in like]
+    lib = _build_and_load()
+    if lib is None or len(outs) > 64:  # pointer-array setup dominates
+        off = 0
+        for o in outs:
+            o.ravel()[:] = flat[off:off + o.size]
+            off += o.size
+        return outs
+    n = len(outs)
+    dsts = (ctypes.c_void_p * n)(*[o.ctypes.data for o in outs])
+    sizes = (ctypes.c_int64 * n)(*[o.nbytes for o in outs])
+    lib.flat_scatter(ctypes.c_void_p(flat.ctypes.data), dsts, sizes, n)
+    return outs

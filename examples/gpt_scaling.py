@@ -1,0 +1,124 @@
+"""GPT parallel-grid scaling harness.
+
+Behavioral spec: ``tests/L0/run_transformer/gpt_scaling_test.py`` — run the
+standalone GPT across (tp, pp) grids and report per-config step time and
+memory.  Here each grid runs the full 3D train step
+(:func:`apex_tpu.transformer.testing.gpt_parallel_train.build_gpt_3d`)
+over the attached devices (virtual CPU mesh or real chips).
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python examples/gpt_scaling.py --grids 1x1 2x1 1x2 2x2 4x2
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def run_grid(tp, pp, args):
+    import jax
+    import jax.numpy as jnp
+
+    from apex_tpu.optimizers import FusedAdam
+    from apex_tpu.parallel import mesh as mesh_lib
+    from apex_tpu.transformer.testing import TransformerConfig
+    from apex_tpu.transformer.testing.gpt_parallel_train import build_gpt_3d
+
+    n = len(jax.devices())
+    if n % (tp * pp):
+        return {"tp": tp, "pp": pp, "error": f"{n} devices not divisible"}
+    vpp = 2 if pp > 1 else 1
+    mesh = mesh_lib.initialize_model_parallel(
+        tensor_model_parallel_size=tp,
+        pipeline_model_parallel_size=pp,
+        virtual_pipeline_model_parallel_size=vpp if vpp > 1 else None)
+    try:
+        dp = mesh.shape["dp"]
+        cfg = TransformerConfig(
+            hidden_size=args.hidden, num_layers=pp * vpp,
+            num_attention_heads=max(4, args.hidden // 32),
+            padded_vocab_size=args.vocab,
+            max_position_embeddings=args.seq,
+            hidden_dropout=0.0, attention_dropout=0.0,
+            tensor_axis="tp" if tp > 1 else None,
+            sequence_parallel=tp > 1,
+            dtype=jnp.bfloat16 if jax.devices()[0].platform == "tpu"
+            else jnp.float32,
+        )
+        m = args.microbatches
+        init_fn, _, make_train_step = build_gpt_3d(
+            cfg, num_chunks=vpp, num_microbatches=m, mesh=mesh)
+        batch = dp * m * args.microbatch
+        tokens = jax.random.randint(jax.random.PRNGKey(0),
+                                    (batch, args.seq), 0, args.vocab)
+        params, specs = init_fn(jax.random.PRNGKey(1), tokens)
+        opt = FusedAdam(lr=1e-3)
+        state = opt.init(params)
+        step = jax.jit(make_train_step(opt, specs))
+
+        t0 = time.perf_counter()
+        params, state, loss = step(params, state, tokens)
+        jax.block_until_ready(loss)
+        compile_s = time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        for _ in range(args.steps):
+            params, state, loss = step(params, state, tokens)
+        jax.block_until_ready(loss)
+        dt = (time.perf_counter() - t0) / args.steps
+
+        mem = None
+        try:
+            stats = jax.devices()[0].memory_stats()
+            if stats:
+                mem = int(stats.get("peak_bytes_in_use", 0))
+        except Exception:
+            pass
+        return {
+            "tp": tp, "pp": pp, "vpp": vpp, "dp": dp,
+            "tokens_per_step": batch * args.seq,
+            "step_time_s": round(dt, 4),
+            "tokens_per_sec": round(batch * args.seq / dt, 1),
+            "compile_s": round(compile_s, 1),
+            "peak_bytes": mem,
+            "loss": float(loss),
+        }
+    finally:
+        mesh_lib.destroy_model_parallel()
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--grids", nargs="+", default=["1x1", "2x1", "1x2",
+                                                   "2x2", "4x2"],
+                    help="TPxPP grid list")
+    ap.add_argument("--hidden", type=int, default=128)
+    ap.add_argument("--seq", type=int, default=64)
+    ap.add_argument("--vocab", type=int, default=512)
+    ap.add_argument("--microbatches", type=int, default=4)
+    ap.add_argument("--microbatch", type=int, default=2)
+    ap.add_argument("--steps", type=int, default=5)
+    args = ap.parse_args()
+
+    if os.environ.get("JAX_PLATFORMS", "").lower() == "cpu":
+        from apex_tpu.utils.platform import pin_cpu
+
+        pin_cpu()
+    results = []
+    for grid in args.grids:
+        tp, pp = (int(x) for x in grid.split("x"))
+        try:
+            rec = run_grid(tp, pp, args)
+        except Exception as e:  # one bad grid must not kill the sweep
+            rec = {"tp": tp, "pp": pp, "error": repr(e)}
+        print(json.dumps(rec), flush=True)
+        results.append(rec)
+
+
+if __name__ == "__main__":
+    main()

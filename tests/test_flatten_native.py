@@ -1,0 +1,48 @@
+"""apex_C flatten/unflatten analog: native kernel + numpy routing."""
+
+import shutil
+
+import numpy as np
+import pytest
+
+from apex_tpu.utils.flatten import (
+    flatten_dense_tensors,
+    native_available,
+    unflatten_dense_tensors,
+)
+
+
+@pytest.mark.parametrize("n,shape", [(3, (13, 7)), (200, (17,)), (1, (4, 4, 2))])
+def test_flatten_roundtrip(n, shape):
+    rng = np.random.RandomState(0)
+    xs = [rng.randn(*shape).astype(np.float32) for _ in range(n)]
+    flat = flatten_dense_tensors(xs)
+    np.testing.assert_array_equal(
+        flat, np.concatenate([x.ravel() for x in xs]))
+    back = unflatten_dense_tensors(flat, xs)
+    for a, b in zip(back, xs):
+        np.testing.assert_array_equal(a, b)
+        assert a.shape == b.shape
+
+
+@pytest.mark.skipif(shutil.which("cc") is None,
+                    reason="no C toolchain; numpy fallback is by design")
+def test_flatten_native_kernel_builds():
+    assert native_available()
+
+
+def test_flatten_validation():
+    with pytest.raises(ValueError, match="dtype"):
+        flatten_dense_tensors([np.zeros(2, np.float32),
+                               np.zeros(2, np.float64)])
+    with pytest.raises(ValueError, match="elements"):
+        unflatten_dense_tensors(np.zeros(3, np.float32),
+                                [np.zeros(2, np.float32)] * 2)
+
+
+def test_flatten_dtypes():
+    for dt in (np.float16, np.float32, np.int32, np.uint16):
+        xs = [np.arange(10, dtype=dt), np.arange(7, dtype=dt)]
+        back = unflatten_dense_tensors(flatten_dense_tensors(xs), xs)
+        for a, b in zip(back, xs):
+            np.testing.assert_array_equal(a, b)
