@@ -1,0 +1,117 @@
+"""Global test-harness state: args + autoresume hook.
+
+Behavioral spec: ``apex/transformer/testing/global_vars.py`` — global args
+registry (``:89-140``) and the ADLR autoresume poller (``:75-87,158-166``)
+that ``check_adlr_autoresume_termination``
+(``pipeline_parallel/utils.py:142-143``) consults so preempted cluster
+jobs checkpoint and requeue themselves.
+
+TPU-first: ADLR's poller is NVIDIA-cluster-internal, so :class:`AutoResume`
+generalizes the *protocol* — a termination signal (sentinel file or env
+var, which is how Borg/GKE/SLURM preemption notices are commonly surfaced)
+polled on an interval, plus the checkpoint-and-requeue hook.  The
+:func:`check_autoresume_termination` helper mirrors the reference's call
+shape: call it every iteration with your save callback.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Callable, Optional
+
+__all__ = [
+    "AutoResume",
+    "get_autoresume",
+    "set_autoresume",
+    "check_autoresume_termination",
+    "get_args",
+    "set_args",
+]
+
+_GLOBAL_ARGS: Optional[Any] = None
+_GLOBAL_AUTORESUME: Optional["AutoResume"] = None
+
+
+def set_args(args) -> None:
+    """Register harness args (reference ``set_global_variables``/_GLOBAL_ARGS)."""
+    global _GLOBAL_ARGS
+    _GLOBAL_ARGS = args
+
+
+def get_args():
+    """Reference ``get_args`` (``global_vars.py:36``)."""
+    if _GLOBAL_ARGS is None:
+        raise RuntimeError("args not initialized; call set_args first")
+    return _GLOBAL_ARGS
+
+
+class AutoResume:
+    """Preemption-notice poller (the ``AutoResume`` ADLR hook analog).
+
+    Termination is requested when ``signal_file`` exists or
+    ``signal_env`` is set to a truthy value; ``min_poll_interval``
+    rate-limits filesystem checks exactly like the reference's
+    ``termination_requested`` poller.
+    """
+
+    def __init__(self,
+                 signal_file: Optional[str] = None,
+                 signal_env: str = "APEX_TPU_AUTORESUME_TERMINATE",
+                 min_poll_interval: float = 10.0):
+        self.signal_file = signal_file or os.environ.get(
+            "APEX_TPU_AUTORESUME_FILE")
+        self.signal_env = signal_env
+        self.min_poll_interval = min_poll_interval
+        self._last_poll = 0.0
+        self._cached = False
+
+    def init(self) -> None:  # reference API shape (autoresume.init())
+        self._last_poll = 0.0
+        self._cached = False
+
+    def termination_requested(self) -> bool:
+        now = time.monotonic()
+        if now - self._last_poll < self.min_poll_interval:
+            return self._cached
+        self._last_poll = now
+        env_val = os.environ.get(self.signal_env, "").strip().lower()
+        env_requested = env_val not in ("", "0", "false", "no", "off")
+        self._cached = bool(
+            env_requested
+            or (self.signal_file and os.path.exists(self.signal_file)))
+        return self._cached
+
+    def request_resume(self) -> None:
+        """Signal the scheduler to requeue (reference
+        ``autoresume.request_resume()``).  Generic analog: remove the
+        sentinel so the requeued job starts clean."""
+        if self.signal_file and os.path.exists(self.signal_file):
+            try:
+                os.remove(self.signal_file)
+            except OSError:
+                pass
+
+
+def set_autoresume(autoresume: Optional[AutoResume]) -> None:
+    global _GLOBAL_AUTORESUME
+    _GLOBAL_AUTORESUME = autoresume
+
+
+def get_autoresume() -> Optional[AutoResume]:
+    """Reference ``get_adlr_autoresume`` (``global_vars.py:75``)."""
+    return _GLOBAL_AUTORESUME
+
+
+def check_autoresume_termination(iteration: int,
+                                 save_fn: Callable[[int], None]) -> bool:
+    """Reference ``check_adlr_autoresume_termination``
+    (``pipeline_parallel/utils.py:142-143`` / megatron training.py): when
+    termination is requested, checkpoint via ``save_fn(iteration)``,
+    request requeue, and return True so the training loop exits."""
+    ar = get_autoresume()
+    if ar is None or not ar.termination_requested():
+        return False
+    save_fn(iteration)
+    ar.request_resume()
+    return True

@@ -80,6 +80,29 @@ class Timers:
         print(line, flush=True)
         return line
 
+    def write(self, names, writer, iteration: int, normalizer: float = 1.0,
+              reset: bool = False) -> None:
+        """Export timer values (reference ``_Timers.write``
+        ``pipeline_parallel/_timers.py:69-77``, which targets a
+        TensorBoard ``SummaryWriter``).
+
+        ``writer`` is duck-typed: anything with ``add_scalar(tag, value,
+        step)`` (TensorBoard-compatible), or a file path — then one JSON
+        line ``{"iteration", "timers": {name: seconds}}`` is appended (no
+        TB dependency in this image; the JSONL is trivially convertible).
+        """
+        values = {n: self.timers[n].elapsed(reset=reset) / normalizer
+                  for n in names if n in self.timers}
+        if hasattr(writer, "add_scalar"):
+            for name, value in values.items():
+                writer.add_scalar(f"timers/{name}", value, iteration)
+        else:
+            import json
+
+            with open(writer, "a") as f:
+                f.write(json.dumps({"iteration": iteration,
+                                    "timers": values}) + "\n")
+
 
 _GLOBAL_TIMERS: Optional[Timers] = None
 

@@ -1,0 +1,102 @@
+"""Aux subsystems: timers export, autoresume protocol, rank logger
+(SURVEY §5 tracing / failure-detection / observability rows)."""
+
+import json
+import logging
+
+import pytest
+
+from apex_tpu.log_util import get_transformer_logger, set_logging_level
+from apex_tpu.transformer.testing.global_vars import (
+    AutoResume,
+    check_autoresume_termination,
+    get_args,
+    set_args,
+    set_autoresume,
+)
+from apex_tpu.utils.timers import Timers
+
+
+def test_timers_write_jsonl(tmp_path):
+    t = Timers()
+    t("fwd").start()
+    t("fwd").stop()
+    path = tmp_path / "timers.jsonl"
+    t.write(["fwd", "missing"], str(path), iteration=3)
+    rec = json.loads(path.read_text().strip())
+    assert rec["iteration"] == 3
+    assert "fwd" in rec["timers"] and rec["timers"]["fwd"] >= 0
+    assert "missing" not in rec["timers"]
+
+
+def test_timers_write_tensorboard_ducktype():
+    calls = []
+
+    class Writer:
+        def add_scalar(self, tag, value, step):
+            calls.append((tag, value, step))
+
+    t = Timers()
+    t("step").start()
+    t("step").stop()
+    t.write(["step"], Writer(), iteration=7)
+    assert calls and calls[0][0] == "timers/step" and calls[0][2] == 7
+
+
+def test_autoresume_file_protocol(tmp_path):
+    sig = tmp_path / "preempt"
+    ar = AutoResume(signal_file=str(sig), min_poll_interval=0.0)
+    set_autoresume(ar)
+    saved = []
+    assert not check_autoresume_termination(1, saved.append)
+    sig.write_text("now")
+    assert check_autoresume_termination(2, saved.append)
+    assert saved == [2]
+    assert not sig.exists()  # request_resume cleared the sentinel
+    set_autoresume(None)
+
+
+def test_autoresume_env_protocol(monkeypatch):
+    monkeypatch.setenv("APEX_TPU_AUTORESUME_TERMINATE", "1")
+    ar = AutoResume(min_poll_interval=0.0)
+    assert ar.termination_requested()
+    # falsy strings mean "disabled", not "requested"
+    for off in ("0", "false", "no", ""):
+        monkeypatch.setenv("APEX_TPU_AUTORESUME_TERMINATE", off)
+        ar.init()
+        assert not ar.termination_requested(), off
+    monkeypatch.delenv("APEX_TPU_AUTORESUME_TERMINATE")
+    ar.init()
+    assert not ar.termination_requested()
+
+
+def test_global_args_registry():
+    set_args(None)
+    with pytest.raises(RuntimeError):
+        get_args()
+    set_args({"lr": 0.1})
+    assert get_args()["lr"] == 0.1
+    set_args(None)
+
+
+def test_rank_logger_stamps_rank_info():
+    import io
+
+    import apex_tpu
+
+    lg = get_transformer_logger(__name__)
+    assert lg.name.startswith("apex_tpu.")
+    set_logging_level(logging.INFO)
+    root = logging.getLogger("apex_tpu")
+    # capture through the installed rank-stamped formatter
+    buf = io.StringIO()
+    cap = logging.StreamHandler(buf)
+    cap.setFormatter(root.handlers[0].formatter)
+    root.addHandler(cap)
+    try:
+        lg.info("hello from the library logger")
+    finally:
+        root.removeHandler(cap)
+    out = buf.getvalue()
+    assert "hello from the library logger" in out
+    assert "[0/1]" in out  # rank info stamped by RankInfoFormatter
