@@ -47,6 +47,13 @@ DEFAULT_RULES: Tuple[Tuple[str, Tuple[Optional[str], ...]], ...] = (
      (None, "tp")),
     # BERT LM head bias is vocab-sharded like the embedding
     (r"lm_head/bias$", ("tp",)),
+    # Switch-MoE expert stacks (transformer/moe.py): dim 0 = local experts,
+    # sharded over the expert-parallel axis ("ep" marker).  The router is
+    # replicated (no rule).
+    (r"mlp/w1$", ("ep", None, None)),
+    (r"mlp/b1$", ("ep", None)),
+    (r"mlp/w2$", ("ep", None, None)),
+    (r"mlp/b2$", ("ep", None)),
 )
 
 
@@ -54,21 +61,30 @@ def infer_param_specs(
     params,
     rules: Sequence[Tuple[str, Tuple[Optional[str], ...]]] = DEFAULT_RULES,
     axis: str = TENSOR_AXIS,
+    ep_axis: Optional[str] = None,
 ):
     """PartitionSpec pytree for ``params`` from path-pattern ``rules``.
 
-    Rule templates use the literal string ``"tp"`` for the sharded dim; it is
-    substituted with ``axis``.  Unmatched leaves are replicated (``P()``) —
-    which is what makes their gradients correct under shard_map (see module
-    docstring).
+    Rule templates use the literal strings ``"tp"`` (tensor-parallel dim,
+    substituted with ``axis``) and ``"ep"`` (expert-parallel dim,
+    substituted with ``ep_axis``; dropped to replicated when ``ep_axis``
+    is None).  Unmatched leaves are replicated (``P()``) — which is what
+    makes their gradients correct under shard_map (see module docstring).
     """
     compiled = [(re.compile(pat), tpl) for pat, tpl in rules]
+
+    def sub(t):
+        if t == "tp":
+            return axis
+        if t == "ep":
+            return ep_axis
+        return t
 
     def spec_for(path, leaf):
         name = "/".join(str(getattr(k, "key", k)) for k in path)
         for pat, tpl in compiled:
             if pat.search(name):
-                resolved = tuple(axis if t == "tp" else t for t in tpl)
+                resolved = tuple(sub(t) for t in tpl)
                 if len(resolved) > leaf.ndim:
                     raise ValueError(
                         f"rule {pat.pattern} spec {resolved} has more dims "

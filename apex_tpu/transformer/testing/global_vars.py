@@ -108,10 +108,30 @@ def check_autoresume_termination(iteration: int,
     """Reference ``check_adlr_autoresume_termination``
     (``pipeline_parallel/utils.py:142-143`` / megatron training.py): when
     termination is requested, checkpoint via ``save_fn(iteration)``,
-    request requeue, and return True so the training loop exits."""
+    request requeue, and return True so the training loop exits.
+
+    Multi-process jobs agree on the decision first (the reference
+    all-reduces the flag over ranks for the same reason): a preemption
+    notice delivered to one host must stop *every* rank, or the others
+    hang in their next collective.
+    """
     ar = get_autoresume()
-    if ar is None or not ar.termination_requested():
+    local = bool(ar is not None and ar.termination_requested())
+    import jax
+
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        import numpy as np
+
+        flags = multihost_utils.process_allgather(
+            np.asarray([local], np.int32))
+        decided = bool(np.asarray(flags).any())
+    else:
+        decided = local
+    if not decided:
         return False
     save_fn(iteration)
-    ar.request_resume()
+    if ar is not None:
+        ar.request_resume()
     return True

@@ -71,6 +71,7 @@ def build_gpt_3d(
     dp_axis: str = DATA_AXIS,
     pp_axis: str = PIPELINE_AXIS,
     tp_axis: str = TENSOR_AXIS,
+    moe_aux_coeff: float = 1e-2,
 ):
     """Return ``(init_fn, train_step, param_specs_fn)``.
 
@@ -119,12 +120,13 @@ def build_gpt_3d(
             return e, stacked, ln
 
         shapes = jax.eval_shape(local_init, mb_tokens)
+        ep_axis = cfg.expert_axis
         e_specs = infer_param_specs(shapes[0], axis=tp_axis)
         l_specs = _prepend(infer_param_specs(
             jax.tree_util.tree_map(
                 lambda l: jax.ShapeDtypeStruct(l.shape[1:], l.dtype),
                 shapes[1],
-            ), axis=tp_axis
+            ), axis=tp_axis, ep_axis=ep_axis
         ), None)  # [L, ...] replicated stack dim at init time
         ln_specs = jax.tree_util.tree_map(lambda _: P(), shapes[2])
 
@@ -138,7 +140,8 @@ def build_gpt_3d(
             lambda l: l.reshape((vpp, pp) + l.shape[1:]), stacked
         )
         layer_specs = _prepend(infer_param_specs(
-            jax.tree_util.tree_map(lambda l: l[0, 0], stacked), axis=tp_axis
+            jax.tree_util.tree_map(lambda l: l[0, 0], stacked),
+            axis=tp_axis, ep_axis=cfg.expert_axis
         ), None, pp_axis)
 
         params = GPT3DParams(embedding=e, layers=stacked, final_ln=ln)
@@ -154,12 +157,21 @@ def build_gpt_3d(
             return embed.apply({"params": p.embedding}, t)
 
         h = jax.vmap(embed_one)(mbs)  # [m, s(/tp), mb, hid]
+        # MoE aux loss rides the pipeline as a per-microbatch scalar in the
+        # activation pytree (stage output structure stays homogeneous);
+        # dense configs carry a zero.
+        aux0 = jnp.zeros((num_microbatches,), jnp.float32)
 
-        def stage_fn(lp, x):
-            return layer.apply({"params": lp}, x, None)
+        def stage_fn(lp, xa):
+            x, aux = xa
+            y, mut = layer.apply({"params": lp}, x, None,
+                                 mutable=["losses"])
+            from apex_tpu.transformer.moe import collect_moe_aux
 
-        out = pipeline_apply(
-            stage_fn, p.layers, h, axis=pp_axis, num_chunks=vpp,
+            return y, aux + collect_moe_aux(mut)
+
+        out, aux_out = pipeline_apply(
+            stage_fn, p.layers, (h, aux0), axis=pp_axis, num_chunks=vpp,
             params_already_local=True,
         )
 
@@ -171,7 +183,10 @@ def build_gpt_3d(
             return jnp.mean(gpt_next_token_loss(logits, t, cfg))
 
         losses = jax.vmap(head_one)(out, mbs)
-        return jnp.mean(losses)
+        ce = jnp.mean(losses)
+        if cfg.num_experts is not None:
+            ce = ce + moe_aux_coeff * jnp.mean(aux_out)
+        return ce
 
     def make_loss_fn(param_specs):
         """Global (dp-mean) loss over global arrays.

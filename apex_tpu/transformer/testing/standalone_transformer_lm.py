@@ -98,6 +98,12 @@ class TransformerConfig:
     sequence_parallel: bool = False
     tensor_axis: Optional[str] = TENSOR_AXIS  # None = no tensor parallelism
 
+    # Mixture-of-experts (parity-plus: the reference stubs SwitchMLP out,
+    # standalone_transformer_lm.py:675; see apex_tpu/transformer/moe.py).
+    num_experts: Optional[int] = None
+    expert_capacity_factor: float = 1.25
+    expert_axis: Optional[str] = None
+
     dtype: Any = jnp.float32        # compute dtype (bf16 under the O2 policy)
     param_dtype: Any = jnp.float32
 
@@ -351,7 +357,20 @@ class ParallelTransformerLayer(nn.Module):
 
         ln2 = FusedLayerNorm(cfg.hidden_size, eps=cfg.layernorm_epsilon,
                              name="post_attention_layernorm")(h)
-        mlp_out, mlp_bias = ParallelMLP(cfg, name="mlp")(ln2)
+        if cfg.num_experts is not None:
+            from apex_tpu.transformer.moe import SwitchMLP
+
+            mlp_out, _aux = SwitchMLP(
+                hidden_size=cfg.hidden_size, ffn_size=cfg.ffn_size,
+                num_experts=cfg.num_experts,
+                capacity_factor=cfg.expert_capacity_factor,
+                expert_axis=cfg.expert_axis,
+                dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                name="mlp",
+            )(ln2)
+            mlp_bias = jnp.zeros((), cfg.dtype)
+        else:
+            mlp_out, mlp_bias = ParallelMLP(cfg, name="mlp")(ln2)
         residual = ln2 if cfg.apply_residual_connection_post_layernorm else h
         return residual + nn.Dropout(rate=cfg.hidden_dropout)(
             mlp_out + mlp_bias, deterministic=deterministic
