@@ -51,8 +51,14 @@ def collect_moe_aux(mutated_collections) -> jnp.ndarray:
     """Sum every ``moe_aux`` value sown into the ``"losses"`` collection
     (one per MoE layer) — add ``coeff * collect_moe_aux(mut)`` to the
     training loss.  Returns 0.0 when no MoE layer ran."""
-    losses = mutated_collections.get("losses", {}) if isinstance(
-        mutated_collections, dict) else {}
+    from collections.abc import Mapping
+
+    if not isinstance(mutated_collections, Mapping):
+        raise TypeError(
+            f"expected the mutated-collections mapping from "
+            f"module.apply(..., mutable=['losses']), got "
+            f"{type(mutated_collections).__name__}")
+    losses = mutated_collections.get("losses", {})
     total = jnp.float32(0.0)
     for path, leaf in jax.tree_util.tree_leaves_with_path(losses):
         if any("moe_aux" in str(getattr(k, "key", k)) for k in path):

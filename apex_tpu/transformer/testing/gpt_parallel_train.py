@@ -185,7 +185,16 @@ def build_gpt_3d(
         losses = jax.vmap(head_one)(out, mbs)
         ce = jnp.mean(losses)
         if cfg.num_experts is not None:
-            ce = ce + moe_aux_coeff * jnp.mean(aux_out)
+            aux_term = jnp.mean(aux_out)
+            if cfg.tensor_axis is not None:
+                # Under SP each tp rank routed a different sequence shard,
+                # so its aux scalar differs; ce is tp-replicated (vocab-
+                # parallel CE psums over tp) and the loss leaves this
+                # shard_map with out_specs=P() — average aux over tp so
+                # the replication contract stays honest
+                # (tensor_parallel/partition.py docstring).
+                aux_term = cc.all_reduce(aux_term, tp_axis, "mean")
+            ce = ce + moe_aux_coeff * aux_term
         return ce
 
     def make_loss_fn(param_specs):
