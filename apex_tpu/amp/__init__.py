@@ -53,6 +53,14 @@ from apex_tpu.amp.scaler import (  # noqa: F401
     all_finite,
     scale_loss,
 )
+from apex_tpu.amp.fp8 import (  # noqa: F401
+    E4M3,
+    E5M2,
+    Fp8Dense,
+    Fp8Meta,
+    fp8_quantize,
+    update_meta,
+)
 from apex_tpu.amp.master import (  # noqa: F401
     MasterWeights,
     make_master,

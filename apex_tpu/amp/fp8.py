@@ -1,0 +1,173 @@
+"""FP8 training primitives with delayed scaling.
+
+Parity-frontier: the reference's fp8 support is the amax-sharing process
+groups ``parallel_state`` builds for TransformerEngine interop
+(``apex/transformer/parallel_state.py`` amax groups, SURVEY §2.2 row 24) —
+apex itself defers the math to TE.  This module supplies the TPU-native
+math TE would: per-tensor **delayed scaling** (scale from a rolling amax
+history), e4m3 forward / e5m2 gradient quantization, and the
+model-parallel amax reduction that is the reference's amax group.
+
+Semantics (TransformerEngine delayed-scaling recipe):
+
+- each quantized tensor carries ``Fp8Meta``: ``amax_history [H]`` and the
+  current ``scale``;
+- quantize: ``q = cast(clip(x * scale, ±fp8_max))`` with
+  ``scale = fp8_max / (amax_hist_max * margin)`` derived from *previous*
+  steps (delayed — no extra pass over the data);
+- the *current* step's amax rolls into the history; under tensor/sequence
+  parallelism the amax is ``pmax``-reduced over the model-parallel axis
+  first (the amax-group all-reduce);
+- **gradients use just-in-time (current) scaling** to e5m2: the cotangent
+  magnitude is set by the loss scaler and can jump 2^16x step to step, so
+  a delayed scale would saturate the clip silently (finite values — the
+  scaler's ``all_finite`` would never trip); the per-step amax pass over
+  the cotangent buys robustness (TE's "current scaling" option).
+
+TPU note: matmuls compute in ``preferred_element_type`` after an upcast
+from fp8 — on chips without fp8 MXU paths this is a numerics/storage
+capability (fp8-width activations/grads for collectives and checkpoints),
+not a FLOP win; the API is laid out so XLA lowers straight to fp8 GEMMs
+where hardware supports them.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+__all__ = ["Fp8Meta", "Fp8Dense", "fp8_quantize", "update_meta",
+           "E4M3", "E5M2"]
+
+E4M3 = jnp.float8_e4m3fn
+E5M2 = jnp.float8_e5m2
+_MARGIN = 1.0
+
+
+class Fp8Meta(NamedTuple):
+    """Delayed-scaling state for one quantized tensor."""
+
+    amax_history: jnp.ndarray  # [H] fp32
+    scale: jnp.ndarray         # scalar fp32
+
+    @classmethod
+    def init(cls, history_len: int = 16) -> "Fp8Meta":
+        return cls(amax_history=jnp.zeros((history_len,), jnp.float32),
+                   scale=jnp.float32(1.0))
+
+
+def _fp8_max(dtype) -> float:
+    return float(jnp.finfo(dtype).max)
+
+
+def fp8_quantize(x, meta: Fp8Meta, dtype=E4M3):
+    """Quantize with the *delayed* scale; returns ``(q, amax_now)``."""
+    amax_now = jnp.max(jnp.abs(x)).astype(jnp.float32)
+    lim = _fp8_max(dtype)
+    q = jnp.clip(x.astype(jnp.float32) * meta.scale, -lim, lim).astype(dtype)
+    return q, amax_now
+
+
+def update_meta(meta: Fp8Meta, amax_now, dtype=E4M3,
+                axis: Optional[str] = None) -> Fp8Meta:
+    """Roll the amax history and refresh the scale.
+
+    ``axis``: model-parallel mesh axis to ``pmax`` the amax over before it
+    enters the history — the reference's amax-sharing group
+    (``parallel_state`` amax groups) as one collective.
+    """
+    amax_now = jnp.asarray(amax_now, jnp.float32).reshape(())
+    if axis is not None:
+        amax_now = jax.lax.pmax(amax_now, axis)
+    hist = jnp.concatenate([amax_now[None],
+                            meta.amax_history[:-1]])
+    amax = jnp.max(hist)
+    scale = jnp.where(amax > 0,
+                      _fp8_max(dtype) / (amax * _MARGIN),
+                      meta.scale)
+    return Fp8Meta(amax_history=hist, scale=scale)
+
+
+class Fp8Dense(nn.Module):
+    """Dense layer computing through fp8 with delayed scaling.
+
+    Meta state lives in the mutable ``"fp8_meta"`` collection — run
+    ``apply(..., mutable=["fp8_meta"])`` during training and carry the
+    returned collection forward (checkpointable like any state).  The
+    gradient path quantizes the incoming cotangent to e5m2 with a
+    just-in-time scale (see module docstring — robust under dynamic loss
+    scaling).
+    """
+
+    features: int
+    use_bias: bool = True
+    history_len: int = 16
+    axis: Optional[str] = None  # model-parallel amax-sharing axis
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        in_features = x.shape[-1]
+        kernel = self.param("kernel", nn.initializers.lecun_normal(),
+                            (in_features, self.features), self.param_dtype)
+        bias = (self.param("bias", nn.initializers.zeros,
+                           (self.features,), self.param_dtype)
+                if self.use_bias else None)
+
+        init = lambda: Fp8Meta.init(self.history_len)  # noqa: E731
+        metas = self.variable("fp8_meta", "metas",
+                              lambda: {"x": init(), "w": init()})
+        m = metas.value
+        axis = self.axis
+
+        def quant(v, scale, dtype):
+            lim = _fp8_max(dtype)
+            return jnp.clip(v.astype(jnp.float32) * scale,
+                            -lim, lim).astype(dtype)
+
+        @jax.custom_vjp
+        def core(x2d, w, xm, wm):
+            y = jnp.dot(quant(x2d, xm.scale, E4M3).astype(jnp.float32),
+                        quant(w, wm.scale, E4M3).astype(jnp.float32))
+            return (y / (xm.scale * wm.scale)).astype(x2d.dtype)
+
+        def fwd(x2d, w, xm, wm):
+            return core(x2d, w, xm, wm), (x2d, w, xm, wm)
+
+        def bwd(res, g):
+            x2d, w, xm, wm = res
+            # just-in-time e5m2 scale from the cotangent itself: immune to
+            # loss-scale jumps that would saturate a delayed scale
+            g_amax = jnp.max(jnp.abs(g)).astype(jnp.float32)
+            g_scale = jnp.where(g_amax > 0, _fp8_max(E5M2) / g_amax, 1.0)
+            g32 = quant(g, g_scale, E5M2).astype(jnp.float32) / g_scale
+            wq = quant(w, wm.scale, E4M3).astype(jnp.float32)
+            xq = quant(x2d, xm.scale, E4M3).astype(jnp.float32)
+            dx = (g32 @ wq.T) / wm.scale
+            dw = (xq.T @ g32) / xm.scale
+            return (dx.astype(x2d.dtype), dw.astype(w.dtype), None, None)
+
+        core.defvjp(fwd, bwd)
+
+        lead = x.shape[:-1]
+        x2d = x.reshape(-1, in_features)
+        y = core(x2d, kernel, m["x"], m["w"])
+
+        # Delayed-scaling bookkeeping (outside the vjp: pure state; the
+        # single amax pass per tensor lives here — core quantizes with the
+        # stored scales only).
+        if not self.is_initializing():
+            x_amax = jnp.max(jnp.abs(x2d)).astype(jnp.float32)
+            w_amax = jnp.max(jnp.abs(kernel)).astype(jnp.float32)
+            metas.value = {
+                "x": update_meta(m["x"], x_amax, E4M3, axis),
+                "w": update_meta(m["w"], w_amax, E4M3, axis),
+            }
+
+        y = y.reshape(*lead, self.features)
+        if bias is not None:
+            y = y + bias.astype(y.dtype)
+        return y
