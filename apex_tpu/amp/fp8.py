@@ -35,9 +35,13 @@ from __future__ import annotations
 
 from typing import NamedTuple, Optional
 
-import flax.linen as nn
 import jax
 import jax.numpy as jnp
+
+try:  # flax is the module-layer convention in this framework
+    import flax.linen as nn
+except Exception:  # pragma: no cover
+    nn = None
 
 __all__ = ["Fp8Meta", "Fp8Dense", "fp8_quantize", "update_meta",
            "E4M3", "E5M2"]
@@ -63,12 +67,17 @@ def _fp8_max(dtype) -> float:
     return float(jnp.finfo(dtype).max)
 
 
+def _quantize(v, scale, dtype):
+    """``cast(clip(v * scale, ±fp8_max))`` — the one copy of the core
+    quantization expression (fwd, bwd, and the public API all route here)."""
+    lim = _fp8_max(dtype)
+    return jnp.clip(v.astype(jnp.float32) * scale, -lim, lim).astype(dtype)
+
+
 def fp8_quantize(x, meta: Fp8Meta, dtype=E4M3):
     """Quantize with the *delayed* scale; returns ``(q, amax_now)``."""
     amax_now = jnp.max(jnp.abs(x)).astype(jnp.float32)
-    lim = _fp8_max(dtype)
-    q = jnp.clip(x.astype(jnp.float32) * meta.scale, -lim, lim).astype(dtype)
-    return q, amax_now
+    return _quantize(x, meta.scale, dtype), amax_now
 
 
 def update_meta(meta: Fp8Meta, amax_now, dtype=E4M3,
@@ -91,83 +100,83 @@ def update_meta(meta: Fp8Meta, amax_now, dtype=E4M3,
     return Fp8Meta(amax_history=hist, scale=scale)
 
 
-class Fp8Dense(nn.Module):
-    """Dense layer computing through fp8 with delayed scaling.
+if nn is not None:
 
-    Meta state lives in the mutable ``"fp8_meta"`` collection — run
-    ``apply(..., mutable=["fp8_meta"])`` during training and carry the
-    returned collection forward (checkpointable like any state).  The
-    gradient path quantizes the incoming cotangent to e5m2 with a
-    just-in-time scale (see module docstring — robust under dynamic loss
-    scaling).
-    """
+    class Fp8Dense(nn.Module):
+        """Dense layer computing through fp8 with delayed scaling.
 
-    features: int
-    use_bias: bool = True
-    history_len: int = 16
-    axis: Optional[str] = None  # model-parallel amax-sharing axis
-    param_dtype: jnp.dtype = jnp.float32
+        Meta state lives in the mutable ``"fp8_meta"`` collection — run
+        ``apply(..., mutable=["fp8_meta"])`` during training and carry the
+        returned collection forward (checkpointable like any state).  The
+        gradient path quantizes the incoming cotangent to e5m2 with a
+        just-in-time scale (see module docstring — robust under dynamic loss
+        scaling).
+        """
 
-    @nn.compact
-    def __call__(self, x):
-        in_features = x.shape[-1]
-        kernel = self.param("kernel", nn.initializers.lecun_normal(),
-                            (in_features, self.features), self.param_dtype)
-        bias = (self.param("bias", nn.initializers.zeros,
-                           (self.features,), self.param_dtype)
-                if self.use_bias else None)
+        features: int
+        use_bias: bool = True
+        history_len: int = 16
+        axis: Optional[str] = None  # model-parallel amax-sharing axis
+        param_dtype: jnp.dtype = jnp.float32
 
-        init = lambda: Fp8Meta.init(self.history_len)  # noqa: E731
-        metas = self.variable("fp8_meta", "metas",
-                              lambda: {"x": init(), "w": init()})
-        m = metas.value
-        axis = self.axis
+        @nn.compact
+        def __call__(self, x):
+            in_features = x.shape[-1]
+            kernel = self.param("kernel", nn.initializers.lecun_normal(),
+                                (in_features, self.features), self.param_dtype)
+            bias = (self.param("bias", nn.initializers.zeros,
+                               (self.features,), self.param_dtype)
+                    if self.use_bias else None)
 
-        def quant(v, scale, dtype):
-            lim = _fp8_max(dtype)
-            return jnp.clip(v.astype(jnp.float32) * scale,
-                            -lim, lim).astype(dtype)
+            init = lambda: Fp8Meta.init(self.history_len)  # noqa: E731
+            metas = self.variable("fp8_meta", "metas",
+                                  lambda: {"x": init(), "w": init()})
+            m = metas.value
+            axis = self.axis
 
-        @jax.custom_vjp
-        def core(x2d, w, xm, wm):
-            y = jnp.dot(quant(x2d, xm.scale, E4M3).astype(jnp.float32),
-                        quant(w, wm.scale, E4M3).astype(jnp.float32))
-            return (y / (xm.scale * wm.scale)).astype(x2d.dtype)
+            @jax.custom_vjp
+            def core(x2d, w, xm, wm):
+                y = jnp.dot(_quantize(x2d, xm.scale, E4M3).astype(jnp.float32),
+                            _quantize(w, wm.scale, E4M3).astype(jnp.float32))
+                return (y / (xm.scale * wm.scale)).astype(x2d.dtype)
 
-        def fwd(x2d, w, xm, wm):
-            return core(x2d, w, xm, wm), (x2d, w, xm, wm)
+            def fwd(x2d, w, xm, wm):
+                return core(x2d, w, xm, wm), (x2d, w, xm, wm)
 
-        def bwd(res, g):
-            x2d, w, xm, wm = res
-            # just-in-time e5m2 scale from the cotangent itself: immune to
-            # loss-scale jumps that would saturate a delayed scale
-            g_amax = jnp.max(jnp.abs(g)).astype(jnp.float32)
-            g_scale = jnp.where(g_amax > 0, _fp8_max(E5M2) / g_amax, 1.0)
-            g32 = quant(g, g_scale, E5M2).astype(jnp.float32) / g_scale
-            wq = quant(w, wm.scale, E4M3).astype(jnp.float32)
-            xq = quant(x2d, xm.scale, E4M3).astype(jnp.float32)
-            dx = (g32 @ wq.T) / wm.scale
-            dw = (xq.T @ g32) / xm.scale
-            return (dx.astype(x2d.dtype), dw.astype(w.dtype), None, None)
+            def bwd(res, g):
+                x2d, w, xm, wm = res
+                # just-in-time e5m2 scale from the cotangent itself: immune to
+                # loss-scale jumps that would saturate a delayed scale
+                g_amax = jnp.max(jnp.abs(g)).astype(jnp.float32)
+                g_scale = jnp.where(g_amax > 0, _fp8_max(E5M2) / g_amax, 1.0)
+                g32 = _quantize(g, g_scale, E5M2).astype(jnp.float32) / g_scale
+                wq = _quantize(w, wm.scale, E4M3).astype(jnp.float32)
+                xq = _quantize(x2d, xm.scale, E4M3).astype(jnp.float32)
+                dx = (g32 @ wq.T) / wm.scale
+                dw = (xq.T @ g32) / xm.scale
+                return (dx.astype(x2d.dtype), dw.astype(w.dtype), None, None)
 
-        core.defvjp(fwd, bwd)
+            core.defvjp(fwd, bwd)
 
-        lead = x.shape[:-1]
-        x2d = x.reshape(-1, in_features)
-        y = core(x2d, kernel, m["x"], m["w"])
+            lead = x.shape[:-1]
+            x2d = x.reshape(-1, in_features)
+            y = core(x2d, kernel, m["x"], m["w"])
 
-        # Delayed-scaling bookkeeping (outside the vjp: pure state; the
-        # single amax pass per tensor lives here — core quantizes with the
-        # stored scales only).
-        if not self.is_initializing():
-            x_amax = jnp.max(jnp.abs(x2d)).astype(jnp.float32)
-            w_amax = jnp.max(jnp.abs(kernel)).astype(jnp.float32)
-            metas.value = {
-                "x": update_meta(m["x"], x_amax, E4M3, axis),
-                "w": update_meta(m["w"], w_amax, E4M3, axis),
-            }
+            # Delayed-scaling bookkeeping (outside the vjp: pure state; the
+            # single amax pass per tensor lives here — core quantizes with the
+            # stored scales only).
+            if not self.is_initializing():
+                x_amax = jnp.max(jnp.abs(x2d)).astype(jnp.float32)
+                w_amax = jnp.max(jnp.abs(kernel)).astype(jnp.float32)
+                metas.value = {
+                    "x": update_meta(m["x"], x_amax, E4M3, axis),
+                    "w": update_meta(m["w"], w_amax, E4M3, axis),
+                }
 
-        y = y.reshape(*lead, self.features)
-        if bias is not None:
-            y = y + bias.astype(y.dtype)
-        return y
+            y = y.reshape(*lead, self.features)
+            if bias is not None:
+                y = y + bias.astype(y.dtype)
+            return y
+
+else:  # pragma: no cover
+    Fp8Dense = None

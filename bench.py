@@ -543,16 +543,52 @@ def main():
             results[name] = {"error": "skipped: global deadline"}
             continue
         results[name] = _run_child(name, platform, budget)
+        # The tunneled TPU can die *mid-suite* (observed: backend init
+        # wedges for every subsequent child).  After a timeout, re-probe
+        # before burning the remaining budget 900s at a time; degrade to
+        # CPU (tiny shapes, but a record) if the chip is gone.
+        if (platform == "tpu" and "timeout" in
+                str(results[name].get("error", ""))):
+            _log("timeout on tpu: re-probing backend health")
+            platform = probe_platform(max_tries=1, timeout=150.0)
+            if platform != "tpu":
+                _log("tpu backend no longer initializes; "
+                     "remaining benches run on cpu")
+
+    # Retry pass: failed benches get another shot if budget remains — the
+    # tunnel can come back as transiently as it goes away; if it stays
+    # dead, fall back to CPU so every bench has *a* record (matching what
+    # a dead initial probe would have produced).
+    failed = [n for n in BENCH_ORDER if "error" in results[n]]
+    if failed and deadline - time.monotonic() > 120:
+        if platform != "tpu":
+            platform = probe_platform(max_tries=1, timeout=150.0)
+        for name in failed:
+            budget = min(per_bench, deadline - time.monotonic())
+            if budget < 60:
+                break
+            _log(f"{name}: retry on {platform}")
+            rec = _run_child(name, platform, budget)
+            if ("error" in rec and platform == "tpu"
+                    and "timeout" in str(rec.get("error", ""))):
+                platform = "cpu"  # died again; finish the pass on cpu
+                budget = min(per_bench, deadline - time.monotonic())
+                if budget >= 60:
+                    _log(f"{name}: retry on cpu")
+                    rec = _run_child(name, platform, budget)
+            if "error" not in rec:
+                results[name] = rec
 
     headline = results["resnet50_o2"]
     ok = "error" not in headline
+    headline_on_tpu = headline.get("platform") == "tpu"
     record = {
         "metric": "resnet50_o2_train_throughput",
         "value": headline.get("value", 0.0) if ok else 0.0,
         "unit": "images/sec/chip",
         "vs_baseline": (round(headline["value"] / APEX_A100_IMAGES_PER_SEC, 3)
-                        if ok and on_tpu else None),
-        "platform": platform,
+                        if ok and headline_on_tpu else None),
+        "platform": headline.get("platform", platform),
         "headline": headline,
         "extras": {k: v for k, v in results.items() if k != "resnet50_o2"},
     }
