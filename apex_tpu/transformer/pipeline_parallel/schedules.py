@@ -43,8 +43,13 @@ ticks), so the live-activation footprint is **O(m*vpp) boundary tensors +
 one tick's recomputed internals** — GPipe-with-remat behavior, smaller
 than storing full per-layer residuals but not 1F1B's O(pp) bound.  The
 trade buys SPMD-friendly homogeneous control flow (SURVEY.md §7 hard
-part (a)); nest an outer ``jax.checkpoint`` over tick groups if the O(pp)
-bound is required.
+part (a)).  When the 1F1B-class bound *is* required, pass
+``remat_ticks=G``: ticks are scanned in checkpointed groups of ``G``
+whose only saved residual is the one carried boundary activation per
+group — O(T/G) stored rows + O(G) recomputed per backward group, i.e.
+O(sqrt(T)) at ``G≈sqrt(T)`` or the 1F1B-flavored O(m/pp + pp*vpp) at the
+default ``G = pp*vpp`` — for one extra rotation-forward of recompute per
+step (the standard remat FLOP/memory trade).
 
 Schedule math (static, host-side): with ``period = pp*vpp``, microbatch ``j``
 enters at ``e_j = (j // pp) * period + (j % pp)``; its stream occupies slot
@@ -151,6 +156,7 @@ def pipeline_apply(
     axis: str = PIPELINE_AXIS,
     mesh: Optional[Mesh] = None,
     remat: bool = True,
+    remat_ticks: Optional[int] = None,
     params_already_local: bool = False,
     shard_microbatches: bool = False,
 ):
@@ -169,6 +175,12 @@ def pipeline_apply(
     ``params_already_local``: for calls from inside an enclosing
     ``shard_map`` that already bound ``axis`` — params are then the local
     ``[num_chunks, 1, ...]`` slices and no sharding wrapper is applied.
+
+    ``remat_ticks``: scan ticks in ``jax.checkpoint``-ed groups of this
+    size (``True`` picks ``pp*num_chunks``, one pipeline period).  The
+    backward then stores one boundary activation per *group* instead of
+    per tick — the 1F1B-class live-activation bound (module docstring) —
+    at the cost of one extra rotation-forward of recompute.
 
     ``shard_microbatches``: hold only ``m/pp`` microbatch rows per pp rank
     instead of replicating the full ``[m, ...]`` input and output buffers
@@ -240,8 +252,10 @@ def pipeline_apply(
                 x_mb,
             )
 
-        def tick(carry, t):
-            state, outbuf = carry
+        def rotate(state, t):
+            """One rotation tick: inject entries, apply the chunk, shift.
+            Returns ``(shifted_state, y)`` with ``y`` the pre-shift stage
+            output (the last stage's ``y`` is a microbatch exit)."""
             grp = t // period
             r = t % period
             j = jnp.clip(grp * pp + r, 0, m - 1)
@@ -252,6 +266,99 @@ def pipeline_apply(
             )
             c = jnp.clip(((t - s) // pp) % vpp, 0, vpp - 1)
             y = fn(chunk_params(c), x_in)
+            shifted = jax.tree_util.tree_map(
+                lambda l: lax.ppermute(
+                    l, axis, [(i, (i + 1) % pp) for i in range(pp)]
+                ),
+                y,
+            )
+            return shifted, y
+
+        def grouped_ticks():
+            """Two-level remat: scan ticks in ``jax.checkpoint``-ed groups
+            whose carry is the rotation state only.  Exit rows leave the
+            checkpointed region as scan *outputs* and are scattered into
+            the output buffer outside it, so the only residual stored per
+            group is one boundary activation — O(T/G) live rows (module
+            docstring) vs the flat scan's O(T)."""
+            G = period if remat_ticks is True else int(remat_ticks)
+            if G < 1:
+                raise ValueError(
+                    f"remat_ticks must be True or a positive group size, "
+                    f"got {remat_ticks!r} (use None/False to disable)")
+            ngroups = -(-total_ticks // G)
+            t_np = np.arange(ngroups * G)
+            u = t_np - (period - 1)
+            ug, ur = u // period, u % period
+            j_out_np = ug * pp + ur
+            valid_np = ((u >= 0) & (ur < pp) & (j_out_np < m)
+                        & (t_np < total_ticks))
+            j_out_np = np.where(valid_np, j_out_np, 0)
+
+            def group_body(state, tg):
+                def inner(st, t):
+                    st, y = rotate(st, t)
+                    if shard_microbatches:
+                        # deliver the exit row to all ranks (its owner
+                        # writes it below) — same per-tick traffic class
+                        # as the rotation ppermute.
+                        y = jax.tree_util.tree_map(
+                            lambda yl: lax.psum(
+                                jnp.where(s == pp - 1, yl,
+                                          jnp.zeros_like(yl)),
+                                axis),
+                            y,
+                        )
+                    return st, y
+
+                return lax.scan(inner, state, tg)
+
+            group_fn = jax.checkpoint(group_body)
+            nrows = mpp if shard_microbatches else m
+
+            def outer(carry, xs):
+                state, outbuf = carry
+                tg, j_idx, valid = xs
+                state, rows = group_fn(state, tg)  # rows: [G, ...] pytree
+                # Scatter: valid exits go to their row, everything else to
+                # the dump row ``nrows`` (never read) — no read-modify-
+                # write, so the buffer is not a residual of anything.
+                if shard_microbatches:
+                    own = valid & (j_idx // mpp == s)
+                    widx = jnp.where(own, j_idx - s * mpp, nrows)
+                else:
+                    widx = jnp.where(valid & (s == pp - 1), j_idx, nrows)
+                outbuf = jax.tree_util.tree_map(
+                    lambda buf, rl: buf.at[widx].set(rl), outbuf, rows
+                )
+                return (state, outbuf), None
+
+            carry0 = jax.tree_util.tree_map(
+                lambda l: jnp.zeros(l.shape[1:], l.dtype), x_mb
+            )
+            out0 = jax.tree_util.tree_map(
+                lambda l: jnp.zeros((nrows + 1,) + l.shape[1:], l.dtype),
+                x_mb,
+            )
+            xs = (
+                jnp.asarray(t_np).reshape(ngroups, G),
+                jnp.asarray(j_out_np).reshape(ngroups, G),
+                jnp.asarray(valid_np).reshape(ngroups, G),
+            )
+            (_, outs), _ = lax.scan(outer, (carry0, out0), xs)
+            outs = jax.tree_util.tree_map(lambda l: l[:nrows], outs)
+            if shard_microbatches:
+                return jax.tree_util.tree_map(
+                    lambda l: lax.all_gather(l, axis, axis=0, tiled=True),
+                    outs)
+            return jax.tree_util.tree_map(lambda l: lax.psum(l, axis), outs)
+
+        if remat_ticks is not None and remat_ticks is not False:
+            return grouped_ticks()
+
+        def tick(carry, t):
+            state, outbuf = carry
+            state, y = rotate(state, t)
             # Exit bookkeeping: tick t is microbatch j_out's last-stage exit
             # iff u = t-(period-1) is one of its entry ticks shifted by the
             # pipe depth.  Accumulate the row into the output buffer (O(1)
@@ -299,13 +406,7 @@ def pipeline_apply(
                     ),
                     outbuf, y,
                 )
-            shifted = jax.tree_util.tree_map(
-                lambda l: lax.ppermute(
-                    l, axis, [(i, (i + 1) % pp) for i in range(pp)]
-                ),
-                y,
-            )
-            return (shifted, outbuf), None
+            return (state, outbuf), None
 
         carry0 = jax.tree_util.tree_map(
             lambda l: jnp.zeros(l.shape[1:], l.dtype), x_mb
@@ -345,6 +446,11 @@ def pipeline_apply(
         ),
         out_specs=P(),
     )
+    if remat_ticks is not None and remat_ticks is not False:
+        # jax.checkpoint inside shard_map cannot evaluate eagerly
+        # ("closed_call inside shard_map"); a jit wrapper is a no-op when
+        # the caller already traces (the normal train-step case).
+        f = jax.jit(f)
     return f(params_cm, inputs)
 
 
@@ -392,11 +498,13 @@ def forward_backward_no_pipelining(
 
 
 def _pipelined_fwd_bwd(stage_fn, loss_fn, stage_params, inputs, targets, *,
-                       num_chunks, axis, mesh, loss_scale, remat):
+                       num_chunks, axis, mesh, loss_scale, remat,
+                       remat_ticks=None):
     def total_loss(params):
         outs = pipeline_apply(
             stage_fn, params, inputs,
             num_chunks=num_chunks, axis=axis, mesh=mesh, remat=remat,
+            remat_ticks=remat_ticks,
         )
         losses = jax.vmap(loss_fn)(outs, targets)
         total = jnp.sum(losses)
@@ -419,15 +527,19 @@ def forward_backward_pipelining_without_interleaving(
     mesh: Optional[Mesh] = None,
     loss_scale=None,
     remat: bool = True,
+    remat_ticks=None,
     **_unused,
 ):
     """1F1B-equivalent schedule
     (``fwd_bwd_pipelining_without_interleaving.py:241``); see module
     docstring.  Returns ``(losses[m], grads)`` with grads summed over
-    microbatches (the reference's ``main_grad`` accumulation)."""
+    microbatches (the reference's ``main_grad`` accumulation).
+    ``remat_ticks`` opts into the 1F1B-class activation bound
+    (grouped-tick remat, :func:`pipeline_apply`)."""
     return _pipelined_fwd_bwd(
         stage_fn, loss_fn, stage_params, inputs, targets,
         num_chunks=1, axis=axis, mesh=mesh, loss_scale=loss_scale, remat=remat,
+        remat_ticks=remat_ticks,
     )
 
 
@@ -443,6 +555,7 @@ def forward_backward_pipelining_with_interleaving(
     mesh: Optional[Mesh] = None,
     loss_scale=None,
     remat: bool = True,
+    remat_ticks=None,
     **_unused,
 ):
     """Interleaved virtual-pipeline schedule
@@ -460,7 +573,7 @@ def forward_backward_pipelining_with_interleaving(
     return _pipelined_fwd_bwd(
         stage_fn, loss_fn, stage_params, inputs, targets,
         num_chunks=num_chunks, axis=axis, mesh=mesh, loss_scale=loss_scale,
-        remat=remat,
+        remat=remat, remat_ticks=remat_ticks,
     )
 
 

@@ -437,7 +437,9 @@ def bench_tp_gpt(jax, on_tpu):
 
 def bench_fused_adam_step(jax, on_tpu):
     """Optimizer step-time microbench: FusedAdam over a resnet-sized tree
-    (the BASELINE "fused-optimizer step time" metric)."""
+    vs the native-JAX baseline (optax.adamw) — the BASELINE
+    "fused-optimizer step time <= native" metric (``vs_native`` < 1 means
+    ours is faster)."""
     import jax.numpy as jnp
 
     from apex_tpu.optimizers import FusedAdam
@@ -445,26 +447,53 @@ def bench_fused_adam_step(jax, on_tpu):
     n_tensors = 161  # RN50-ish tree
     size = 160_000 if on_tpu else 1_000
     keys = [f"w{i}" for i in range(n_tensors)]
-    params = {k: jnp.ones((size,), jnp.float32) * 0.01 for k in keys}
     grads = {k: jnp.full((size,), 1e-4, jnp.float32) for k in keys}
+    steps = 50 if on_tpu else 5
+
+    def fresh_params():
+        # per-run trees: the jitted steps donate params/state, so each
+        # optimizer needs its own buffers
+        return {k: jnp.ones((size,), jnp.float32) * 0.01 for k in keys}
+
+    def timed(step, init):
+        params = fresh_params()
+        state = init(params)
+        params, state = step(grads, state, params)  # compile
+        jax.block_until_ready((params, state))
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            params, state = step(grads, state, params)
+        jax.block_until_ready((params, state))
+        return (time.perf_counter() - t0) / steps
+
     opt = FusedAdam(lr=1e-3, weight_decay=1e-2, adam_w_mode=True)
-    state = opt.init(params)
 
     @partial(jax.jit, donate_argnums=(1, 2))
-    def step(grads, state, params):
+    def fused_step(grads, state, params):
         return opt.step(grads, state, params)
 
-    params, state = step(grads, state, params)  # compile (returns new trees)
-    jax.block_until_ready((params, state))
-    steps = 50 if on_tpu else 5
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        params, state = step(grads, state, params)
-    jax.block_until_ready((params, state))
-    dt = time.perf_counter() - t0
+    dt = timed(fused_step, opt.init)
+
+    dt_native = None
+    try:
+        import optax
+
+        native = optax.adamw(1e-3, weight_decay=1e-2)
+
+        @partial(jax.jit, donate_argnums=(1, 2))
+        def native_step(grads, state, params):
+            updates, state = native.update(grads, state, params)
+            return optax.apply_updates(params, updates), state
+
+        dt_native = timed(native_step, native.init)
+    except ImportError:
+        pass
+
     return {
-        "value": round(dt / steps * 1e6, 1),
+        "value": round(dt * 1e6, 1),
         "unit": "us/step",
+        "native_optax_us": round(dt_native * 1e6, 1) if dt_native else None,
+        "vs_native": round(dt / dt_native, 3) if dt_native else None,
         "n_tensors": n_tensors,
         "n_elements": n_tensors * size,
     }

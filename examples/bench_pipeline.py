@@ -96,6 +96,16 @@ def main():
     t_pipe = timeit(piped, stacked, mbs)
     t_serial = timeit(serial, stacked, x)
 
+    def temp_mb(remat_ticks):
+        def fb(params, mbs):
+            def loss(params):
+                out = pipeline_apply(stage_fn, params, mbs, num_chunks=vpp,
+                                     mesh=mesh, remat_ticks=remat_ticks)
+                return jnp.sum(out ** 2)
+            return jax.grad(loss)(params)
+        ma = jax.jit(fb).lower(stacked, mbs).compile().memory_analysis()
+        return round(ma.temp_size_in_bytes / 1e6, 2)
+
     bubble = pipeline_bubble_fraction(m, pp, vpp)
     record = {
         "pp": pp, "vpp": vpp, "m": m, "width": width,
@@ -104,6 +114,8 @@ def main():
         "analytic_bubble": round(bubble, 4),
         "ideal_speedup_vs_1dev": round(pp * (1 - bubble), 3),
         "measured_speedup_vs_1dev": round(t_serial / t_pipe, 3),
+        "temp_mem_mb_flat": temp_mb(None),
+        "temp_mem_mb_grouped_remat": temp_mb(True),
         "platform": jax.devices()[0].platform,
         "note": ("wall-clock meaningless on virtual CPU devices"
                  if jax.devices()[0].platform == "cpu" else ""),

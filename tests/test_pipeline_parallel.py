@@ -232,3 +232,77 @@ def test_split_into_microbatches():
     assert mbs["x"].shape == (4, 3, 2)
     with pytest.raises(ValueError):
         pp_lib.split_into_microbatches(batch, 5)
+
+
+@pytest.mark.parametrize("pp,vpp,m,g", [(4, 1, 8, True), (2, 2, 6, 3),
+                                        (4, 2, 8, True), (4, 1, 4, 5)])
+def test_grouped_remat_matches_flat(pp, vpp, m, g):
+    """remat_ticks (two-level checkpointed tick groups, incl. a group size
+    that does not divide the tick count) must be numerically identical to
+    the flat scan, forward and backward."""
+    parallel.initialize_model_parallel(pipeline_model_parallel_size=pp)
+    n_virtual = pp * vpp
+    stacked, per_stage = make_stage_params(jax.random.PRNGKey(0), n_virtual)
+    x = jax.random.normal(jax.random.PRNGKey(1), (m, MB, HID))
+    tgt = jax.random.normal(jax.random.PRNGKey(2), (m, MB, HID))
+
+    outs_flat = pp_lib.pipeline_apply(stage_fn, stacked, x, num_chunks=vpp)
+    outs_grp = pp_lib.pipeline_apply(stage_fn, stacked, x, num_chunks=vpp,
+                                     remat_ticks=g)
+    np.testing.assert_allclose(np.asarray(outs_grp), np.asarray(outs_flat),
+                               rtol=1e-6, atol=1e-6)
+
+    def run(remat_ticks):
+        if vpp > 1:
+            return pp_lib.forward_backward_pipelining_with_interleaving(
+                stage_fn, loss_fn, stacked, x, tgt, num_chunks=vpp,
+                remat_ticks=remat_ticks)
+        return pp_lib.forward_backward_pipelining_without_interleaving(
+            stage_fn, loss_fn, stacked, x, tgt, remat_ticks=remat_ticks)
+
+    losses_flat, grads_flat = run(None)
+    losses_grp, grads_grp = run(g)
+    np.testing.assert_allclose(np.asarray(losses_grp),
+                               np.asarray(losses_flat),
+                               rtol=1e-6, atol=1e-6)
+    for name in ("w1", "b1", "w2"):
+        np.testing.assert_allclose(
+            np.asarray(grads_grp[name]), np.asarray(grads_flat[name]),
+            rtol=1e-5, atol=1e-5,
+        )
+
+
+@pytest.mark.parametrize("pp,m", [(4, 8)])
+def test_grouped_remat_with_sharded_microbatches(pp, m):
+    """remat_ticks composes with shard_microbatches (1/pp input/output
+    buffers AND O(T/G) boundary residuals) — forward *and* backward: the
+    owner-masked exit psum lives inside the checkpointed group, so its
+    transpose is replayed during group recompute."""
+    parallel.initialize_model_parallel(pipeline_model_parallel_size=pp)
+    stacked, per_stage = make_stage_params(jax.random.PRNGKey(0), pp)
+    x = jax.random.normal(jax.random.PRNGKey(1), (m, MB, HID))
+    tgt = jax.random.normal(jax.random.PRNGKey(2), (m, MB, HID))
+    ref_outs, ref_grads = sequential_reference(per_stage, x, tgt)
+
+    def total_loss(params, remat_ticks):
+        outs = pp_lib.pipeline_apply(stage_fn, params, x,
+                                     remat_ticks=remat_ticks,
+                                     shard_microbatches=True)
+        return jnp.sum((outs - tgt) ** 2), outs
+
+    @jax.jit
+    def run(params):
+        grads, outs = jax.grad(lambda p: total_loss(p, True),
+                               has_aux=True)(params)
+        return grads, outs
+
+    grads, outs = run(stacked)
+    np.testing.assert_allclose(np.asarray(outs), np.asarray(ref_outs),
+                               rtol=1e-5, atol=1e-5)
+    ref_stacked = pp_lib.stack_stage_params(
+        [ref_grads[v] for v in range(pp)])
+    for name in ("w1", "b1", "w2"):
+        np.testing.assert_allclose(
+            np.asarray(grads[name]), np.asarray(ref_stacked[name]),
+            rtol=1e-4, atol=1e-4,
+        )
