@@ -72,6 +72,7 @@ head therefore live *outside* the pipelined region (computed replicated over
 from __future__ import annotations
 
 import functools
+import operator
 from typing import Any, Callable, Optional, Sequence
 
 import jax
@@ -95,6 +96,17 @@ __all__ = [
 
 StageFn = Callable[[Any, Any], Any]   # (stage_params, activation) -> activation
 LossFn = Callable[[Any, Any], jnp.ndarray]  # (output, target) -> scalar
+
+# Jitted grouped-remat pipelines, memoized so repeated *eager* calls of
+# pipeline_apply(remat_ticks=...) don't recompile (see pipeline_apply tail).
+_GROUPED_JIT_CACHE: dict = {}
+_GROUPED_JIT_CACHE_MAX = 32
+
+
+def _abstract_key(tree):
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    return (treedef,
+            tuple((l.shape, jnp.result_type(l).name) for l in leaves))
 
 
 def split_into_microbatches(batch, num_microbatches: int):
@@ -129,6 +141,26 @@ def _entry_ticks(m: int, pp: int, vpp: int) -> np.ndarray:
     period = pp * vpp
     j = np.arange(m)
     return (j // pp) * period + (j % pp)
+
+
+def _exit_schedule(total_ticks: int, period: int, pp: int, m: int,
+                   pad_to: Optional[int] = None):
+    """Static per-tick exit metadata ``(j_out, valid)``.
+
+    Tick ``t`` is microbatch ``j_out``'s last-virtual-stage exit iff
+    ``u = t - (period-1)`` is an entry tick shifted by the pipe depth:
+    ``j_out = (u // period) * pp + (u % period)`` with ``u % period < pp``.
+    The one copy of this formula — both the flat scan and the grouped-remat
+    scan consume these arrays as ``xs``.  Ticks past ``total_ticks`` (group
+    padding) are invalid; invalid entries have ``j_out`` forced to 0.
+    """
+    n = total_ticks if pad_to is None else pad_to
+    t = np.arange(n)
+    u = t - (period - 1)
+    ug, ur = u // period, u % period
+    j_out = ug * pp + ur
+    valid = (u >= 0) & (ur < pp) & (j_out < m) & (t < total_ticks)
+    return np.where(valid, j_out, 0), valid
 
 
 def pipeline_bubble_fraction(m: int, pp: int, vpp: int = 1) -> float:
@@ -199,6 +231,19 @@ def pipeline_apply(
     pp = (lax.axis_size(axis) if params_already_local else mesh.shape[axis])
     vpp = num_chunks
     period = pp * vpp
+
+    # Normalize remat_ticks once: None/False -> off, True -> one period,
+    # else an exact positive integer group size.
+    if remat_ticks is None or remat_ticks is False:
+        group_size = None
+    elif remat_ticks is True:
+        group_size = period
+    else:
+        group_size = operator.index(remat_ticks)
+        if group_size < 1:
+            raise ValueError(
+                f"remat_ticks must be True or a positive group size, got "
+                f"{remat_ticks!r} (use None/False to disable)")
 
     leaves = jax.tree_util.tree_leaves(inputs)
     if not leaves:
@@ -281,19 +326,11 @@ def pipeline_apply(
             the output buffer outside it, so the only residual stored per
             group is one boundary activation — O(T/G) live rows (module
             docstring) vs the flat scan's O(T)."""
-            G = period if remat_ticks is True else int(remat_ticks)
-            if G < 1:
-                raise ValueError(
-                    f"remat_ticks must be True or a positive group size, "
-                    f"got {remat_ticks!r} (use None/False to disable)")
+            G = group_size
             ngroups = -(-total_ticks // G)
+            j_out_np, valid_np = _exit_schedule(total_ticks, period, pp, m,
+                                                pad_to=ngroups * G)
             t_np = np.arange(ngroups * G)
-            u = t_np - (period - 1)
-            ug, ur = u // period, u % period
-            j_out_np = ug * pp + ur
-            valid_np = ((u >= 0) & (ur < pp) & (j_out_np < m)
-                        & (t_np < total_ticks))
-            j_out_np = np.where(valid_np, j_out_np, 0)
 
             def group_body(state, tg):
                 def inner(st, t):
@@ -353,21 +390,16 @@ def pipeline_apply(
                     outs)
             return jax.tree_util.tree_map(lambda l: lax.psum(l, axis), outs)
 
-        if remat_ticks is not None and remat_ticks is not False:
+        if group_size is not None:
             return grouped_ticks()
 
-        def tick(carry, t):
+        def tick(carry, xs):
+            t, j_outc, exit_valid = xs  # from _exit_schedule
             state, outbuf = carry
             state, y = rotate(state, t)
-            # Exit bookkeeping: tick t is microbatch j_out's last-stage exit
-            # iff u = t-(period-1) is one of its entry ticks shifted by the
-            # pipe depth.  Accumulate the row into the output buffer (O(1)
-            # rows touched per tick) instead of stacking all T tick outputs.
-            u = t - (period - 1)
-            ug, ur = u // period, u % period
-            j_out = ug * pp + ur
-            exit_valid = (u >= 0) & (ur < pp) & (j_out < m)
-            j_outc = jnp.clip(j_out, 0, m - 1)
+            # Exit bookkeeping: accumulate the exiting row into the output
+            # buffer (O(1) rows touched per tick) instead of stacking all
+            # T tick outputs.
             if shard_microbatches:
                 # deliver the last stage's row to its owner rank: one-row
                 # psum broadcast (same O(row) per-tick traffic class as the
@@ -412,8 +444,11 @@ def pipeline_apply(
             lambda l: jnp.zeros(l.shape[1:], l.dtype), x_mb
         )
         out0 = jax.tree_util.tree_map(jnp.zeros_like, x_mb)
-        (_, outs), _ = lax.scan(tick, (carry0, out0),
-                                jnp.arange(total_ticks))
+        j_out_np, valid_np = _exit_schedule(total_ticks, period, pp, m)
+        (_, outs), _ = lax.scan(
+            tick, (carry0, out0),
+            (jnp.arange(total_ticks), jnp.asarray(j_out_np),
+             jnp.asarray(valid_np)))
         if shard_microbatches:
             # each rank holds its own m/pp rows; materialize the full [m,..]
             # outputs once (tiled all_gather) to keep the return contract.
@@ -437,21 +472,33 @@ def pipeline_apply(
     from apex_tpu.parallel.collectives import shard_over
 
     in_spec_x = P(axis) if shard_microbatches else P()
-    f = shard_over(
-        local_pipeline,
-        mesh=mesh,
-        in_specs=(
-            jax.tree_util.tree_map(lambda _: P(None, axis), params_cm),
-            jax.tree_util.tree_map(lambda _: in_spec_x, inputs),
-        ),
-        out_specs=P(),
-    )
-    if remat_ticks is not None and remat_ticks is not False:
-        # jax.checkpoint inside shard_map cannot evaluate eagerly
-        # ("closed_call inside shard_map"); a jit wrapper is a no-op when
-        # the caller already traces (the normal train-step case).
-        f = jax.jit(f)
-    return f(params_cm, inputs)
+
+    def build():
+        return shard_over(
+            local_pipeline,
+            mesh=mesh,
+            in_specs=(
+                jax.tree_util.tree_map(lambda _: P(None, axis), params_cm),
+                jax.tree_util.tree_map(lambda _: in_spec_x, inputs),
+            ),
+            out_specs=P(),
+        )
+
+    if group_size is None:
+        return build()(params_cm, inputs)
+    # jax.checkpoint inside shard_map cannot evaluate eagerly ("closed_call
+    # inside shard_map"), so the grouped path needs a jit wrapper.  Wrapping
+    # a fresh closure per call would defeat jit's cache, so memoize the
+    # jitted program on everything its trace depends on.
+    key = (stage_fn, mesh, axis, vpp, remat, group_size, shard_microbatches,
+           _abstract_key(params_cm), _abstract_key(inputs))
+    jitted = _GROUPED_JIT_CACHE.get(key)
+    if jitted is None:
+        if len(_GROUPED_JIT_CACHE) >= _GROUPED_JIT_CACHE_MAX:
+            _GROUPED_JIT_CACHE.pop(next(iter(_GROUPED_JIT_CACHE)))
+        jitted = jax.jit(build())
+        _GROUPED_JIT_CACHE[key] = jitted
+    return jitted(params_cm, inputs)
 
 
 def forward_backward_no_pipelining(

@@ -72,6 +72,7 @@ def build_gpt_3d(
     pp_axis: str = PIPELINE_AXIS,
     tp_axis: str = TENSOR_AXIS,
     moe_aux_coeff: float = 1e-2,
+    remat_ticks=None,
 ):
     """Return ``(init_fn, train_step, param_specs_fn)``.
 
@@ -84,6 +85,10 @@ def build_gpt_3d(
 
     ``config.num_layers`` must equal ``pp * num_chunks`` (one transformer
     layer per virtual stage); ``tokens: [global_batch, seq]`` sharded on dp.
+
+    ``remat_ticks``: forward to :func:`pipeline_apply` for the 1F1B-class
+    live-activation bound (grouped-tick remat); the train step must run
+    under ``jax.jit`` (it should anyway).
     """
     cfg = config
     if mesh is None:
@@ -172,7 +177,7 @@ def build_gpt_3d(
 
         out, aux_out = pipeline_apply(
             stage_fn, p.layers, (h, aux0), axis=pp_axis, num_chunks=vpp,
-            params_already_local=True,
+            params_already_local=True, remat_ticks=remat_ticks,
         )
 
         def head_one(hid, t):

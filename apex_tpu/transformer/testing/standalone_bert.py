@@ -82,8 +82,16 @@ class BertModel(nn.Module):
     def __call__(self, input_ids, attention_mask, position_ids=None,
                  deterministic: bool = True):
         ext_mask = bert_extended_attention_mask(attention_mask)
+        # Under flash attention the [b,s] padding mask is expressed as
+        # segment ids (real tokens id 0, padding id 1): attention is kept
+        # only where both sides share an id — exactly ``ext_mask``'s
+        # both-real semantics (padding rows attend only padding; their
+        # outputs are ignored by the masked LM loss, as in the reference).
+        seg = ((1 - attention_mask).astype(jnp.int32)
+               if self.config.use_flash_attention else None)
         out = self.language_model(input_ids, position_ids, ext_mask,
-                                  deterministic=deterministic)
+                                  deterministic=deterministic,
+                                  segment_ids=seg)
         hidden, pooled = out if self.add_binary_head else (out, None)
         lm_logits = self.lm_head(
             hidden, self.language_model.embedding.word_embeddings

@@ -175,14 +175,23 @@ class CoreAttention(nn.Module):
     attn_mask_type: AttnMaskType = AttnMaskType.padding
 
     @nn.compact
-    def __call__(self, q, k, v, mask, deterministic: bool = True):
+    def __call__(self, q, k, v, mask, deterministic: bool = True,
+                 segment_ids=None):
         cfg = self.config
         # q/k/v: [s, b, n_local, d]
         sq, b, n, d = q.shape
         sk = k.shape[0]
 
-        if (cfg.use_flash_attention
-                and self.attn_mask_type == AttnMaskType.causal):
+        # Flash handles the causal mask natively and *padding* masks via
+        # segment ids ([b, s] ints: real tokens share an id, padding gets a
+        # different one — the both-sides-real semantics of
+        # ``bert_extended_attention_mask``); an arbitrary [b,1,sq,sk] mask
+        # has no flash form and falls through to the fused-softmax path.
+        use_flash = cfg.use_flash_attention and (
+            self.attn_mask_type == AttnMaskType.causal
+            or (self.attn_mask_type == AttnMaskType.padding
+                and segment_ids is not None))
+        if use_flash:
             from apex_tpu.ops.flash_attention import flash_attention
             if cfg.attention_dropout > 0.0 and not deterministic:
                 # In-kernel counter-based dropout: derive a per-call scalar
@@ -195,9 +204,13 @@ class CoreAttention(nn.Module):
                             dropout_seed=seed)
             else:
                 drop = {}
+            if segment_ids is not None:
+                drop.update(segment_ids_q=segment_ids,
+                            segment_ids_kv=segment_ids)
             ctx = flash_attention(
                 q.transpose(1, 2, 0, 3), k.transpose(1, 2, 0, 3),
-                v.transpose(1, 2, 0, 3), causal=True, **drop,
+                v.transpose(1, 2, 0, 3),
+                causal=self.attn_mask_type == AttnMaskType.causal, **drop,
             )  # [b, n, sq, d]
             return ctx.transpose(2, 0, 1, 3).reshape(sq, b, n * d)
 
@@ -255,7 +268,8 @@ class ParallelAttention(nn.Module):
     attn_mask_type: AttnMaskType = AttnMaskType.padding
 
     @nn.compact
-    def __call__(self, x, mask, encoder_output=None, deterministic=True):
+    def __call__(self, x, mask, encoder_output=None, deterministic=True,
+                 segment_ids=None):
         cfg = self.config
         world = bound_axis_size(cfg.tensor_axis)
         n_local = divide(cfg.num_attention_heads, world)
@@ -296,7 +310,8 @@ class ParallelAttention(nn.Module):
         ctx = CoreAttention(
             cfg, layer_number=self.layer_number,
             attn_mask_type=self.attn_mask_type, name="core_attention",
-        )(q, k, v, mask, deterministic=deterministic)
+        )(q, k, v, mask, deterministic=deterministic,
+          segment_ids=segment_ids)
 
         out, bias = RowParallelLinear(
             proj, cfg.hidden_size,
@@ -324,14 +339,14 @@ class ParallelTransformerLayer(nn.Module):
 
     @nn.compact
     def __call__(self, x, mask, encoder_output=None, enc_dec_mask=None,
-                 deterministic: bool = True):
+                 deterministic: bool = True, segment_ids=None):
         cfg = self.config
         ln1 = FusedLayerNorm(cfg.hidden_size, eps=cfg.layernorm_epsilon,
                              name="input_layernorm")(x)
         attn_out, attn_bias = ParallelAttention(
             cfg, layer_number=self.layer_number,
             attn_mask_type=self.self_attn_mask_type, name="self_attention",
-        )(ln1, mask, deterministic=deterministic)
+        )(ln1, mask, deterministic=deterministic, segment_ids=segment_ids)
         residual = ln1 if cfg.apply_residual_connection_post_layernorm else x
         h = residual + nn.Dropout(rate=cfg.hidden_dropout)(
             attn_out + attn_bias, deterministic=deterministic
@@ -392,14 +407,16 @@ class ParallelTransformer(nn.Module):
     post_process: bool = True
 
     @nn.compact
-    def __call__(self, x, mask, deterministic: bool = True):
+    def __call__(self, x, mask, deterministic: bool = True,
+                 segment_ids=None):
         cfg = self.config
         for i in range(cfg.num_layers):
             x = ParallelTransformerLayer(
                 cfg, layer_number=i + 1,
                 self_attn_mask_type=self.self_attn_mask_type,
                 name=f"layers_{i}",
-            )(x, mask, deterministic=deterministic)
+            )(x, mask, deterministic=deterministic,
+              segment_ids=segment_ids)
         if self.post_process:
             x = FusedLayerNorm(cfg.hidden_size, eps=cfg.layernorm_epsilon,
                                name="final_layernorm")(x)
@@ -511,10 +528,12 @@ class TransformerLanguageModel(nn.Module):
             self.pooler = Pooler(cfg)
 
     def __call__(self, token_ids, position_ids=None, attention_mask=None,
-                 deterministic: bool = True, pooling_sequence_index: int = 0):
+                 deterministic: bool = True, pooling_sequence_index: int = 0,
+                 segment_ids=None):
         x = self.embedding(token_ids, position_ids,
                            deterministic=deterministic)
-        hidden = self.encoder(x, attention_mask, deterministic=deterministic)
+        hidden = self.encoder(x, attention_mask, deterministic=deterministic,
+                              segment_ids=segment_ids)
         if self.add_pooler:
             pooled = self.pooler(hidden, pooling_sequence_index)
             return hidden, pooled

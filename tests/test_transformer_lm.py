@@ -232,3 +232,31 @@ def test_reference_import_paths():
         infer_param_specs,
     )
     from apex_tpu.transformer.amp import GradScaler  # noqa: F401
+
+
+def test_bert_flash_padding_matches_fused_softmax():
+    """BERT's padding mask expressed as flash segment ids must reproduce
+    the fused-softmax path's logits at every real (non-pad) position.
+    Pad positions legitimately differ (fully-masked rows: the fused
+    softmax yields a uniform mix, flash yields a pad-only mix; both are
+    ignored downstream), so the comparison masks them out."""
+    cfg = small_cfg(apply_query_key_layer_scaling=False)
+    cfg_flash = small_cfg(apply_query_key_layer_scaling=False,
+                          use_flash_attention=True)
+    tokens = lm_batch(jax.random.PRNGKey(9))
+    mask = jnp.ones((BATCH, SEQ), jnp.int32).at[:2, -5:].set(0)
+
+    model = BertModel(cfg)
+    params = model.init(jax.random.PRNGKey(10), tokens, mask)["params"]
+    ref_lm, ref_bin = model.apply({"params": params}, tokens, mask)
+    flash_lm, flash_bin = BertModel(cfg_flash).apply(
+        {"params": params}, tokens, mask)
+
+    real = np.asarray(mask, bool).T[:, :, None]  # [s, b, 1]
+    np.testing.assert_allclose(
+        np.asarray(flash_lm) * real, np.asarray(ref_lm) * real,
+        rtol=2e-5, atol=2e-5,
+    )
+    # pooled/binary head reads sequence position 0 (always real here)
+    np.testing.assert_allclose(np.asarray(flash_bin), np.asarray(ref_bin),
+                               rtol=2e-5, atol=2e-5)
