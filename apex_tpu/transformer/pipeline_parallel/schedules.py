@@ -98,7 +98,11 @@ StageFn = Callable[[Any, Any], Any]   # (stage_params, activation) -> activation
 LossFn = Callable[[Any, Any], jnp.ndarray]  # (output, target) -> scalar
 
 # Jitted grouped-remat pipelines, memoized so repeated *eager* calls of
-# pipeline_apply(remat_ticks=...) don't recompile (see pipeline_apply tail).
+# pipeline_apply(remat_ticks=...) don't recompile (see pipeline_apply
+# tail).  Keyed on stage_fn *identity* — deliberately conservative (keying
+# on code would alias closures over different captured models); callers
+# wanting cache hits must pass a stable stage_fn object, not a fresh
+# lambda per call.  LRU: hits move to the back, eviction pops the front.
 _GROUPED_JIT_CACHE: dict = {}
 _GROUPED_JIT_CACHE_MAX = 32
 
@@ -492,12 +496,12 @@ def pipeline_apply(
     # jitted program on everything its trace depends on.
     key = (stage_fn, mesh, axis, vpp, remat, group_size, shard_microbatches,
            _abstract_key(params_cm), _abstract_key(inputs))
-    jitted = _GROUPED_JIT_CACHE.get(key)
+    jitted = _GROUPED_JIT_CACHE.pop(key, None)  # pop+reinsert = LRU order
     if jitted is None:
         if len(_GROUPED_JIT_CACHE) >= _GROUPED_JIT_CACHE_MAX:
             _GROUPED_JIT_CACHE.pop(next(iter(_GROUPED_JIT_CACHE)))
         jitted = jax.jit(build())
-        _GROUPED_JIT_CACHE[key] = jitted
+    _GROUPED_JIT_CACHE[key] = jitted
     return jitted(params_cm, inputs)
 
 

@@ -97,6 +97,22 @@ class TransformerConfig:
 
     sequence_parallel: bool = False
     tensor_axis: Optional[str] = TENSOR_AXIS  # None = no tensor parallelism
+    # Context parallelism (ring attention over a cp mesh axis): activations
+    # carry the LOCAL sequence shard [s/cp, b, h]; the causal core runs
+    # :func:`apex_tpu.transformer.context_parallel.ring_attention`.  Run the
+    # model inside shard_map with this axis bound (gpt_cp_train.py is the
+    # worked harness).  Mutually exclusive with sequence_parallel
+    # (validated in __post_init__); causal attention only (enforced in
+    # CoreAttention).
+    context_axis: Optional[str] = None
+
+    def __post_init__(self):
+        if self.context_axis is not None and self.sequence_parallel:
+            raise ValueError(
+                "context_axis and sequence_parallel are mutually exclusive:"
+                " both reinterpret the sequence dimension as sharded (over"
+                " cp and tp respectively) and composing them would compute"
+                " attention over a misread shard layout")
 
     # Mixture-of-experts (parity-plus: the reference stubs SwitchMLP out,
     # standalone_transformer_lm.py:675; see apex_tpu/transformer/moe.py).
@@ -181,6 +197,33 @@ class CoreAttention(nn.Module):
         # q/k/v: [s, b, n_local, d]
         sq, b, n, d = q.shape
         sk = k.shape[0]
+
+        if (cfg.context_axis is not None
+                and self.attn_mask_type != AttnMaskType.causal):
+            # Falling through to the fused-softmax path would silently
+            # attend within the local [s/cp] shard only.
+            raise NotImplementedError(
+                "context_axis supports causal self-attention only; "
+                "non-causal attention over a cp-sharded sequence needs "
+                "ulysses_attention (context_parallel.py) wired explicitly")
+        if (cfg.context_axis is not None
+                and self.attn_mask_type == AttnMaskType.causal):
+            # Context parallelism: q/k/v hold this rank's sequence shard;
+            # ring attention rotates K/V chunks over the cp axis (global
+            # causal offsets handled inside).  In-kernel dropout is not
+            # plumbed through the ring VJP; reject rather than silently
+            # skip it.
+            if cfg.attention_dropout > 0.0 and not deterministic:
+                raise NotImplementedError(
+                    "attention_dropout under context parallelism is not "
+                    "supported (ring attention re-drives the flash kernels "
+                    "per chunk; set attention_dropout=0.0)")
+            from apex_tpu.transformer.context_parallel import ring_attention
+            ctx = ring_attention(
+                q.transpose(1, 2, 0, 3), k.transpose(1, 2, 0, 3),
+                v.transpose(1, 2, 0, 3), axis=cfg.context_axis, causal=True,
+            )  # [b, n, sq_local, d]
+            return ctx.transpose(2, 0, 1, 3).reshape(sq, b, n * d)
 
         # Flash handles the causal mask natively and *padding* masks via
         # segment ids ([b, s] ints: real tokens share an id, padding gets a
