@@ -68,18 +68,24 @@ def initialize(
     half_dtype=jnp.bfloat16,
     *,
     loss_scale: Union[str, float, None] = None,
+    num_losses: int = 1,
     **policy_overrides,
 ):
     """Build amp config+state from an opt level.
 
     Mirrors ``amp.initialize`` keyword semantics
     (``apex/amp/frontend.py:197-264``): ``loss_scale`` overrides the preset
-    ("dynamic" or a float); other :class:`Policy` fields can be overridden by
-    keyword.  Returns ``(AmpConfig, AmpState)``; if ``params`` is given and
-    the policy uses master weights, ``AmpState.master`` holds fp32 masters
-    and the caller should derive model params via
-    :func:`apex_tpu.amp.master_to_model`.
+    ("dynamic" or a float); ``num_losses > 1`` gives each loss its own
+    scaler state (the reference's per-loss ``LossScaler`` list,
+    ``_initialize.py:229-233``) — ``AmpState.scaler`` is then a tuple,
+    index it per loss for ``scale_loss``/``update``; other :class:`Policy`
+    fields can be overridden by keyword.  Returns ``(AmpConfig,
+    AmpState)``; if ``params`` is given and the policy uses master weights,
+    ``AmpState.master`` holds fp32 masters and the caller should derive
+    model params via :func:`apex_tpu.amp.master_to_model`.
     """
+    if num_losses < 1:
+        raise ValueError(f"num_losses must be >= 1, got {num_losses}")
     pol = make_policy(opt_level, half_dtype)
     if loss_scale is not None:
         pol = pol.with_options(loss_scale=loss_scale)
@@ -97,29 +103,53 @@ def initialize(
     if params is not None and pol.master_weights:
         master = make_master(pol.cast_to_param(params))
 
+    scaler_state = (scaler_algo.init() if num_losses == 1
+                    else tuple(scaler_algo.init()
+                               for _ in range(num_losses)))
     return AmpConfig(policy=pol, loss_scaler=scaler_algo), AmpState(
-        scaler=scaler_algo.init(), master=master
+        scaler=scaler_state, master=master
     )
 
 
-def state_dict(state: AmpState) -> dict:
-    """Checkpointable scaler state (``amp.state_dict``,
-    ``apex/amp/frontend.py:365-375``)."""
+def _one_state_dict(s: LossScaleState) -> dict:
     return {
-        "loss_scale": state.scaler.scale,
-        "growth_tracker": state.scaler.growth_tracker,
-        "hysteresis_tracker": state.scaler.hysteresis_tracker,
-        "found_inf": state.scaler.found_inf,
+        "loss_scale": s.scale,
+        "growth_tracker": s.growth_tracker,
+        "hysteresis_tracker": s.hysteresis_tracker,
+        "found_inf": s.found_inf,
     }
 
 
-def load_state_dict(state: AmpState, sd: dict) -> AmpState:
-    """Restore scaler state (``amp.load_state_dict``,
-    ``apex/amp/frontend.py:377-404``)."""
-    scaler = LossScaleState(
+def _one_load(sd: dict) -> LossScaleState:
+    return LossScaleState(
         scale=jnp.float32(sd["loss_scale"]),
         growth_tracker=jnp.int32(sd["growth_tracker"]),
         hysteresis_tracker=jnp.int32(sd["hysteresis_tracker"]),
         found_inf=jnp.asarray(sd["found_inf"]),
     )
-    return state._replace(scaler=scaler)
+
+
+def state_dict(state: AmpState):
+    """Checkpointable scaler state (``amp.state_dict``,
+    ``apex/amp/frontend.py:365-375``); a list of dicts when
+    ``num_losses > 1`` (the reference serializes its scaler list the same
+    way)."""
+    if not isinstance(state.scaler, LossScaleState):  # per-loss tuple
+        return [_one_state_dict(s) for s in state.scaler]
+    return _one_state_dict(state.scaler)
+
+
+def load_state_dict(state: AmpState, sd) -> AmpState:
+    """Restore scaler state (``amp.load_state_dict``,
+    ``apex/amp/frontend.py:377-404``)."""
+    if not isinstance(state.scaler, LossScaleState):  # per-loss tuple
+        if not isinstance(sd, (list, tuple)) or len(sd) != len(state.scaler):
+            raise ValueError(
+                f"state_dict has {len(sd) if isinstance(sd, (list, tuple)) else 1} "
+                f"scaler entries, state expects {len(state.scaler)}")
+        return state._replace(scaler=tuple(_one_load(d) for d in sd))
+    if isinstance(sd, (list, tuple)):
+        raise ValueError(
+            f"state_dict has {len(sd)} scaler entries (saved with "
+            f"num_losses>1), state expects a single scaler")
+    return state._replace(scaler=_one_load(sd))

@@ -189,13 +189,19 @@ ring_attention.defvjp(_ring_vjp_fwd, _ring_vjp_bwd)
 
 
 def ulysses_attention(q, k, v, axis: str = CONTEXT_AXIS,
-                      causal: bool = True, scale: Optional[float] = None):
+                      causal: bool = True, scale: Optional[float] = None,
+                      dropout_rate: float = 0.0, dropout_seed=None):
     """All-to-all (DeepSpeed-Ulysses) sequence-parallel attention.
 
     Local shards ``[b, h, s_local, d]`` with ``h % cp == 0``: a2a to
     ``[b, h/cp, s_global, d]``, full-sequence flash attention, a2a back.
     One a2a pair per call versus ring's ``cp`` neighbor hops — better when
     ``h >= cp`` and the sequence fits a single rank's VMEM streaming.
+
+    Attention dropout works here (unlike ring attention): after the a2a
+    each rank runs ordinary full-sequence flash with in-kernel dropout;
+    the rank index is folded into the seed so different head groups draw
+    different masks.
     """
     cp = lax.axis_size(axis)
     if q.shape[1] % cp != 0:
@@ -211,6 +217,13 @@ def ulysses_attention(q, k, v, axis: str = CONTEXT_AXIS,
         return lax.all_to_all(x, axis, split_axis=2, concat_axis=1,
                               tiled=True)
 
+    drop = {}
+    if dropout_rate > 0.0:
+        drop = dict(
+            dropout_rate=dropout_rate,
+            dropout_seed=jnp.asarray(dropout_seed, jnp.int32)
+            + lax.axis_index(axis),
+        )
     qg, kg, vg = scatter_heads(q), scatter_heads(k), scatter_heads(v)
-    out, _ = flash_attention_with_lse(qg, kg, vg, causal, scale)
+    out, _ = flash_attention_with_lse(qg, kg, vg, causal, scale, **drop)
     return gather_heads(out)

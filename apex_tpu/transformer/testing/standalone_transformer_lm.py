@@ -105,6 +105,10 @@ class TransformerConfig:
     # (validated in __post_init__); causal attention only (enforced in
     # CoreAttention).
     context_axis: Optional[str] = None
+    # "ring" (K/V chunks rotate via ppermute; any head count) or "ulysses"
+    # (all_to_all head<->sequence swap; needs heads % cp == 0, one a2a pair
+    # instead of cp neighbor hops).
+    context_impl: str = "ring"
 
     def __post_init__(self):
         if self.context_axis is not None and self.sequence_parallel:
@@ -113,6 +117,10 @@ class TransformerConfig:
                 " both reinterpret the sequence dimension as sharded (over"
                 " cp and tp respectively) and composing them would compute"
                 " attention over a misread shard layout")
+        if self.context_impl not in ("ring", "ulysses"):
+            raise ValueError(
+                f"context_impl must be 'ring' or 'ulysses', got "
+                f"{self.context_impl!r}")
 
     # Mixture-of-experts (parity-plus: the reference stubs SwitchMLP out,
     # standalone_transformer_lm.py:675; see apex_tpu/transformer/moe.py).
@@ -208,20 +216,31 @@ class CoreAttention(nn.Module):
                 "ulysses_attention (context_parallel.py) wired explicitly")
         if (cfg.context_axis is not None
                 and self.attn_mask_type == AttnMaskType.causal):
-            # Context parallelism: q/k/v hold this rank's sequence shard;
-            # ring attention rotates K/V chunks over the cp axis (global
-            # causal offsets handled inside).  In-kernel dropout is not
-            # plumbed through the ring VJP; reject rather than silently
-            # skip it.
+            # Context parallelism: q/k/v hold this rank's sequence shard.
+            from apex_tpu.transformer import context_parallel as cp_lib
+
+            kw = {}
             if cfg.attention_dropout > 0.0 and not deterministic:
-                raise NotImplementedError(
-                    "attention_dropout under context parallelism is not "
-                    "supported (ring attention re-drives the flash kernels "
-                    "per chunk; set attention_dropout=0.0)")
-            from apex_tpu.transformer.context_parallel import ring_attention
-            ctx = ring_attention(
+                if cfg.context_impl == "ring":
+                    # in-kernel dropout is not plumbed through the ring
+                    # VJP (kernels re-driven per visiting chunk); reject
+                    # rather than silently skip it
+                    raise NotImplementedError(
+                        "attention_dropout under ring context parallelism "
+                        "is not supported; use context_impl='ulysses' or "
+                        "set attention_dropout=0.0")
+                kw = dict(
+                    dropout_rate=cfg.attention_dropout,
+                    dropout_seed=jax.random.randint(
+                        self.make_rng("dropout"), (), 0,
+                        jnp.iinfo(jnp.int32).max),
+                )
+            attn = (cp_lib.ring_attention if cfg.context_impl == "ring"
+                    else cp_lib.ulysses_attention)
+            ctx = attn(
                 q.transpose(1, 2, 0, 3), k.transpose(1, 2, 0, 3),
                 v.transpose(1, 2, 0, 3), axis=cfg.context_axis, causal=True,
+                **kw,
             )  # [b, n, sq_local, d]
             return ctx.transpose(2, 0, 1, 3).reshape(sq, b, n * d)
 

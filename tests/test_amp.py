@@ -200,3 +200,34 @@ class TestFrontend:
         sd = amp.state_dict(state._replace(scaler=s2))
         restored = amp.load_state_dict(state, sd)
         assert float(restored.scaler.scale) == float(s2.scale)
+
+    def test_multiple_losses_independent_scalers(self):
+        """The reference's multiple-models/optimizers/losses contract
+        (tests/L0/run_amp/test_multiple_models_optimizers_losses.py):
+        num_losses > 1 gives each loss its own dynamic scaler whose
+        overflow backoff does not disturb the others."""
+        conf, state = amp.initialize(opt_level="O2", num_losses=2)
+        assert isinstance(state.scaler, tuple) and len(state.scaler) == 2
+        s0, s1 = state.scaler
+        start = float(s0.scale)
+        # loss 0 overflows; loss 1 is clean
+        s0 = conf.loss_scaler.update(s0, jnp.asarray(False))
+        s1 = conf.loss_scaler.update(s1, jnp.asarray(True))
+        assert float(s0.scale) == start / 2.0       # backed off
+        assert float(s1.scale) == start             # untouched
+        assert bool(s0.found_inf) and not bool(s1.found_inf)
+
+        # per-loss scaling uses the per-loss state
+        l0 = amp.scale_loss(jnp.float32(1.0), s0)
+        l1 = amp.scale_loss(jnp.float32(1.0), s1)
+        assert float(l0) == float(s0.scale)
+        assert float(l1) == float(s1.scale)
+
+        # state-dict round-trips the scaler list
+        sd = amp.state_dict(state._replace(scaler=(s0, s1)))
+        assert isinstance(sd, list) and len(sd) == 2
+        restored = amp.load_state_dict(state, sd)
+        assert float(restored.scaler[0].scale) == float(s0.scale)
+        assert float(restored.scaler[1].scale) == float(s1.scale)
+        with pytest.raises(ValueError):
+            amp.load_state_dict(state, sd[:1])

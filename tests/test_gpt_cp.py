@@ -67,9 +67,10 @@ def serial_loss(cfg_cp, params, tokens):
     return jnp.mean(per_tok)
 
 
-def test_cp_loss_and_grads_match_serial():
+@pytest.mark.parametrize("impl", ["ring", "ulysses"])
+def test_cp_loss_and_grads_match_serial(impl):
     mesh = parallel.initialize_model_parallel(context_parallel_size=CP)
-    cfg = make_cfg()
+    cfg = make_cfg(context_impl=impl)
     init_fn, make_loss_fn, _ = build_gpt_cp(cfg, mesh=mesh)
     batch = DP * 2
     tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, SEQ), 0,
