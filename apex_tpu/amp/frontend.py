@@ -141,15 +141,27 @@ def state_dict(state: AmpState):
 
 def load_state_dict(state: AmpState, sd) -> AmpState:
     """Restore scaler state (``amp.load_state_dict``,
-    ``apex/amp/frontend.py:377-404``)."""
-    if not isinstance(state.scaler, LossScaleState):  # per-loss tuple
-        if not isinstance(sd, (list, tuple)) or len(sd) != len(state.scaler):
-            raise ValueError(
-                f"state_dict has {len(sd) if isinstance(sd, (list, tuple)) else 1} "
-                f"scaler entries, state expects {len(state.scaler)}")
-        return state._replace(scaler=tuple(_one_load(d) for d in sd))
-    if isinstance(sd, (list, tuple)):
-        raise ValueError(
-            f"state_dict has {len(sd)} scaler entries (saved with "
-            f"num_losses>1), state expects a single scaler")
-    return state._replace(scaler=_one_load(sd))
+    ``apex/amp/frontend.py:377-404``).
+
+    Scaler-count mismatches (checkpoint saved with a different
+    ``num_losses``) follow the reference's resume semantics
+    (``apex/amp/frontend.py:394``): load the overlapping prefix and warn —
+    extra saved scalers are dropped, missing ones keep their fresh state —
+    rather than refusing the checkpoint."""
+    saved = list(sd) if isinstance(sd, (list, tuple)) else [sd]
+    current = (list(state.scaler)
+               if not isinstance(state.scaler, LossScaleState)
+               else [state.scaler])
+    if len(saved) != len(current):
+        import warnings
+
+        warnings.warn(
+            f"amp.load_state_dict: checkpoint has {len(saved)} loss "
+            f"scaler(s) but state expects {len(current)} (saved with a "
+            "different num_losses); loading the overlapping prefix "
+            "(reference behavior, apex/amp/frontend.py:394)")
+    loaded = [_one_load(d) for d in saved[: len(current)]]
+    loaded += current[len(loaded):]
+    if not isinstance(state.scaler, LossScaleState):
+        return state._replace(scaler=tuple(loaded))
+    return state._replace(scaler=loaded[0])

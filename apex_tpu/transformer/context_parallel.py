@@ -219,6 +219,10 @@ def ulysses_attention(q, k, v, axis: str = CONTEXT_AXIS,
 
     drop = {}
     if dropout_rate > 0.0:
+        if dropout_seed is None:  # mirror _seed_array's error, pre-asarray
+            raise ValueError(
+                "dropout_rate > 0 requires an explicit integer dropout_seed"
+            )
         drop = dict(
             dropout_rate=dropout_rate,
             dropout_seed=jnp.asarray(dropout_seed, jnp.int32)

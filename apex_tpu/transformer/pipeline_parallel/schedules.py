@@ -105,6 +105,41 @@ LossFn = Callable[[Any, Any], jnp.ndarray]  # (output, target) -> scalar
 # lambda per call.  LRU: hits move to the back, eviction pops the front.
 _GROUPED_JIT_CACHE: dict = {}
 _GROUPED_JIT_CACHE_MAX = 32
+# Identity-driven miss counts per stage_fn code object.  Fresh closures
+# per call share a code object but never hit the identity-keyed cache; a
+# miss only counts when a cached entry matches the key in every component
+# *except* stage_fn identity, so legitimate misses (new shapes, new
+# config, LRU eviction) never accumulate toward the warning.
+_GROUPED_JIT_MISSES: dict = {}
+_GROUPED_JIT_MISSES_MAX = 64
+_GROUPED_JIT_MISS_WARN_AT = 4
+
+
+def _note_cache_miss(stage_fn, key) -> None:
+    code = getattr(stage_fn, "__code__", None)
+    if code is None:
+        return
+    identity_driven = any(
+        k[0] is not stage_fn and k[1:] == key[1:] for k in _GROUPED_JIT_CACHE
+    )
+    if not identity_driven:
+        return
+    if len(_GROUPED_JIT_MISSES) >= _GROUPED_JIT_MISSES_MAX:
+        _GROUPED_JIT_MISSES.pop(next(iter(_GROUPED_JIT_MISSES)))
+    misses = _GROUPED_JIT_MISSES.get(code, 0) + 1
+    _GROUPED_JIT_MISSES[code] = misses
+    if misses == _GROUPED_JIT_MISS_WARN_AT:
+        import warnings
+
+        warnings.warn(
+            f"pipeline_apply(remat_ticks=...) has recompiled {misses} times "
+            f"for distinct stage_fn objects sharing the code at "
+            f"{code.co_filename}:{code.co_firstlineno}. The grouped-remat "
+            "jit cache keys on stage_fn *identity*; pass one stable "
+            "stage_fn object (hoist it out of the step loop) instead of a "
+            "fresh closure/lambda per call.",
+            stacklevel=4,
+        )
 
 
 def _abstract_key(tree):
@@ -498,6 +533,7 @@ def pipeline_apply(
            _abstract_key(params_cm), _abstract_key(inputs))
     jitted = _GROUPED_JIT_CACHE.pop(key, None)  # pop+reinsert = LRU order
     if jitted is None:
+        _note_cache_miss(stage_fn, key)
         if len(_GROUPED_JIT_CACHE) >= _GROUPED_JIT_CACHE_MAX:
             _GROUPED_JIT_CACHE.pop(next(iter(_GROUPED_JIT_CACHE)))
         jitted = jax.jit(build())

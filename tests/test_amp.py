@@ -229,5 +229,23 @@ class TestFrontend:
         restored = amp.load_state_dict(state, sd)
         assert float(restored.scaler[0].scale) == float(s0.scale)
         assert float(restored.scaler[1].scale) == float(s1.scale)
-        with pytest.raises(ValueError):
-            amp.load_state_dict(state, sd[:1])
+
+    def test_load_state_dict_num_losses_mismatch(self):
+        """Resume with a different num_losses loads the overlapping prefix
+        with a warning (reference: apex/amp/frontend.py:394 skips extra
+        saved scalers rather than refusing the checkpoint)."""
+        conf, state = amp.initialize(opt_level="O2", num_losses=2)
+        s0 = conf.loss_scaler.update(state.scaler[0], jnp.asarray(True))
+        sd = amp.state_dict(state._replace(scaler=(s0, state.scaler[1])))
+
+        # fewer saved than expected: prefix loads, the rest stays fresh
+        with pytest.warns(UserWarning, match="overlapping prefix"):
+            restored = amp.load_state_dict(state, sd[:1])
+        assert float(restored.scaler[0].scale) == float(s0.scale)
+        assert float(restored.scaler[1].scale) == float(state.scaler[1].scale)
+
+        # more saved than expected: extras dropped
+        _, single = amp.initialize(opt_level="O2")
+        with pytest.warns(UserWarning, match="overlapping prefix"):
+            restored = amp.load_state_dict(single, sd)
+        assert float(restored.scaler.scale) == float(s0.scale)

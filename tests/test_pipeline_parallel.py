@@ -272,6 +272,35 @@ def test_grouped_remat_matches_flat(pp, vpp, m, g):
         )
 
 
+def test_grouped_remat_cache_miss_warning():
+    """Fresh stage_fn closures per call (same code object, new identity)
+    defeat the identity-keyed grouped-remat jit cache; after
+    _GROUPED_JIT_MISS_WARN_AT identity-driven misses a warning tells the
+    caller to hoist stage_fn.  A stable stage_fn never warns (ADVICE r2:
+    schedules.py _GROUPED_JIT_CACHE identity-keying footgun)."""
+    import warnings
+
+    parallel.initialize_model_parallel(pipeline_model_parallel_size=4)
+    stacked, _ = make_stage_params(jax.random.PRNGKey(0), 4)
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, MB, HID))
+
+    pp_lib.schedules._GROUPED_JIT_CACHE.clear()
+    pp_lib.schedules._GROUPED_JIT_MISSES.clear()
+
+    # stable stage_fn, varying shapes: legitimate misses, no warning
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        for m in (2, 4, 6, 8, 10):
+            xi = jax.random.normal(jax.random.PRNGKey(2), (m, MB, HID))
+            pp_lib.pipeline_apply(stage_fn, stacked, xi, remat_ticks=True)
+
+    # fresh closure per call, same everything else: warns at the threshold
+    with pytest.warns(UserWarning, match="hoist it out of the step loop"):
+        for _ in range(pp_lib.schedules._GROUPED_JIT_MISS_WARN_AT + 1):
+            fresh = lambda p, h: stage_fn(p, h)  # noqa: E731
+            pp_lib.pipeline_apply(fresh, stacked, x, remat_ticks=True)
+
+
 @pytest.mark.parametrize("pp,m", [(4, 8)])
 def test_grouped_remat_with_sharded_microbatches(pp, m):
     """remat_ticks composes with shard_microbatches (1/pp input/output
