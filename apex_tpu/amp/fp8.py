@@ -43,8 +43,8 @@ try:  # flax is the module-layer convention in this framework
 except Exception:  # pragma: no cover
     nn = None
 
-__all__ = ["Fp8Meta", "Fp8Dense", "fp8_quantize", "update_meta",
-           "E4M3", "E5M2"]
+__all__ = ["Fp8Meta", "Fp8Dense", "fp8_quantize", "fp8_matmul_t",
+           "update_meta", "E4M3", "E5M2"]
 
 E4M3 = jnp.float8_e4m3fn
 E5M2 = jnp.float8_e5m2
@@ -100,6 +100,55 @@ def update_meta(meta: Fp8Meta, amax_now, dtype=E4M3,
     return Fp8Meta(amax_history=hist, scale=scale)
 
 
+def _jit_e5m2_f32(g):
+    """Quantize a cotangent to e5m2 with a just-in-time scale and return it
+    upcast to fp32 (see module docstring: delayed scales are unsafe for
+    gradients under dynamic loss scaling)."""
+    g_amax = jnp.max(jnp.abs(g)).astype(jnp.float32)
+    g_scale = jnp.where(g_amax > 0, _fp8_max(E5M2) / g_amax, 1.0)
+    return _quantize(g, g_scale, E5M2).astype(jnp.float32) / g_scale
+
+
+@jax.custom_vjp
+def fp8_matmul_t(x, w, xm, wm):
+    """``y = x @ w.T`` computed through fp8 with delayed scaling.
+
+    Torch weight layout (``w: [out, in]``) — the GEMM core the
+    tensor-parallel linears (:class:`ColumnParallelLinear` /
+    :class:`RowParallelLinear`) route through when their ``fp8`` flag is
+    set.  Forward quantizes both operands to e4m3 with the *delayed* scales
+    carried in ``xm``/``wm`` (:class:`Fp8Meta`); backward quantizes the
+    cotangent to e5m2 just-in-time.  Pure w.r.t. the metas — callers roll
+    them forward with :func:`update_meta` (amax ``pmax``-shared over the
+    model-parallel axis, the reference's amax group:
+    ``apex/transformer/parallel_state.py:280-291``).
+    """
+    x2d = x.reshape(-1, x.shape[-1])
+    xq = _quantize(x2d, xm.scale, E4M3).astype(jnp.float32)
+    wq = _quantize(w, wm.scale, E4M3).astype(jnp.float32)
+    y = (xq @ wq.T) / (xm.scale * wm.scale)
+    return y.reshape(*x.shape[:-1], w.shape[0]).astype(x.dtype)
+
+
+def _fp8_matmul_t_fwd(x, w, xm, wm):
+    return fp8_matmul_t(x, w, xm, wm), (x, w, xm, wm)
+
+
+def _fp8_matmul_t_bwd(res, g):
+    x, w, xm, wm = res
+    g32 = _jit_e5m2_f32(g.reshape(-1, g.shape[-1]))  # [N, out]
+    wq = _quantize(w, wm.scale, E4M3).astype(jnp.float32)
+    xq = _quantize(x.reshape(-1, x.shape[-1]), xm.scale, E4M3).astype(
+        jnp.float32)
+    dx = (g32 @ wq) / wm.scale     # [N, in]
+    dw = (g32.T @ xq) / xm.scale   # [out, in]
+    return (dx.reshape(x.shape).astype(x.dtype), dw.astype(w.dtype),
+            None, None)
+
+
+fp8_matmul_t.defvjp(_fp8_matmul_t_fwd, _fp8_matmul_t_bwd)
+
+
 if nn is not None:
 
     class Fp8Dense(nn.Module):
@@ -134,38 +183,20 @@ if nn is not None:
             m = metas.value
             axis = self.axis
 
-            @jax.custom_vjp
-            def core(x2d, w, xm, wm):
-                y = jnp.dot(_quantize(x2d, xm.scale, E4M3).astype(jnp.float32),
-                            _quantize(w, wm.scale, E4M3).astype(jnp.float32))
-                return (y / (xm.scale * wm.scale)).astype(x2d.dtype)
-
-            def fwd(x2d, w, xm, wm):
-                return core(x2d, w, xm, wm), (x2d, w, xm, wm)
-
-            def bwd(res, g):
-                x2d, w, xm, wm = res
-                # just-in-time e5m2 scale from the cotangent itself: immune to
-                # loss-scale jumps that would saturate a delayed scale
-                g_amax = jnp.max(jnp.abs(g)).astype(jnp.float32)
-                g_scale = jnp.where(g_amax > 0, _fp8_max(E5M2) / g_amax, 1.0)
-                g32 = _quantize(g, g_scale, E5M2).astype(jnp.float32) / g_scale
-                wq = _quantize(w, wm.scale, E4M3).astype(jnp.float32)
-                xq = _quantize(x2d, xm.scale, E4M3).astype(jnp.float32)
-                dx = (g32 @ wq.T) / wm.scale
-                dw = (xq.T @ g32) / xm.scale
-                return (dx.astype(x2d.dtype), dw.astype(w.dtype), None, None)
-
-            core.defvjp(fwd, bwd)
-
             lead = x.shape[:-1]
             x2d = x.reshape(-1, in_features)
-            y = core(x2d, kernel, m["x"], m["w"])
+            # One fp8 GEMM core for the whole framework: fp8_matmul_t takes
+            # the torch layout [out, in]; the flax kernel is [in, out], and
+            # XLA folds the transpose into the GEMM's dimension numbers.
+            y = fp8_matmul_t(x2d, kernel.T, m["x"], m["w"])
 
             # Delayed-scaling bookkeeping (outside the vjp: pure state; the
-            # single amax pass per tensor lives here — core quantizes with the
-            # stored scales only).
-            if not self.is_initializing():
+            # single amax pass per tensor lives here — the core quantizes
+            # with the stored scales only).  Rolls only when the caller made
+            # the collection mutable: inference apply() runs with frozen
+            # scales (delayed-scaling eval semantics).
+            if not self.is_initializing() and self.is_mutable_collection(
+                    "fp8_meta"):
                 x_amax = jnp.max(jnp.abs(x2d)).astype(jnp.float32)
                 w_amax = jnp.max(jnp.abs(kernel)).astype(jnp.float32)
                 metas.value = {
@@ -179,4 +210,11 @@ if nn is not None:
             return y
 
 else:  # pragma: no cover
-    Fp8Dense = None
+    class Fp8Dense:  # type: ignore[no-redef]
+        """Placeholder that fails loudly when flax is unavailable."""
+
+        def __init__(self, *args, **kwargs):
+            raise ImportError(
+                "Fp8Dense requires flax (the Flax module layer is optional "
+                "for the rest of apex_tpu.amp.fp8); install flax to use it."
+            )

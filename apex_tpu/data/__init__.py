@@ -1,0 +1,27 @@
+"""Input pipelines.
+
+The reference's examples consume ``torchvision.datasets.ImageFolder``
+through a ``DataLoader`` with ``fast_collate`` and a CUDA-side
+``data_prefetcher`` (``examples/imagenet/main_amp.py:48-63,207-232,256``).
+This package is the TPU-native analog: a pure PIL/numpy ImageFolder, DP
+sharding through the Megatron samplers, threaded decode, and uint8 batches
+normalized on-device inside the jitted step.
+"""
+
+from apex_tpu.data.image_folder import (
+    ImageFolder,
+    ImageFolderLoader,
+    center_crop_resize,
+    normalize_on_device,
+    random_resized_crop,
+    synthetic_image_batches,
+)
+
+__all__ = [
+    "ImageFolder",
+    "ImageFolderLoader",
+    "center_crop_resize",
+    "normalize_on_device",
+    "random_resized_crop",
+    "synthetic_image_batches",
+]

@@ -86,6 +86,7 @@ def linear_with_grad_accumulation(
     *,
     sequence_parallel: bool = False,
     axis: Optional[str] = TENSOR_AXIS,
+    fp8_metas=None,
 ):
     """``y = x @ w.T + b`` with optional SP all-gather of ``x``.
 
@@ -95,6 +96,10 @@ def linear_with_grad_accumulation(
     reduce-scattered in backward — exactly
     :func:`~apex_tpu.transformer.tensor_parallel.mappings.gather_from_sequence_parallel_region`
     with ``tensor_parallel_output_grad=True``.
+
+    ``fp8_metas``: ``{"x": Fp8Meta, "w": Fp8Meta}`` — route the GEMM
+    through :func:`apex_tpu.amp.fp8.fp8_matmul_t` (e4m3 operands, delayed
+    scaling; e5m2 just-in-time cotangent).  The caller rolls the metas.
     """
     if sequence_parallel:
         if axis is None:
@@ -102,10 +107,54 @@ def linear_with_grad_accumulation(
         x = mappings.gather_from_sequence_parallel_region(
             x, axis, True
         )
-    y = jnp.matmul(x, weight.T)
+    if fp8_metas is not None:
+        from apex_tpu.amp.fp8 import fp8_matmul_t
+
+        y = fp8_matmul_t(x, weight, fp8_metas["x"], fp8_metas["w"])
+    else:
+        y = jnp.matmul(x, weight.T)
     if bias is not None:
         y = y + bias
     return y
+
+
+class _Fp8MetaMixin:
+    """Shared fp8 bookkeeping for the parallel linears: a mutable
+    ``"fp8_meta"`` collection holding ``{"x", "w"}`` :class:`Fp8Meta`s, and
+    the per-step delayed-scaling update with the amax ``pmax``-shared over
+    the tensor axis (the reference's TE amax-sharing groups,
+    ``apex/transformer/parallel_state.py:280-291``)."""
+
+    def _fp8_metas(self):
+        from apex_tpu.amp.fp8 import Fp8Meta
+
+        return self.variable(
+            "fp8_meta", "metas",
+            lambda: {"x": Fp8Meta.init(), "w": Fp8Meta.init()})
+
+    def _fp8_roll(self, metas, x_local, weight, axis_bound: bool):
+        """Roll the delayed scales with this step's amaxes.  ``x_local`` may
+        be the pre-all-gather sequence shard: its local amax ``pmax``-ed
+        over the axis equals the gathered tensor's amax.
+
+        Only rolls when the caller made the collection mutable (training
+        steps pass ``mutable=["fp8_meta"]``); plain inference ``apply``
+        runs with the stored scales frozen — the correct delayed-scaling
+        eval semantics, and it keeps ``apply`` usable without threading
+        state."""
+        from apex_tpu.amp.fp8 import E4M3, update_meta
+
+        if (self.is_initializing()
+                or not self.is_mutable_collection("fp8_meta")):
+            return
+        axis = self.axis if axis_bound else None
+        m = metas.value
+        x_amax = jnp.max(jnp.abs(x_local)).astype(jnp.float32)
+        w_amax = jnp.max(jnp.abs(weight)).astype(jnp.float32)
+        metas.value = {
+            "x": update_meta(m["x"], x_amax, E4M3, axis),
+            "w": update_meta(m["w"], w_amax, E4M3, axis),
+        }
 
 
 class VocabParallelEmbedding(nn.Module):
@@ -165,7 +214,7 @@ class VocabParallelEmbedding(nn.Module):
         return jnp.matmul(query, weight.T)
 
 
-class ColumnParallelLinear(nn.Module):
+class ColumnParallelLinear(nn.Module, _Fp8MetaMixin):
     """Linear with the output dimension sharded: ``W = [W_1 .. W_p]`` rows.
 
     Reference: ``ColumnParallelLinear`` (``layers.py:460-644``).  Forward
@@ -192,6 +241,7 @@ class ColumnParallelLinear(nn.Module):
     bias_init: Initializer = nn.initializers.zeros_init()
     dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
+    fp8: bool = False  # e4m3/e5m2 GEMM with delayed scaling (fp8_matmul_t)
 
     @nn.compact
     def __call__(self, x):
@@ -219,13 +269,17 @@ class ColumnParallelLinear(nn.Module):
 
         if world > 1 and not self.sequence_parallel:
             x = mappings.copy_to_tensor_model_parallel_region(x, self.axis)
+        fp8_metas = self._fp8_metas() if self.fp8 else None
         y = linear_with_grad_accumulation(
             x,
             weight,
             bias if not self.skip_bias_add else None,
             sequence_parallel=self.sequence_parallel and world > 1,
             axis=shard_axis,
+            fp8_metas=None if fp8_metas is None else fp8_metas.value,
         )
+        if fp8_metas is not None:
+            self._fp8_roll(fp8_metas, x, weight, world > 1)
         if self.gather_output:
             if self.sequence_parallel:
                 raise ValueError(
@@ -241,7 +295,7 @@ class ColumnParallelLinear(nn.Module):
         return y
 
 
-class RowParallelLinear(nn.Module):
+class RowParallelLinear(nn.Module, _Fp8MetaMixin):
     """Linear with the input dimension sharded: ``W = [W_1; ..; W_p]`` cols.
 
     Reference: ``RowParallelLinear`` (``layers.py:645-813``).  Forward
@@ -262,6 +316,7 @@ class RowParallelLinear(nn.Module):
     bias_init: Initializer = nn.initializers.zeros_init()
     dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
+    fp8: bool = False  # e4m3/e5m2 GEMM with delayed scaling (fp8_matmul_t)
 
     @nn.compact
     def __call__(self, x):
@@ -292,7 +347,13 @@ class RowParallelLinear(nn.Module):
                     "(layers.py:761-764)"
                 )
             x = mappings.scatter_to_tensor_model_parallel_region(x, self.axis)
-        y = jnp.matmul(x, weight.T)
+        fp8_metas = self._fp8_metas() if self.fp8 else None
+        y = linear_with_grad_accumulation(
+            x, weight, None, sequence_parallel=False, axis=shard_axis,
+            fp8_metas=None if fp8_metas is None else fp8_metas.value,
+        )
+        if fp8_metas is not None:
+            self._fp8_roll(fp8_metas, x, weight, world > 1)
         if world > 1:
             if self.sequence_parallel:
                 y = mappings.reduce_scatter_to_sequence_parallel_region(

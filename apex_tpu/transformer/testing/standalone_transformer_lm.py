@@ -131,6 +131,17 @@ class TransformerConfig:
     dtype: Any = jnp.float32        # compute dtype (bf16 under the O2 policy)
     param_dtype: Any = jnp.float32
 
+    # FP8 transformer-layer GEMMs (qkv / attention out / fc1 / fc2) via
+    # :func:`apex_tpu.amp.fp8.fp8_matmul_t`: e4m3 operands with delayed
+    # scaling, e5m2 just-in-time cotangents, amax pmax-shared over
+    # ``tensor_axis`` (the reference's TE amax groups,
+    # ``apex/transformer/parallel_state.py:280-291``).  The delayed scales
+    # live in the mutable ``"fp8_meta"`` collection — train steps apply with
+    # ``mutable=["fp8_meta"]`` and carry the collection forward (see
+    # ``tests/test_fp8.py::test_fp8_gpt_trains``).  Embedding/LM head stay
+    # in the compute dtype (the TE recipe).
+    fp8: bool = False
+
     @property
     def ffn_size(self) -> int:
         return self.ffn_hidden_size or 4 * self.hidden_size
@@ -169,7 +180,7 @@ class ParallelMLP(nn.Module):
             skip_bias_add=True,
             axis=cfg.tensor_axis,
             kernel_init=cfg.init_method(),
-            dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+            dtype=cfg.dtype, param_dtype=cfg.param_dtype, fp8=cfg.fp8,
             name="dense_h_to_4h",
         )(x)
         # bias_gelu fusion (reference fused_bias_gelu.py): one fused
@@ -182,7 +193,7 @@ class ParallelMLP(nn.Module):
             skip_bias_add=True,
             axis=cfg.tensor_axis,
             kernel_init=cfg.scaled_init_method(),
-            dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+            dtype=cfg.dtype, param_dtype=cfg.param_dtype, fp8=cfg.fp8,
             name="dense_4h_to_h",
         )(h)
         return out, out_bias
@@ -344,7 +355,7 @@ class ParallelAttention(nn.Module):
                 sequence_parallel=cfg.sequence_parallel,
                 axis=cfg.tensor_axis,
                 kernel_init=cfg.init_method(),
-                dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                dtype=cfg.dtype, param_dtype=cfg.param_dtype, fp8=cfg.fp8,
                 name="query_key_value",
             )(x)
             s, b = qkv.shape[0], qkv.shape[1]
@@ -355,13 +366,14 @@ class ParallelAttention(nn.Module):
                 cfg.hidden_size, proj,
                 sequence_parallel=cfg.sequence_parallel,
                 axis=cfg.tensor_axis, kernel_init=cfg.init_method(),
-                dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="query",
+                dtype=cfg.dtype, param_dtype=cfg.param_dtype, fp8=cfg.fp8,
+                name="query",
             )(x)
             kv = ColumnParallelLinear(
                 cfg.hidden_size, 2 * proj,
                 sequence_parallel=False, axis=cfg.tensor_axis,
                 kernel_init=cfg.init_method(),
-                dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                dtype=cfg.dtype, param_dtype=cfg.param_dtype, fp8=cfg.fp8,
                 name="key_value",
             )(encoder_output)
             s, b = q.shape[0], q.shape[1]
@@ -382,7 +394,7 @@ class ParallelAttention(nn.Module):
             skip_bias_add=True,
             axis=cfg.tensor_axis,
             kernel_init=cfg.scaled_init_method(),
-            dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+            dtype=cfg.dtype, param_dtype=cfg.param_dtype, fp8=cfg.fp8,
             name="dense",
         )(ctx)
         return out, bias

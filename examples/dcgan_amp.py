@@ -1,0 +1,246 @@
+"""DCGAN with mixed precision — the analog of
+``examples/dcgan/main_amp.py``.
+
+The reference trains the classic 64x64 DCGAN with
+``amp.initialize([netD, netG], [optD, optG], num_losses=3)`` and a
+separate ``loss_id`` per backward (D-real=0, D-fake=1, G=2;
+``main_amp.py:218-276``) so each loss owns an independent dynamic scaler.
+Here the same three-scaler structure drives one jitted D step and one
+jitted G step:
+
+    # synthetic data (the reference's ``--dataset fake`` / FakeData path):
+    python examples/dcgan_amp.py --steps 200
+
+    # folder dataset (the reference's ``--dataset folder``):
+    python examples/dcgan_amp.py --dataroot /path/to/images --steps 2000
+
+TPU-first notes: both networks are NHWC Flax modules (XLA's native conv
+layout); the two optimizers are FusedAdam(betas=(0.5, 0.999)) like the
+reference; generator/discriminator losses stay finite in bf16, but the
+per-loss scaler plumbing is exercised exactly as the reference exercises
+it (scale -> grad -> unscale -> finite-check -> update/adjust).
+"""
+
+import argparse
+import time
+from functools import partial
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu import amp, parallel
+from apex_tpu.optimizers import FusedAdam
+
+NC = 3  # image channels
+
+
+class Generator(nn.Module):
+    """z -> 64x64x3, the reference netG (``main_amp.py:125-153``):
+    ConvTranspose 4x4 stack, BN+ReLU, tanh output."""
+
+    nz: int = 100
+    ngf: int = 64
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, z, train: bool = True):
+        # z: [B, nz] -> [B, 1, 1, nz]
+        x = z.reshape(z.shape[0], 1, 1, self.nz).astype(self.dtype)
+        widths = (self.ngf * 8, self.ngf * 4, self.ngf * 2, self.ngf)
+        for i, w in enumerate(widths):
+            x = nn.ConvTranspose(
+                w, (4, 4),
+                strides=(1, 1) if i == 0 else (2, 2),
+                padding="VALID" if i == 0 else "SAME",
+                use_bias=False, dtype=self.dtype)(x)
+            x = nn.BatchNorm(use_running_average=not train,
+                             dtype=self.dtype)(x)
+            x = nn.relu(x)
+        x = nn.ConvTranspose(NC, (4, 4), strides=(2, 2), padding="SAME",
+                             use_bias=False, dtype=self.dtype)(x)
+        return jnp.tanh(x)  # [B, 64, 64, 3]
+
+
+class Discriminator(nn.Module):
+    """64x64x3 -> logit, the reference netD (``main_amp.py:166-190``):
+    strided 4x4 convs, LeakyReLU(0.2), BN on the middle blocks."""
+
+    ndf: int = 64
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = x.astype(self.dtype)
+        widths = (self.ndf, self.ndf * 2, self.ndf * 4, self.ndf * 8)
+        for i, w in enumerate(widths):
+            x = nn.Conv(w, (4, 4), strides=(2, 2), padding="SAME",
+                        use_bias=False, dtype=self.dtype)(x)
+            if i > 0:
+                x = nn.BatchNorm(use_running_average=not train,
+                                 dtype=self.dtype)(x)
+            x = nn.leaky_relu(x, 0.2)
+        x = nn.Conv(1, (4, 4), padding="VALID", use_bias=False,
+                    dtype=self.dtype)(x)  # [B, 1, 1, 1]
+        return x.reshape(x.shape[0])
+
+
+def bce_with_logits(logits, target: float):
+    """``BCEWithLogitsLoss`` against a constant label, in fp32."""
+    logits = logits.astype(jnp.float32)
+    return jnp.mean(
+        jnp.maximum(logits, 0) - logits * target
+        + jnp.log1p(jnp.exp(-jnp.abs(logits))))
+
+
+def folder_batches(root, batch_size, image_size=64, seed=0):
+    """Real-image stream through apex_tpu.data (uint8 -> [-1, 1])."""
+    from apex_tpu.data import ImageFolder, ImageFolderLoader
+
+    loader = ImageFolderLoader(ImageFolder(root), local_batch=batch_size,
+                               image_size=image_size, seed=seed)
+    while True:
+        for x, _ in loader:  # labels unused (unconditional GAN)
+            yield x.astype(np.float32) / 127.5 - 1.0
+
+
+def fake_batches(batch_size, image_size=64, seed=0):
+    """The reference's ``--dataset fake`` (FakeData) path."""
+    rng = np.random.RandomState(seed)
+    while True:
+        yield rng.uniform(-1.0, 1.0,
+                          (batch_size, image_size, image_size, NC)
+                          ).astype(np.float32)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--dataroot", default=None,
+                   help="image folder; synthetic FakeData when omitted")
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--nz", type=int, default=100)
+    p.add_argument("--ngf", type=int, default=64)
+    p.add_argument("--ndf", type=int, default=64)
+    p.add_argument("--lr", type=float, default=2e-4)
+    p.add_argument("--beta1", type=float, default=0.5)
+    p.add_argument("--steps", type=int, default=100)
+    p.add_argument("--opt-level", default="O1",
+                   choices=["O0", "O1", "O2", "O3"])
+    p.add_argument("--seed", type=int, default=2809)  # reference default
+    args = p.parse_args(argv)
+
+    parallel.initialize_model_parallel()
+    conf, state = amp.initialize(opt_level=args.opt_level, num_losses=3)
+    scalers = (state.scaler if isinstance(state.scaler, tuple)
+               else (state.scaler,) * 3)
+    s_real, s_fake, s_gen = scalers
+    policy = conf.policy
+
+    netG = Generator(nz=args.nz, ngf=args.ngf, dtype=policy.compute_dtype)
+    netD = Discriminator(ndf=args.ndf, dtype=policy.compute_dtype)
+
+    key = jax.random.PRNGKey(args.seed)
+    kG, kD, key = jax.random.split(key, 3)
+    z0 = jnp.zeros((2, args.nz))
+    x0 = jnp.zeros((2, 64, 64, NC))
+    vG = netG.init(kG, z0)
+    vD = netD.init(kD, x0)
+    pG, bsG = vG["params"], vG["batch_stats"]
+    pD, bsD = vD["params"], vD["batch_stats"]
+
+    optD = FusedAdam(lr=args.lr, betas=(args.beta1, 0.999))
+    optG = FusedAdam(lr=args.lr, betas=(args.beta1, 0.999))
+    osD, osG = optD.init(pD), optG.init(pG)
+
+    def grads_finite(g):
+        return jnp.all(jnp.asarray(
+            [jnp.all(jnp.isfinite(x))
+             for x in jax.tree_util.tree_leaves(g)]))
+
+    @partial(jax.jit, donate_argnums=(0, 1, 2))
+    def d_step(pD, bsD, osD, pG, bsG, real, z, s_real, s_fake):
+        """D update: two backwards with per-loss scalers (loss_id 0 and 1,
+        ``main_amp.py:231-244``), summed unscaled grads, one Adam step."""
+        fake, _ = netG.apply({"params": pG, "batch_stats": bsG}, z,
+                             train=True, mutable=["batch_stats"])
+        fake = jax.lax.stop_gradient(fake)  # fake.detach()
+
+        def loss_real(pD, bsD):
+            out, mut = netD.apply({"params": pD, "batch_stats": bsD}, real,
+                                  train=True, mutable=["batch_stats"])
+            return amp.scale_loss(bce_with_logits(out, 1.0), s_real), (
+                mut["batch_stats"], jnp.mean(jax.nn.sigmoid(out)))
+
+        def loss_fake(pD, bsD):
+            out, mut = netD.apply({"params": pD, "batch_stats": bsD}, fake,
+                                  train=True, mutable=["batch_stats"])
+            return amp.scale_loss(bce_with_logits(out, 0.0), s_fake), (
+                mut["batch_stats"], jnp.mean(jax.nn.sigmoid(out)))
+
+        (lr_s, (bsD, d_x)), g_real = jax.value_and_grad(
+            loss_real, has_aux=True)(pD, bsD)
+        (lf_s, (bsD, d_g1)), g_fake = jax.value_and_grad(
+            loss_fake, has_aux=True)(pD, bsD)
+
+        g_real = conf.loss_scaler.unscale(g_real, s_real)
+        g_fake = conf.loss_scaler.unscale(g_fake, s_fake)
+        finite = grads_finite((g_real, g_fake))
+        g = jax.tree_util.tree_map(jnp.add, g_real, g_fake)
+        new_pD, new_osD = optD.step(g, osD, pD, skip_update=~finite)
+        s_real = conf.loss_scaler.update(s_real, finite)
+        s_fake = conf.loss_scaler.update(s_fake, finite)
+        errD = lr_s / s_real.scale + lf_s / s_fake.scale
+        return (new_pD, bsD, new_osD, s_real, s_fake, errD, d_x, d_g1)
+
+    @partial(jax.jit, donate_argnums=(0, 1, 2))
+    def g_step(pG, bsG, osG, pD, bsD, z, s_gen):
+        """G update: maximize log(D(G(z))) with loss_id 2
+        (``main_amp.py:262-270``)."""
+        def loss(pG, bsG):
+            fake, mutG = netG.apply({"params": pG, "batch_stats": bsG}, z,
+                                    train=True, mutable=["batch_stats"])
+            out, _ = netD.apply({"params": pD, "batch_stats": bsD}, fake,
+                                train=True, mutable=["batch_stats"])
+            return amp.scale_loss(bce_with_logits(out, 1.0), s_gen), (
+                mutG["batch_stats"], jnp.mean(jax.nn.sigmoid(out)))
+
+        (l_s, (bsG, d_g2)), g = jax.value_and_grad(
+            loss, has_aux=True)(pG, bsG)
+        g = conf.loss_scaler.unscale(g, s_gen)
+        finite = grads_finite(g)
+        new_pG, new_osG = optG.step(g, osG, pG, skip_update=~finite)
+        s_gen = conf.loss_scaler.update(s_gen, finite)
+        return new_pG, bsG, new_osG, s_gen, l_s / s_gen.scale, d_g2
+
+    it = (folder_batches(args.dataroot, args.batch_size)
+          if args.dataroot else fake_batches(args.batch_size))
+    rng = np.random.RandomState(args.seed)
+    t0 = time.perf_counter()
+    errD = errG = None
+    for i in range(args.steps):
+        real = jnp.asarray(next(it))
+        z = jnp.asarray(rng.randn(args.batch_size, args.nz), np.float32)
+        (pD, bsD, osD, s_real, s_fake, errD, d_x, d_g1) = d_step(
+            pD, bsD, osD, pG, bsG, real, z, s_real, s_fake)
+        z = jnp.asarray(rng.randn(args.batch_size, args.nz), np.float32)
+        pG, bsG, osG, s_gen, errG, d_g2 = g_step(
+            pG, bsG, osG, pD, bsD, z, s_gen)
+        if i == 0:
+            jax.block_until_ready(errG)
+            t0 = time.perf_counter()
+        if i % 10 == 0 or i == args.steps - 1:
+            print(f"[{i}/{args.steps}] Loss_D {float(errD):.4f} "
+                  f"Loss_G {float(errG):.4f} D(x) {float(d_x):.3f} "
+                  f"D(G(z)) {float(d_g1):.3f}/{float(d_g2):.3f} "
+                  f"scales {float(s_real.scale):.0f}/"
+                  f"{float(s_fake.scale):.0f}/{float(s_gen.scale):.0f}")
+    jax.block_until_ready(errG)
+    dt = time.perf_counter() - t0
+    if args.steps > 1:
+        print(f"{args.batch_size * (args.steps - 1) / dt:.1f} images/sec")
+    return float(errD), float(errG)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,16 +1,24 @@
-"""ImageNet-style ResNet training — the analog of
-``examples/imagenet/main_amp.py``.
+"""ImageNet ResNet training — the analog of ``examples/imagenet/main_amp.py``.
 
 The reference trains torchvision ResNet-50 with ``amp.initialize(opt_level)``,
 ``FusedSGD``/``FusedLAMB``, apex ``DistributedDataParallel`` and optional
-``--sync_bn``.  Here the same configuration space is flags over one SPMD
-train step:
+``--sync_bn``, reading an ImageFolder tree with DistributedSampler DP
+sharding (``main_amp.py:207-232``).  Here the same configuration space is
+flags over one SPMD train step:
 
+    # synthetic data (CI / smoke test):
     python examples/imagenet_amp.py --arch resnet50 --opt-level O2 \
         --optimizer sgd --sync-bn --batch-size 256 --steps 100
 
-Data: synthetic by default (the reference's shape contract: 224x224x3,
-1000 classes); plug a real input pipeline by replacing `synthetic_batches`.
+    # real data (directory of class subfolders, e.g. ImageNet train/):
+    python examples/imagenet_amp.py --data /path/to/imagenet/train \
+        --opt-level O2 --batch-size 256 --steps 500
+
+Input pipeline (``apex_tpu.data``): PIL decode + RandomResizedCrop/flip in
+a thread pool, Megatron-sampler DP sharding, and **uint8 batches** that are
+normalized on-device inside the jitted step (the reference's
+``fast_collate`` + CUDA prefetcher normalize, done the XLA way — the
+divide/subtract fuses into the first conv).
 """
 
 import argparse
@@ -18,9 +26,14 @@ import time
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from apex_tpu import amp, parallel
+from apex_tpu.data import (
+    ImageFolder,
+    ImageFolderLoader,
+    normalize_on_device,
+    synthetic_image_batches,
+)
 from apex_tpu.models import ResNet18, ResNet50, ResNet101
 from apex_tpu.optimizers import FusedLAMB, FusedSGD
 from apex_tpu.parallel import dp_shard_batch, replicate
@@ -28,25 +41,22 @@ from apex_tpu.parallel import dp_shard_batch, replicate
 ARCHS = {"resnet18": ResNet18, "resnet50": ResNet50, "resnet101": ResNet101}
 
 
-def synthetic_batches(batch_size, image_size, num_classes, seed=0):
-    rng = np.random.RandomState(seed)
-    while True:
-        x = rng.randn(batch_size, image_size, image_size, 3).astype(np.float32)
-        y = rng.randint(0, num_classes, size=(batch_size,))
-        yield jnp.asarray(x), jnp.asarray(y)
-
-
 def main(argv=None):
     p = argparse.ArgumentParser()
+    p.add_argument("--data", default=None, metavar="DIR",
+                   help="ImageFolder root (class subdirectories); "
+                        "synthetic data when omitted")
     p.add_argument("--arch", default="resnet50", choices=sorted(ARCHS))
     p.add_argument("--opt-level", default="O2", choices=["O0", "O1", "O2", "O3"])
     p.add_argument("--optimizer", default="sgd", choices=["sgd", "lamb"])
     p.add_argument("--sync-bn", action="store_true")
-    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--batch-size", type=int, default=64,
+                   help="global batch (all dp shards)")
     p.add_argument("--image-size", type=int, default=224)
     p.add_argument("--num-classes", type=int, default=1000)
     p.add_argument("--lr", type=float, default=0.1)
     p.add_argument("--steps", type=int, default=20)
+    p.add_argument("--workers", type=int, default=8)
     args = p.parse_args(argv)
 
     mesh = parallel.initialize_model_parallel()
@@ -76,10 +86,11 @@ def main(argv=None):
     opt_state = opt.init(params)
 
     def loss_fn(params, batch_stats, batch):
-        x, y = batch
+        x_uint8, y = batch
+        x = normalize_on_device(x_uint8, dtype=policy.compute_dtype)
         logits, mutated = model.apply(
             {"params": params, "batch_stats": batch_stats},
-            policy.cast_to_compute(x),
+            x,
             train=True,
             mutable=["batch_stats"],
         )
@@ -99,8 +110,33 @@ def main(argv=None):
     batch_stats = replicate(batch_stats, mesh)
     opt_state = replicate(opt_state, mesh)
 
-    it = synthetic_batches(args.batch_size, args.image_size, args.num_classes)
+    dp = parallel.mesh.get_data_parallel_world_size()
+    if args.batch_size % dp != 0:
+        raise SystemExit(
+            f"--batch-size {args.batch_size} must be divisible by the "
+            f"data-parallel world size ({dp})")
+    if args.data is not None:
+        dataset = ImageFolder(args.data)
+        print(f"ImageFolder: {len(dataset)} samples, "
+              f"{len(dataset.classes)} classes, dp={dp}")
+        loader = ImageFolderLoader(
+            dataset, local_batch=args.batch_size // dp,
+            data_parallel_size=dp, image_size=args.image_size,
+            workers=args.workers)
+
+        def epochs(loader):
+            # re-iterating resumes from consumed_samples -> next epoch
+            # permutation (the reference's `for epoch in range(...)` loop)
+            while True:
+                yield from loader
+
+        it = epochs(loader)
+    else:
+        it = synthetic_image_batches(args.batch_size, args.image_size,
+                                     args.num_classes)
+
     t0 = time.perf_counter()
+    loss = None
     for i in range(args.steps):
         batch = dp_shard_batch(next(it), mesh)
         params, batch_stats, opt_state, loss = train_step(
