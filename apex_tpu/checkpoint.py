@@ -48,29 +48,53 @@ def _path_str(path) -> str:
     return "/".join(parts)
 
 
+def _leaf_to_host(x) -> np.ndarray:
+    """Fetch one leaf to host, including leaves sharded across *processes*
+    (multi-host training): a non-fully-addressable global array is
+    all-gathered over the process boundary first — the collective analog
+    of the reference's rank-0 NCCL state gather
+    (``distributed_fused_adam.py state_dict(gather_on_root=True)``)."""
+    if isinstance(x, jax.Array) and not x.is_fully_addressable:
+        from jax.experimental import multihost_utils
+
+        return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+    return np.asarray(jax.device_get(x))
+
+
 def save_checkpoint(path: str, tree: Any, step: Optional[int] = None) -> None:
     """Write ``tree`` (any pytree of arrays/scalars) to ``path`` (.npz).
 
     Leaves are fetched to host (works on sharded global arrays — JAX
-    assembles the full array) and stored with a manifest of tree paths,
-    shapes, and dtypes for restore-time verification.
+    assembles the full array; cross-process shards are all-gathered) and
+    stored with a manifest of tree paths, shapes, and dtypes for
+    restore-time verification.
+
+    Multi-host: call from **every** process (the gather is a collective);
+    only process 0 writes the file, and a cross-process barrier makes the
+    checkpoint visible to all ranks on return.
     """
     flat = jax.tree_util.tree_leaves_with_path(tree)
+    arrays = {f"leaf_{i}": _leaf_to_host(x)
+              for i, (_, x) in enumerate(flat)}
     manifest = {
         "version": 1,
         "step": step,
         "leaves": [
-            {"path": _path_str(p), "shape": list(np.shape(x)),
-             "dtype": str(np.asarray(jax.device_get(x)).dtype)}
-            for p, x in flat
+            {"path": _path_str(p), "shape": list(arrays[f"leaf_{i}"].shape),
+             "dtype": str(arrays[f"leaf_{i}"].dtype)}
+            for i, (p, _) in enumerate(flat)
         ],
     }
-    arrays = {f"leaf_{i}": np.asarray(jax.device_get(x))
-              for i, (_, x) in enumerate(flat)}
-    tmp = path + ".tmp"
-    with open(tmp, "wb") as f:
-        np.savez(f, __manifest__=json.dumps(manifest), **arrays)
-    os.replace(tmp, path)  # atomic: no torn checkpoints on preemption
+    multi = jax.process_count() > 1
+    if not multi or jax.process_index() == 0:
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            np.savez(f, __manifest__=json.dumps(manifest), **arrays)
+        os.replace(tmp, path)  # atomic: no torn checkpoints on preemption
+    if multi:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(f"save_checkpoint:{path}")
 
 
 def restore_checkpoint(path: str, like: Any):

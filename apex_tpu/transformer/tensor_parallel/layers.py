@@ -16,7 +16,11 @@ TPU-first notes:
   and XLA overlaps them on the ICI DMA engines.  Nothing to hand-schedule.
 - ``gradient_accumulation_fusion`` (wgrad accumulated straight into a
   persistent ``main_grad`` buffer) is donation: the optimizer's grad
-  accumulator is a jit-carried buffer XLA updates in place.
+  accumulator is a jit-carried buffer XLA updates in place — *measured*,
+  not asserted: ``tests/test_wgrad_accum.py`` checks the compiled HLO's
+  ``input_output_alias`` (in-place write into the donated accumulator),
+  the alias-bytes accounting, and that scan-accumulation temp memory
+  stays flat in the microbatch count.
 - Weights follow the torch layout of the reference (``weight: [out, in]``,
   ``y = x @ w.T``) so checkpoints migrate 1:1; the *local* shard shapes match
   Megatron's partitioning (column: ``[out/tp, in]``, row: ``[out, in/tp]``).

@@ -153,11 +153,6 @@ def main(argv=None):
     optG = FusedAdam(lr=args.lr, betas=(args.beta1, 0.999))
     osD, osG = optD.init(pD), optG.init(pG)
 
-    def grads_finite(g):
-        return jnp.all(jnp.asarray(
-            [jnp.all(jnp.isfinite(x))
-             for x in jax.tree_util.tree_leaves(g)]))
-
     @partial(jax.jit, donate_argnums=(0, 1, 2))
     def d_step(pD, bsD, osD, pG, bsG, real, z, s_real, s_fake):
         """D update: two backwards with per-loss scalers (loss_id 0 and 1,
@@ -185,12 +180,17 @@ def main(argv=None):
 
         g_real = conf.loss_scaler.unscale(g_real, s_real)
         g_fake = conf.loss_scaler.unscale(g_fake, s_fake)
-        finite = grads_finite((g_real, g_fake))
-        g = jax.tree_util.tree_map(jnp.add, g_real, g_fake)
-        new_pD, new_osD = optD.step(g, osD, pD, skip_update=~finite)
-        s_real = conf.loss_scaler.update(s_real, finite)
-        s_fake = conf.loss_scaler.update(s_fake, finite)
+        # report with the scales the losses were scaled by (pre-update)
         errD = lr_s / s_real.scale + lf_s / s_fake.scale
+        # independent per-loss overflow checks (the loss_id 0/1 contract);
+        # the shared optimizer step skips if either backward overflowed
+        finite_real = amp.all_finite(g_real)
+        finite_fake = amp.all_finite(g_fake)
+        g = jax.tree_util.tree_map(jnp.add, g_real, g_fake)
+        new_pD, new_osD = optD.step(
+            g, osD, pD, skip_update=~(finite_real & finite_fake))
+        s_real = conf.loss_scaler.update(s_real, finite_real)
+        s_fake = conf.loss_scaler.update(s_fake, finite_fake)
         return (new_pD, bsD, new_osD, s_real, s_fake, errD, d_x, d_g1)
 
     @partial(jax.jit, donate_argnums=(0, 1, 2))
@@ -208,10 +208,11 @@ def main(argv=None):
         (l_s, (bsG, d_g2)), g = jax.value_and_grad(
             loss, has_aux=True)(pG, bsG)
         g = conf.loss_scaler.unscale(g, s_gen)
-        finite = grads_finite(g)
+        errG = l_s / s_gen.scale  # pre-update scale
+        finite = amp.all_finite(g)
         new_pG, new_osG = optG.step(g, osG, pG, skip_update=~finite)
         s_gen = conf.loss_scaler.update(s_gen, finite)
-        return new_pG, bsG, new_osG, s_gen, l_s / s_gen.scale, d_g2
+        return new_pG, bsG, new_osG, s_gen, errG, d_g2
 
     it = (folder_batches(args.dataroot, args.batch_size)
           if args.dataroot else fake_batches(args.batch_size))

@@ -74,16 +74,16 @@ def test_loader_dp_sharding(image_root):
     np.testing.assert_array_equal(x, x2)  # same consumed_samples, same batch
     np.testing.assert_array_equal(y, y2)
 
-    # the two rank windows come from disjoint sampler buckets: collect one
-    # epoch of labels per rank and check the index sets differ
+    # the two rank windows come from disjoint sampler buckets: one epoch of
+    # per-rank sample indices must not intersect
     loader = mk()
-    seen = [[], []]
-    for bi, (xb, yb) in enumerate(loader):
-        seen[0].append(yb[:2])
-        seen[1].append(yb[2:])
-        if bi >= 2:
-            break
-    assert loader.consumed_samples > 0
+    rank_indices = [set(), set()]
+    for per_rank in zip(*loader.samplers):
+        for r, ids in enumerate(per_rank):
+            rank_indices[r].update(ids)
+    assert rank_indices[0] and rank_indices[1]
+    assert not rank_indices[0] & rank_indices[1], rank_indices
+    assert loader.consumed_samples > 0  # iterating advanced the epoch state
 
 
 def test_normalize_on_device_matches_numpy():

@@ -1,0 +1,118 @@
+"""Wgrad-accumulation donation proof (SURVEY row 42 / VERDICT r2 weak #7).
+
+The reference fuses the weight-gradient GEMM's accumulation into a
+persistent ``weight.main_grad`` buffer (``gradient_accumulation_fusion``,
+``csrc/megatron/fused_weight_gradient_dense.cpp:19`` — a beta=1 GEMM into
+main_grad).  The TPU-native claim (``tensor_parallel/layers.py:17-19``) is
+that buffer donation gives the same thing: the jit-carried accumulator is
+updated in place, with no second grad-sized output allocation.  These
+tests turn that claim into compiled-HLO assertions:
+
+- the donated accumulator appears in ``input_output_alias`` (XLA writes
+  the result into the argument buffer — in-place accumulation);
+- the non-donated variant allocates a fresh grad-sized output instead;
+- temp memory for a scan over M microbatches does not scale with M (the
+  accumulator is carried, not copied per microbatch).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from apex_tpu.transformer.tensor_parallel import (
+    linear_with_grad_accumulation,
+)
+
+OUT, IN, MB = 256, 128, 32
+
+
+def _wgrad_step(main_grad, weight, x, g):
+    """One microbatch's wgrad accumulated into main_grad: the functional
+    analog of fused_weight_gradient_dense's beta=1 GEMM, taken through the
+    public GEMM entry point's vjp."""
+    wgrad = jax.vjp(
+        lambda w: linear_with_grad_accumulation(x, w, axis=None), weight
+    )[1](g)[0]
+    return main_grad + wgrad
+
+
+def _compile(donate):
+    fn = jax.jit(_wgrad_step,
+                 donate_argnums=(0,) if donate else ())
+    mg = jnp.zeros((OUT, IN))
+    w = jnp.ones((OUT, IN))
+    x = jnp.ones((MB, IN))
+    g = jnp.ones((MB, OUT))
+    return fn.lower(mg, w, x, g).compile(), (mg, w, x, g), fn
+
+
+def test_donated_accumulator_aliases_output():
+    comp, _, _ = _compile(donate=True)
+    header = comp.as_text().splitlines()[0]
+    assert "input_output_alias" in header, header
+    # parameter 0 (main_grad) aliases the (single) output
+    assert "(0, {}" in header.split("input_output_alias=")[1], header
+
+
+def test_undonated_accumulator_does_not_alias():
+    comp, _, _ = _compile(donate=False)
+    header = comp.as_text().splitlines()[0]
+    assert "input_output_alias" not in header, header
+
+
+def test_donation_eliminates_output_allocation():
+    """Peak-footprint accounting: with donation the grad-sized output
+    lives in the argument buffer, so (output bytes not aliased) drops by
+    exactly one accumulator."""
+    grad_bytes = OUT * IN * 4
+    comp_d, _, _ = _compile(donate=True)
+    comp_u, _, _ = _compile(donate=False)
+    ma_d, ma_u = comp_d.memory_analysis(), comp_u.memory_analysis()
+    # both report the same logical output size...
+    assert ma_d.output_size_in_bytes == ma_u.output_size_in_bytes
+    # ...but the donated program's output aliases an argument
+    assert ma_d.alias_size_in_bytes >= grad_bytes, (
+        ma_d.alias_size_in_bytes)
+    assert ma_u.alias_size_in_bytes == 0
+
+
+def test_in_place_semantics_and_numerics():
+    """The donated buffer is consumed (in-place write), and M accumulation
+    steps produce exactly M * wgrad."""
+    comp, (mg, w, x, g), fn = _compile(donate=True)
+    out = fn(mg, w, x, g)
+    assert mg.is_deleted()  # the argument buffer was donated
+    out2 = fn(out, w, x, g)
+    expected = 2.0 * np.asarray(
+        jnp.einsum("bo,bi->oi", g, x))
+    np.testing.assert_allclose(np.asarray(out2), expected, rtol=1e-6)
+
+
+def test_scan_accumulation_temp_memory_flat_in_microbatches():
+    """A scan over M microbatches carrying main_grad must not allocate
+    per-microbatch grad buffers: temp bytes stay flat as M grows 4x."""
+
+    def accum(main_grad, weight, xs, gs):
+        def body(acc, mb):
+            x, g = mb
+            wgrad = jax.vjp(
+                lambda w: linear_with_grad_accumulation(x, w, axis=None),
+                weight)[1](g)[0]
+            return acc + wgrad, ()
+
+        acc, _ = lax.scan(body, main_grad, (xs, gs))
+        return acc
+
+    def temp_bytes(m):
+        fn = jax.jit(accum, donate_argnums=(0,))
+        args = (jnp.zeros((OUT, IN)), jnp.ones((OUT, IN)),
+                jnp.ones((m, MB, IN)), jnp.ones((m, MB, OUT)))
+        comp = fn.lower(*args).compile()
+        header = comp.as_text().splitlines()[0]
+        assert "input_output_alias" in header
+        return comp.memory_analysis().temp_size_in_bytes
+
+    t4, t16 = temp_bytes(4), temp_bytes(16)
+    grad_bytes = OUT * IN * 4
+    assert t16 <= t4 + grad_bytes, (t4, t16)  # flat, not 4x
