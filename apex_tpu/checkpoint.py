@@ -70,8 +70,10 @@ def save_checkpoint(path: str, tree: Any, step: Optional[int] = None) -> None:
     restore-time verification.
 
     Multi-host: call from **every** process (the gather is a collective);
-    only process 0 writes the file, and a cross-process barrier makes the
-    checkpoint visible to all ranks on return.
+    only process 0 writes the file, and a cross-process barrier orders the
+    write before any rank returns.  ``path`` must be on a filesystem all
+    hosts can read (NFS / GCS-fuse / single-host tests) — rank-0-local
+    storage leaves other ranks unable to ``restore_checkpoint``.
     """
     flat = jax.tree_util.tree_leaves_with_path(tree)
     arrays = {f"leaf_{i}": _leaf_to_host(x)

@@ -97,6 +97,7 @@ def run_multiprocess(
     devices_per_process: int = 4,
     timeout: float = 600.0,
     extra_env: Optional[dict] = None,
+    script_args: Optional[Sequence[str]] = None,
 ):
     """Spawn ``num_processes`` CPU ranks of ``script`` on this host and wait
     (the ``python -m apex.parallel.multiproc`` analog; per-rank output is
@@ -104,8 +105,9 @@ def run_multiprocess(
 
     Each rank gets ``JAX_PLATFORMS=cpu``, ``devices_per_process`` forced
     host devices, and coordinator/rank env consumed by
-    :func:`initialize_distributed`.  Returns the list of
-    ``CompletedProcess`` results; raises if any rank fails.
+    :func:`initialize_distributed`; ``script_args`` are appended to every
+    rank's argv.  Returns the list of ``CompletedProcess`` results; raises
+    if any rank fails.
     """
     port = free_port()
     procs = []
@@ -121,7 +123,7 @@ def run_multiprocess(
         env["NUM_PROCESSES"] = str(num_processes)
         env["PROCESS_ID"] = str(rank)
         procs.append(subprocess.Popen(
-            [sys.executable, script],
+            [sys.executable, script, *(script_args or ())],
             env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
         ))
     results = []

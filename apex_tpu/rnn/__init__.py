@@ -1,0 +1,15 @@
+"""RNN layer family (apex.RNN parity, closing SURVEY row 19).
+
+Reference: ``apex/RNN/`` — ``RNNBackend.py:25`` (bidirectionalRNN),
+``:90`` (stackedRNN), ``:232`` (RNNCell), ``models.py:21-56``
+(LSTM/GRU/ReLU/Tanh/mLSTM factories), ``cells.py:55`` (mLSTMCell).
+The reference is deprecated upstream but kept here for a clean sweep of
+the component inventory, rebuilt the TPU way: ``lax.scan`` over time
+(one compiled step, no per-timestep dispatch), gate projections fused
+into single GEMMs, bidirectional as a reversed scan, and the whole
+stack differentiable through the scan (no fusedBackend autograd glue).
+"""
+
+from apex_tpu.rnn.rnn import GRU, LSTM, RNN, ReLU, Tanh, mLSTM
+
+__all__ = ["RNN", "LSTM", "GRU", "ReLU", "Tanh", "mLSTM"]

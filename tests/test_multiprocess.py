@@ -14,6 +14,8 @@ import textwrap
 
 import pytest
 
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
 RANK_SCRIPT = textwrap.dedent("""
     import os
 
@@ -191,37 +193,16 @@ def test_four_process_cluster_zero_checkpoint(tmp_path):
     ckpt = tmp_path / "zero_ckpt.npz"
     driver = tmp_path / "driver4.py"
     driver.write_text(textwrap.dedent(f"""
-        import subprocess, sys
-        from apex_tpu.parallel import launch as L
-
-        # pass the ckpt path through argv of every rank
-        import os
-
-        port = L.free_port()
-        procs = []
-        for rank in range(4):
-            env = dict(os.environ)
-            env["JAX_PLATFORMS"] = "cpu"
-            env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
-                                + " --xla_force_host_platform_device_count=2"
-                                ).strip()
-            env["COORDINATOR_ADDRESS"] = f"127.0.0.1:{{port}}"
-            env["NUM_PROCESSES"] = "4"
-            env["PROCESS_ID"] = str(rank)
-            procs.append(subprocess.Popen(
-                [sys.executable, {str(script)!r}, {str(ckpt)!r}],
-                env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE))
-        fails = []
-        for rank, proc in enumerate(procs):
-            out, err = proc.communicate(timeout=540)
-            if proc.returncode != 0 or b"OK" not in out:
-                fails.append((rank, proc.returncode,
-                              err.decode(errors="replace")[-2000:]))
-        assert not fails, fails
+        from apex_tpu.parallel.launch import run_multiprocess
+        results = run_multiprocess({str(script)!r}, num_processes=4,
+                                   devices_per_process=2, timeout=540,
+                                   script_args=[{str(ckpt)!r}])
+        for r in results:
+            assert b"OK" in r.stdout, r.stdout
         print("LAUNCH OK")
     """))
     env = dict(os.environ)
-    env["PYTHONPATH"] = "/root/repo" + os.pathsep + env.get("PYTHONPATH", "")
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
     proc = subprocess.run([sys.executable, str(driver)], env=env,
                           capture_output=True, timeout=900)
     assert proc.returncode == 0, (proc.stderr.decode()[-3000:],
@@ -246,7 +227,7 @@ def test_two_process_cpu_cluster(tmp_path):
         print("LAUNCH OK")
     """))
     env = dict(os.environ)
-    env["PYTHONPATH"] = "/root/repo" + os.pathsep + env.get("PYTHONPATH", "")
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
     proc = subprocess.run([sys.executable, str(driver)], env=env,
                           capture_output=True, timeout=600)
     assert proc.returncode == 0, proc.stderr.decode()[-3000:]

@@ -1,0 +1,184 @@
+"""RNN family (SURVEY row 19): torch parity + scan/grad behavior.
+
+The reference (``apex/RNN``) wraps torch cells; the ground truth for the
+gate math is therefore ``torch.nn.LSTM``/``GRU``/``RNN`` itself — these
+tests copy torch's weights into the scan-based implementation leaf-for-
+leaf (same ``[gates*h, in]`` layout) and require matching outputs.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.rnn import GRU, LSTM, RNN, ReLU, Tanh, mLSTM
+
+torch = pytest.importorskip("torch")
+
+T, B, IN, H = 5, 3, 6, 8
+
+
+def _torch_weights_to_params(tm, num_layers, bidirectional, bias):
+    """torch RNNBase -> the flax param dict of apex_tpu.rnn.RNN."""
+    params = {}
+    dirs = 2 if bidirectional else 1
+    for layer in range(num_layers):
+        for d in range(dirs):
+            name = f"l{layer}{'_rev' if d else ''}"
+            sfx = f"l{layer}{'_reverse' if d else ''}"
+            params[f"{name}_w_ih"] = jnp.asarray(
+                getattr(tm, f"weight_ih_{sfx}").detach().numpy())
+            params[f"{name}_w_hh"] = jnp.asarray(
+                getattr(tm, f"weight_hh_{sfx}").detach().numpy())
+            if bias:
+                params[f"{name}_b_ih"] = jnp.asarray(
+                    getattr(tm, f"bias_ih_{sfx}").detach().numpy())
+                params[f"{name}_b_hh"] = jnp.asarray(
+                    getattr(tm, f"bias_hh_{sfx}").detach().numpy())
+    return params
+
+
+@pytest.mark.parametrize("kind,cls,tcls", [
+    ("lstm", LSTM, torch.nn.LSTM),
+    ("gru", GRU, torch.nn.GRU),
+])
+@pytest.mark.parametrize("layers,bidi,bias", [
+    (1, False, True), (2, True, True), (2, False, False),
+])
+def test_torch_parity(kind, cls, tcls, layers, bidi, bias):
+    tm = tcls(IN, H, num_layers=layers, bias=bias, bidirectional=bidi)
+    tm.eval()
+    x = np.random.RandomState(0).randn(T, B, IN).astype(np.float32)
+
+    with torch.no_grad():
+        t_out, t_hidden = tm(torch.from_numpy(x))
+
+    model = cls(IN, H, num_layers=layers, bias=bias, bidirectional=bidi)
+    params = _torch_weights_to_params(tm, layers, bidi, bias)
+    out, hidden = model.apply({"params": params}, jnp.asarray(x))
+
+    np.testing.assert_allclose(np.asarray(out), t_out.numpy(),
+                               rtol=1e-5, atol=1e-5)
+    if kind == "lstm":
+        th, tc = t_hidden
+        np.testing.assert_allclose(np.asarray(hidden[0]), th.numpy(),
+                                   rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(hidden[1]), tc.numpy(),
+                                   rtol=1e-5, atol=1e-5)
+    else:
+        np.testing.assert_allclose(np.asarray(hidden[0]),
+                                   t_hidden.numpy(), rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("kind,tmode", [("relu", "RNN_RELU"),
+                                        ("tanh", "RNN_TANH")])
+def test_elementary_cells_torch_parity(kind, tmode):
+    tm = torch.nn.RNN(IN, H, num_layers=1,
+                      nonlinearity=kind, bias=True)
+    tm.eval()
+    x = np.random.RandomState(1).randn(T, B, IN).astype(np.float32)
+    with torch.no_grad():
+        t_out, t_h = tm(torch.from_numpy(x))
+
+    cls = ReLU if kind == "relu" else Tanh
+    model = cls(IN, H, num_layers=1)
+    params = _torch_weights_to_params(tm, 1, False, True)
+    out, hidden = model.apply({"params": params}, jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(out), t_out.numpy(),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(hidden[0]), t_h.numpy(),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_batch_first_and_hidden_roundtrip():
+    model = LSTM(IN, H, num_layers=2, batch_first=True)
+    x = jax.random.normal(jax.random.PRNGKey(0), (B, T, IN))
+    params = model.init(jax.random.PRNGKey(1), x)["params"]
+    out, (h, c) = model.apply({"params": params}, x)
+    assert out.shape == (B, T, H)
+    assert h.shape == (2, B, H) and c.shape == (2, B, H)
+    # continuing from the returned hidden == running the concat sequence
+    x2 = jax.random.normal(jax.random.PRNGKey(2), (B, T, IN))
+    out2, _ = model.apply({"params": params}, x2, hidden=(h, c))
+    out_full, _ = model.apply({"params": params},
+                              jnp.concatenate([x, x2], axis=1))
+    np.testing.assert_allclose(np.asarray(out2),
+                               np.asarray(out_full[:, T:]),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_mlstm_forward_matches_reference_math():
+    """mLSTM (cells.py:55-80): m = (x @ w_mih.T) * (h @ w_mhh.T), LSTM
+    gates on x and m — checked against a direct numpy transcription."""
+    model = mLSTM(IN, H, num_layers=1, bias=True)
+    x = jax.random.normal(jax.random.PRNGKey(0), (T, B, IN))
+    params = model.init(jax.random.PRNGKey(1), x)["params"]
+    out, (hT, cT) = model.apply({"params": params}, x)
+
+    p = {k: np.asarray(v) for k, v in params.items()}
+    h = np.zeros((B, H), np.float32)
+    c = np.zeros((B, H), np.float32)
+    xs = np.asarray(x)
+
+    def sig(v):
+        return 1.0 / (1.0 + np.exp(-v))
+
+    for t in range(T):
+        m = (xs[t] @ p["l0_w_mih"].T) * (h @ p["l0_w_mhh"].T)
+        gates = (xs[t] @ p["l0_w_ih"].T + p["l0_b_ih"]
+                 + m @ p["l0_w_hh"].T + p["l0_b_hh"])
+        i, f, g, o = np.split(gates, 4, axis=-1)
+        c = sig(f) * c + sig(i) * np.tanh(g)
+        h = sig(o) * np.tanh(c)
+        np.testing.assert_allclose(np.asarray(out[t]), h,
+                                   rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(hT[0]), h, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(cT[0]), c, rtol=1e-5, atol=1e-5)
+
+
+def test_output_size_projection():
+    """RNNCell's w_ho path (RNNBackend.py:361-363): the recurrent state is
+    the *projected* output, so w_hh consumes output_size features."""
+    model = LSTM(IN, H, num_layers=1, output_size=4)
+    x = jax.random.normal(jax.random.PRNGKey(0), (T, B, IN))
+    params = model.init(jax.random.PRNGKey(1), x)["params"]
+    assert params["l0_w_ho"].shape == (4, H)
+    assert params["l0_w_hh"].shape == (4 * H, 4)
+    out, (h, c) = model.apply({"params": params}, x)
+    assert out.shape == (T, B, 4)
+    assert h.shape == (1, B, 4) and c.shape == (1, B, H)
+
+
+def test_trains_under_jit():
+    """The whole stack is differentiable through the scan and trains."""
+    model = GRU(IN, H, num_layers=2, dropout=0.1)
+    x = jax.random.normal(jax.random.PRNGKey(0), (T, B, IN))
+    y = jnp.roll(x, 1, axis=0)  # memorize-previous-input task
+    variables = model.init(jax.random.PRNGKey(1), x)
+    params = variables["params"]
+
+    head = jax.random.normal(jax.random.PRNGKey(2), (H, IN)) * 0.1
+
+    @jax.jit
+    def step(params, head, key):
+        def loss_fn(params, head):
+            out, _ = model.apply(
+                {"params": params}, x, deterministic=False,
+                rngs={"dropout": key})
+            return jnp.mean((out @ head - y) ** 2)
+
+        loss, grads = jax.value_and_grad(loss_fn, argnums=(0, 1))(
+            params, head)
+        params = jax.tree_util.tree_map(
+            lambda p, g: p - 0.2 * g, params, grads[0])
+        return params, head - 0.2 * grads[1], loss
+
+    losses = []
+    key = jax.random.PRNGKey(3)
+    for i in range(300):
+        key, k = jax.random.split(key)
+        params, head, loss = step(params, head, k)
+        losses.append(float(loss))
+    # the wrapped roll target makes t=0 unlearnable (causal RNN), so the
+    # loss has a floor; 300 sgd steps reliably reach ~0.58x of init
+    assert losses[-1] < losses[0] * 0.7, losses[::50]
