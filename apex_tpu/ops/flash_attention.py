@@ -75,8 +75,12 @@ __all__ = [
     "dkv_chunk",
 ]
 
-DEFAULT_BLOCK_Q = 256
-DEFAULT_BLOCK_K = 512
+# Block-size defaults, overridable per-process for hardware sweeps
+# (examples/tune_flash_blocks.py runs each grid point in a subprocess).
+import os as _os
+
+DEFAULT_BLOCK_Q = int(_os.environ.get("APEX_TPU_FLASH_BLOCK_Q", "256"))
+DEFAULT_BLOCK_K = int(_os.environ.get("APEX_TPU_FLASH_BLOCK_K", "512"))
 NEG_INF = -1e30
 _LANES = 128   # TPU lane count: minor-dim tile
 _SUBLANES = 8  # fp32 sublane tile

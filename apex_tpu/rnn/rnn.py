@@ -101,10 +101,12 @@ class RNN(nn.Module):
                                    self.param_dtype)
             p["b_hh"] = self.param(f"{name}_b_hh", u, (gm * h,),
                                    self.param_dtype)
-        if self.cell == "mlstm":  # cells.py:17-44 multiplicative path
-            p["w_mih"] = self.param(f"{name}_w_mih", u, (h, in_size),
+        if self.cell == "mlstm":
+            # cells.py:20-22: w_mih [out, in], w_mhh [out, out] — the
+            # multiplicative intermediate m is *output_size*-dimensional
+            p["w_mih"] = self.param(f"{name}_w_mih", u, (out, in_size),
                                     self.param_dtype)
-            p["w_mhh"] = self.param(f"{name}_w_mhh", u, (h, out),
+            p["w_mhh"] = self.param(f"{name}_w_mhh", u, (out, out),
                                     self.param_dtype)
         if self.output_size is not None and self.output_size != h:
             p["w_ho"] = self.param(f"{name}_w_ho", u, (self.output_size, h),
@@ -122,15 +124,21 @@ class RNN(nn.Module):
             b = (jnp.asarray(p["b_ih"], dt) + jnp.asarray(p["b_hh"], dt))
         x = jnp.flip(x, axis=0) if reverse else x
 
+        # The whole input projection in one hoisted GEMM; per-cell bias
+        # placement: GRU keeps b_ih separate from b_hh (the reset gate
+        # multiplies b_hh's n-slice but not b_ih's), mLSTM folds both
+        # into the gate sum later, the rest fold the combined bias here.
+        xm = None
         if self.cell == "mlstm":
             w_mih = jnp.asarray(p["w_mih"], dt)
             w_mhh = jnp.asarray(p["w_mhh"], dt)
-            xm = x @ w_mih.T        # hoisted: [T, B, h]
+            xm = x @ w_mih.T        # hoisted: [T, B, out]
             xg = x @ w_ih.T         # hoisted input gates
+        elif self.cell == "gru":
+            xg = x @ w_ih.T + (jnp.asarray(p["b_ih"], dt)
+                               if self.bias else 0.0)
         else:
-            # the whole input projection in one GEMM, outside the scan
             xg = x @ w_ih.T + b
-            xm = None
 
         w_ho = p.get("w_ho")
         if w_ho is not None:
@@ -158,8 +166,7 @@ class RNN(nn.Module):
                 # r,z,n order (torch/GRUCell parity; RNNBackend GRUCell)
                 gh = h @ w_hh.T + (jnp.asarray(p["b_hh"], dt)
                                    if self.bias else 0.0)
-                gi = inp  # already has b_ih folded? no: fold separately
-                ir, iz, in_ = jnp.split(gi, 3, axis=-1)
+                ir, iz, in_ = jnp.split(inp, 3, axis=-1)
                 hr, hz, hn = jnp.split(gh, 3, axis=-1)
                 r = jax.nn.sigmoid(ir + hr)
                 z = jax.nn.sigmoid(iz + hz)
@@ -170,12 +177,6 @@ class RNN(nn.Module):
                 h = act(inp + h @ w_hh.T)
             out = project(h)
             return (out,), out
-
-        if cell == "gru":
-            # keep b_ih separate from b_hh (the reset gate multiplies
-            # b_hh's n-slice but not b_ih's)
-            xg = x @ w_ih.T + (jnp.asarray(p["b_ih"], dt)
-                               if self.bias else 0.0)
 
         xs = (xg, xm) if cell == "mlstm" else xg
         carry, ys = lax.scan(step, h0, xs)

@@ -149,6 +149,43 @@ def test_output_size_projection():
     assert h.shape == (1, B, 4) and c.shape == (1, B, H)
 
 
+def test_mlstm_output_size_projection():
+    """mLSTM + w_ho: the reference sizes w_mih/w_mhh/w_hh by *output_size*
+    (RNNBackend.py:258, cells.py:20-22) — m is output_size-dimensional."""
+    model = mLSTM(IN, H, num_layers=1, output_size=4)
+    x = jax.random.normal(jax.random.PRNGKey(0), (T, B, IN))
+    params = model.init(jax.random.PRNGKey(1), x)["params"]
+    assert params["l0_w_mih"].shape == (4, IN)
+    assert params["l0_w_mhh"].shape == (4, 4)
+    assert params["l0_w_hh"].shape == (4 * H, 4)
+    out, (h, c) = model.apply({"params": params}, x)
+    assert out.shape == (T, B, 4)
+    assert h.shape == (1, B, 4) and c.shape == (1, B, H)
+    assert bool(jnp.all(jnp.isfinite(out)))
+
+
+def test_amp_compute_dtype():
+    """The amp-policy contract (COVERAGE row 7): fp32 params, bf16
+    compute/output — the module casts at its boundary like every flax
+    module under the O1/O2 policies."""
+    from apex_tpu import amp
+
+    policy = amp.policy("O1")  # bf16 compute, fp32 params
+    model = LSTM(IN, H, num_layers=1, dtype=policy.compute_dtype)
+    x = jax.random.normal(jax.random.PRNGKey(0), (T, B, IN))
+    params = model.init(jax.random.PRNGKey(1), x)["params"]
+    assert params["l0_w_ih"].dtype == jnp.float32  # storage stays fp32
+    out, (h, c) = model.apply({"params": params}, x)
+    assert out.dtype == jnp.bfloat16
+    assert h.dtype == jnp.bfloat16
+
+    # gradients flow (through the bf16 scan) back to fp32 params
+    g = jax.grad(lambda p: jnp.sum(
+        model.apply({"params": p}, x)[0].astype(jnp.float32)))(params)
+    assert g["l0_w_ih"].dtype == jnp.float32
+    assert bool(jnp.all(jnp.isfinite(g["l0_w_ih"])))
+
+
 def test_trains_under_jit():
     """The whole stack is differentiable through the scan and trains."""
     model = GRU(IN, H, num_layers=2, dropout=0.1)
