@@ -87,8 +87,14 @@ def update_meta(meta: Fp8Meta, amax_now, dtype=E4M3,
     ``axis``: model-parallel mesh axis to ``pmax`` the amax over before it
     enters the history — the reference's amax-sharing group
     (``parallel_state`` amax groups) as one collective.
+
+    The update is pure bookkeeping, never a gradient path: the input is
+    ``stop_gradient``-ed so the ``pmax`` (which has no differentiation
+    rule) sees a symbolic-zero tangent when the surrounding train step is
+    differentiated with the new metas as aux outputs.
     """
-    amax_now = jnp.asarray(amax_now, jnp.float32).reshape(())
+    amax_now = jax.lax.stop_gradient(
+        jnp.asarray(amax_now, jnp.float32).reshape(()))
     if axis is not None:
         amax_now = jax.lax.pmax(amax_now, axis)
     hist = jnp.concatenate([amax_now[None],

@@ -230,6 +230,7 @@ def _train_gpt(cfg, tokens, steps=10, seed=0):
     return losses, fp8_state
 
 
+@pytest.mark.slow
 def test_fp8_gpt_trains():
     """e2e: TransformerConfig(fp8=True) routes the four transformer-layer
     GEMMs through fp8_matmul_t; the model trains (loss decreases), tracks
@@ -256,6 +257,7 @@ def test_fp8_gpt_trains():
     assert all(float(m.scale) != 1.0 for m in leaves)
 
 
+@pytest.mark.slow
 def test_fp8_gpt_inference_without_mutable():
     """Plain apply() (no mutable) must work for eval/serving: the delayed
     scales are read but not rolled (r3 review finding — _fp8_roll used to
@@ -274,6 +276,7 @@ def test_fp8_gpt_inference_without_mutable():
     assert bool(jnp.all(jnp.isfinite(logits.astype(jnp.float32))))
 
 
+@pytest.mark.slow
 def test_fp8_gpt_tp_amax_sharing():
     """Under tp=2 the per-rank amaxes are pmax-shared over the tensor axis
     (the reference's amax groups): every rank ends with identical delayed
@@ -323,5 +326,30 @@ def test_fp8_gpt_tp_amax_sharing():
             np.testing.assert_allclose(arr[0], arr[1], rtol=0, atol=0,
                                        err_msg=str(path))
             assert arr[0] != 1.0  # the scale really updated
+
+        # and the *training* path differentiates: the amax pmax is pure
+        # bookkeeping (stop_gradient inside update_meta), so grad through
+        # the step with the rolled metas as aux must work (r3 dryrun
+        # regression: 'Differentiation rule for pmax not implemented')
+        def train_local(params, fp8_state, tokens):
+            def loss_fn(p):
+                losses, mut = model.apply(
+                    {"params": p, "fp8_meta": fp8_state}, tokens,
+                    labels=tokens, mutable=["fp8_meta"])
+                return jax.lax.pmean(jnp.mean(losses), "tp"), (
+                    dict(mut)["fp8_meta"])
+
+            (loss, new_meta), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            return loss, grads
+
+        loss, grads = cc.shard_over(
+            train_local,
+            in_specs=(param_specs, meta_specs, P()),
+            out_specs=(P(), param_specs),
+        )(variables["params"], variables["fp8_meta"], tokens)
+        assert np.isfinite(float(loss))
+        assert all(bool(jnp.all(jnp.isfinite(g)))
+                   for g in jax.tree_util.tree_leaves(grads))
     finally:
         parallel.destroy_model_parallel()
