@@ -32,6 +32,7 @@ import numpy as np
 
 __all__ = [
     "save_checkpoint",
+    "save_checkpoint_async",
     "restore_checkpoint",
     "gather_zero_state",
     "scatter_zero_state",
@@ -61,6 +62,44 @@ def _leaf_to_host(x) -> np.ndarray:
     return np.asarray(jax.device_get(x))
 
 
+def _snapshot(tree, step, copy_host_leaves=False):
+    """Fetch every leaf to host (D2H; collective for cross-process shards)
+    and build the restore-time manifest.
+
+    ``copy_host_leaves``: leaves that are *already* host numpy arrays come
+    back as zero-copy views from ``device_get``; the async save needs real
+    copies so a caller mutating such a leaf in place cannot corrupt the
+    snapshot before the background write lands (device-backed leaves are
+    fresh host buffers either way and are never re-copied).
+    """
+    flat = jax.tree_util.tree_leaves_with_path(tree)
+
+    def to_host(x):
+        if copy_host_leaves and isinstance(x, np.ndarray):
+            return np.array(x)
+        return _leaf_to_host(x)
+
+    arrays = {f"leaf_{i}": to_host(x) for i, (_, x) in enumerate(flat)}
+    manifest = {
+        "version": 1,
+        "step": step,
+        "leaves": [
+            {"path": _path_str(p), "shape": list(arrays[f"leaf_{i}"].shape),
+             "dtype": str(arrays[f"leaf_{i}"].dtype)}
+            for i, (p, _) in enumerate(flat)
+        ],
+    }
+    return arrays, manifest
+
+
+def _write_npz(path, manifest, arrays) -> str:
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        np.savez(f, __manifest__=json.dumps(manifest), **arrays)
+    os.replace(tmp, path)  # atomic: no torn checkpoints on preemption
+    return path
+
+
 def save_checkpoint(path: str, tree: Any, step: Optional[int] = None) -> None:
     """Write ``tree`` (any pytree of arrays/scalars) to ``path`` (.npz).
 
@@ -75,28 +114,40 @@ def save_checkpoint(path: str, tree: Any, step: Optional[int] = None) -> None:
     hosts can read (NFS / GCS-fuse / single-host tests) — rank-0-local
     storage leaves other ranks unable to ``restore_checkpoint``.
     """
-    flat = jax.tree_util.tree_leaves_with_path(tree)
-    arrays = {f"leaf_{i}": _leaf_to_host(x)
-              for i, (_, x) in enumerate(flat)}
-    manifest = {
-        "version": 1,
-        "step": step,
-        "leaves": [
-            {"path": _path_str(p), "shape": list(arrays[f"leaf_{i}"].shape),
-             "dtype": str(arrays[f"leaf_{i}"].dtype)}
-            for i, (p, _) in enumerate(flat)
-        ],
-    }
+    arrays, manifest = _snapshot(tree, step)
     multi = jax.process_count() > 1
     if not multi or jax.process_index() == 0:
-        tmp = path + ".tmp"
-        with open(tmp, "wb") as f:
-            np.savez(f, __manifest__=json.dumps(manifest), **arrays)
-        os.replace(tmp, path)  # atomic: no torn checkpoints on preemption
+        _write_npz(path, manifest, arrays)
     if multi:
         from jax.experimental import multihost_utils
 
         multihost_utils.sync_global_devices(f"save_checkpoint:{path}")
+
+
+def save_checkpoint_async(path: str, tree: Any,
+                          step: Optional[int] = None):
+    """Overlapped checkpointing: fetch-to-host happens on the caller's
+    thread (device buffers are released as soon as the copies land — the
+    next train step can donate/overwrite them safely), while the
+    serialization + disk write runs on a background thread.
+
+    Returns a handle with ``result()`` (wait; re-raises write errors) and
+    ``done()``.  Call ``result()`` before shutdown or the next save to the
+    same path.  Single-process only: the multi-host collective gather of
+    :func:`save_checkpoint` must run synchronously on every rank.
+    """
+    if jax.process_count() > 1:
+        raise ValueError(
+            "save_checkpoint_async is single-process; multi-host saves "
+            "need the collective gather of save_checkpoint")
+    import concurrent.futures
+
+    # sync D2H (host-numpy leaves copied), then async IO
+    arrays, manifest = _snapshot(tree, step, copy_host_leaves=True)
+    pool = concurrent.futures.ThreadPoolExecutor(max_workers=1)
+    future = pool.submit(_write_npz, path, manifest, arrays)
+    pool.shutdown(wait=False)
+    return future
 
 
 def restore_checkpoint(path: str, like: Any):

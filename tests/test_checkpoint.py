@@ -84,6 +84,30 @@ def test_roundtrip_bit_exact_resume(tmp_path):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+def test_async_save_roundtrip(tmp_path):
+    """save_checkpoint_async: the device state may be donated/overwritten
+    immediately after the call (D2H completes synchronously); the write
+    completes in the background and restores bit-exact."""
+    from apex_tpu.checkpoint import restore_checkpoint, save_checkpoint_async
+
+    path = str(tmp_path / "async.npz")
+    host_counter = np.arange(4)  # host-numpy leaf (e.g. consumed_samples)
+    tree = {"w": jnp.arange(8.0), "counter": host_counter}
+    fut = save_checkpoint_async(path, tree, step=7)
+    # mutate the sources immediately: the snapshot must not see it —
+    # including *in-place* mutation of the host-numpy leaf (zero-copy
+    # aliasing hazard, r3 review finding)
+    tree["w"] = tree["w"] + 100.0
+    host_counter += 50
+    assert fut.result(timeout=30) == path
+    restored, step = restore_checkpoint(path, like=tree)
+    assert step == 7
+    np.testing.assert_array_equal(np.asarray(restored["w"]),
+                                  np.arange(8.0))
+    np.testing.assert_array_equal(np.asarray(restored["counter"]),
+                                  np.arange(4))
+
+
 def test_restore_mismatch_raises(tmp_path):
     tree = {"a": jnp.ones((3,)), "b": jnp.zeros((2, 2))}
     save_checkpoint(str(tmp_path / "c.npz"), tree)
