@@ -66,18 +66,25 @@ def _snapshot(tree, step, copy_host_leaves=False):
     """Fetch every leaf to host (D2H; collective for cross-process shards)
     and build the restore-time manifest.
 
-    ``copy_host_leaves``: leaves that are *already* host numpy arrays come
-    back as zero-copy views from ``device_get``; the async save needs real
-    copies so a caller mutating such a leaf in place cannot corrupt the
-    snapshot before the background write lands (device-backed leaves are
-    fresh host buffers either way and are never re-copied).
+    ``copy_host_leaves``: ``device_get`` returns zero-copy *views* for
+    leaves whose backing store is host memory — numpy leaves and
+    CPU-backend ``jax.Array``s alike.  The async save needs real copies so
+    in-place mutation or jit buffer *donation* after the call cannot
+    corrupt the snapshot before the background write lands.  Leaves on an
+    accelerator already get a fresh host buffer from the transfer and are
+    never re-copied.
     """
     flat = jax.tree_util.tree_leaves_with_path(tree)
 
+    def on_accelerator(x):
+        return (isinstance(x, jax.Array)
+                and all(d.platform != "cpu" for d in x.devices()))
+
     def to_host(x):
-        if copy_host_leaves and isinstance(x, np.ndarray):
-            return np.array(x)
-        return _leaf_to_host(x)
+        host = _leaf_to_host(x)
+        if copy_host_leaves and not on_accelerator(x):
+            return np.array(host)
+        return host
 
     arrays = {f"leaf_{i}": to_host(x) for i, (_, x) in enumerate(flat)}
     manifest = {

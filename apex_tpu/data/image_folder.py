@@ -196,6 +196,24 @@ class ImageFolderLoader:
     def consumed_samples(self) -> int:
         return self.samplers[0].consumed_samples
 
+    def close(self) -> None:
+        """Shut down the decode thread pool (idempotent).  Loaders are
+        also context managers; without either, the pool's threads live
+        for the rest of the process."""
+        self._pool.shutdown(wait=False)
+
+    def __enter__(self) -> "ImageFolderLoader":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def __del__(self):  # best-effort backstop
+        try:
+            self._pool.shutdown(wait=False)
+        except Exception:
+            pass
+
     def _decode(self, index: int) -> Tuple[np.ndarray, int]:
         img, label = self.dataset.load(index)
         if self.train:

@@ -188,6 +188,17 @@ class RNN(nn.Module):
         if self.cell not in _GATE_MULT:
             raise ValueError(f"unknown cell {self.cell!r}; one of "
                              f"{sorted(_GATE_MULT)}")
+        if (self.cell == "gru" and self.output_size is not None
+                and self.output_size != self.hidden_size):
+            # The GRU update h' = (1-z)*n + z*h convex-combines the
+            # hidden-width candidate n with the carried state; a projected
+            # (output_size-width) carry makes that ill-defined — the
+            # reference's GRUCell would crash on the same shapes.
+            raise ValueError(
+                "GRU does not support output_size != hidden_size (the "
+                "update gate mixes the hidden-width candidate with the "
+                "carried state); use LSTM/mLSTM/ReLU/Tanh for w_ho "
+                "recurrent projection")
         if self.batch_first:
             x = jnp.swapaxes(x, 0, 1)
         x = jnp.asarray(x, self.dtype)

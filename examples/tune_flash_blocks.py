@@ -98,17 +98,17 @@ def run_point(block_q: int, block_k: int, seq: int, steps: int) -> None:
     jax.block_until_ready(st)
     dt = time.perf_counter() - t0
 
+    import bench  # repo-root module: the flops/peak tables live there
+
     tps = batch * seq * steps / dt
-    flops = (6.0 * n_params * batch * seq
-             + 12.0 * cfg.num_layers * cfg.hidden_size * batch * seq * seq
-             ) * steps / dt
-    peak = 197e12  # v5e bf16
+    flops = bench._lm_train_flops(cfg, n_params, batch, seq) * steps / dt
     rec = {
         "block_q": block_q, "block_k": block_k, "seq": seq,
         "batch": batch, "tokens_per_sec": round(tps, 1),
-        "mfu": round(flops / peak, 4) if on_tpu else None,
+        "mfu": round(flops / bench._peak_flops(dev), 4) if on_tpu else None,
         "compile_s": round(compile_s, 1),
         "platform": dev.platform,
+        "device_kind": getattr(dev, "device_kind", ""),
         "ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
     }
     print(json.dumps(rec), flush=True)

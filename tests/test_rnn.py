@@ -186,6 +186,15 @@ def test_amp_compute_dtype():
     assert bool(jnp.all(jnp.isfinite(g["l0_w_ih"])))
 
 
+def test_gru_output_size_rejected():
+    """GRU's convex update can't carry a projected state — clear error
+    instead of a trace-time broadcast crash (r3 review finding)."""
+    model = GRU(IN, H, num_layers=1, output_size=4)
+    x = jnp.zeros((T, B, IN))
+    with pytest.raises(ValueError, match="does not support output_size"):
+        model.init(jax.random.PRNGKey(0), x)
+
+
 def test_trains_under_jit():
     """The whole stack is differentiable through the scan and trains."""
     model = GRU(IN, H, num_layers=2, dropout=0.1)
