@@ -318,32 +318,40 @@ def bench_bert_large(jax, on_tpu):
     }
 
 
-def _gpt_flash_bench(jax, on_tpu, fp8: bool):
-    """Flagship GPT train-step bench; ``fp8=True`` threads the delayed-
-    scaling ``fp8_meta`` collection through the step (e4m3 GEMMs for
-    qkv/attn-out/fc1/fc2, e5m2 JIT cotangents — the fp8-vs-bf16 delta the
-    r2 VERDICT asked to put in the bench extras)."""
+def gpt_flash_setup(jax, on_tpu, seq=None, fp8=False):
+    """Build the flagship GPT-124M flash train step — the ONE definition
+    of the ``gpt_flash`` workload, shared by this bench, the block-size
+    sweep (``examples/tune_flash_blocks.py``), and the profiler
+    (``examples/profile_gpt.py``) so their configs cannot drift.
+
+    Returns ``(cfg, step, st0, batch, seq, n_params)`` where ``step`` is
+    the donated jitted train step and ``st0 = (params, opt_state,
+    fp8_state)`` its initial carry (``fp8_state`` is ``{}`` when ``fp8``
+    is off).  Batch policy: 8 up to seq 1024, token-budget-rescaled above.
+    """
     import jax.numpy as jnp
 
     from apex_tpu.optimizers import FusedAdam
     from apex_tpu.transformer.testing import GPTModel, TransformerConfig
 
     if on_tpu:
+        seq = seq or 1024
+        batch = 8 if seq <= 1024 else max(1, 8 * 1024 // seq)
         cfg = TransformerConfig(
             hidden_size=768, num_layers=12, num_attention_heads=12,
-            padded_vocab_size=50304, max_position_embeddings=1024,
+            padded_vocab_size=50304, max_position_embeddings=seq,
             hidden_dropout=0.0, attention_dropout=0.0, tensor_axis=None,
             use_flash_attention=True, dtype=jnp.bfloat16, fp8=fp8,
         )
-        batch, seq, steps = 8, 1024, 10
     else:
+        seq = min(seq or 128, 128)
+        batch = 2
         cfg = TransformerConfig(
             hidden_size=64, num_layers=2, num_attention_heads=4,
-            padded_vocab_size=512, max_position_embeddings=128,
+            padded_vocab_size=512, max_position_embeddings=seq,
             hidden_dropout=0.0, attention_dropout=0.0, tensor_axis=None,
             use_flash_attention=True, fp8=fp8,
         )
-        batch, seq, steps = 2, 128, 2
 
     model = GPTModel(cfg)
     tokens = jnp.zeros((batch, seq), jnp.int32)
@@ -370,10 +378,34 @@ def _gpt_flash_bench(jax, on_tpu, fp8: bool):
         params, state = opt.step(grads, state, params)
         return params, state, fp8_state
 
+    return cfg, step, (params, state, fp8_state), batch, seq, n_params
+
+
+def enable_compilation_cache(jax) -> None:
+    """Persistent XLA compilation cache shared by bench children and the
+    tuning/profiling harnesses (warm retries after timeouts/wedges)."""
+    try:
+        cache_dir = os.path.join(_REPO, "bench_results", ".xla_cache")
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception as e:
+        _log(f"compilation cache unavailable: {e!r}")
+
+
+def _gpt_flash_bench(jax, on_tpu, fp8: bool):
+    """Flagship GPT train-step bench; ``fp8=True`` threads the delayed-
+    scaling ``fp8_meta`` collection through the step (e4m3 GEMMs for
+    qkv/attn-out/fc1/fc2, e5m2 JIT cotangents — the fp8-vs-bf16 delta the
+    r2 VERDICT asked to put in the bench extras)."""
+    cfg, step, st, batch, seq, n_params = gpt_flash_setup(
+        jax, on_tpu, fp8=fp8)
+    steps = 10 if on_tpu else 2
+
     name = "gpt_flash_fp8" if fp8 else "gpt_flash"
     _log(f"{name}: compile start")
     t0 = time.perf_counter()
-    st = step(params, state, fp8_state)
+    st = step(*st)
     jax.block_until_ready(st)
     _log(f"{name}: compiled in {time.perf_counter() - t0:.1f}s; "
          f"timing {steps} steps")
@@ -671,13 +703,7 @@ def run_one(name: str) -> None:
         # Persistent compilation cache: a child killed mid-compile (900s
         # timeout) leaves its XLA work on disk, so the retry pass resumes
         # warm instead of recompiling from scratch.
-        try:
-            cache_dir = os.path.join(_REPO, "bench_results", ".xla_cache")
-            os.makedirs(cache_dir, exist_ok=True)
-            jax.config.update("jax_compilation_cache_dir", cache_dir)
-            jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-        except Exception as e:
-            _log(f"compilation cache unavailable: {e!r}")
+        enable_compilation_cache(jax)
     _log(f"{name}: initializing backend")
     t0 = time.perf_counter()
     dev = jax.devices()[0]

@@ -1,0 +1,79 @@
+"""Capture a jax.profiler trace of the flagship GPT train step on the
+attached chip (VERDICT r2 item 3: the MFU gap "needs a profile, not a
+guess").
+
+    python examples/profile_gpt.py [--seq 1024] [--steps 5]
+
+Writes a TensorBoard/XPlane trace directory under
+``bench_results/profiles/<stamp>/`` plus a one-line JSON summary of
+step time and MFU for the profiled configuration.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--seq", type=int, default=1024)
+    p.add_argument("--steps", type=int, default=5)
+    args = p.parse_args()
+
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS", "").lower() == "cpu":
+        from apex_tpu.utils.platform import pin_cpu
+
+        pin_cpu()
+
+    import bench
+
+    bench.enable_compilation_cache(jax)
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+
+    # exactly the bench/sweep workload (one shared definition, so the
+    # trace explains the numbers those harnesses record)
+    cfg, step, st, batch, seq, n_params = bench.gpt_flash_setup(
+        jax, on_tpu, seq=args.seq)
+
+    st = step(*st)  # compile + warm
+    st = step(*st)
+    jax.block_until_ready(st)
+
+    stamp = time.strftime("%Y%m%d_%H%M%S")
+    trace_dir = os.path.join(REPO, "bench_results", "profiles", stamp)
+    os.makedirs(trace_dir, exist_ok=True)
+    with jax.profiler.trace(trace_dir):
+        t0 = time.perf_counter()
+        for _ in range(args.steps):
+            st = step(*st)
+        jax.block_until_ready(st)
+        dt = time.perf_counter() - t0
+
+    flops = bench._lm_train_flops(cfg, n_params, batch, seq) * args.steps / dt
+    rec = {
+        "trace_dir": os.path.relpath(trace_dir, REPO),
+        "platform": dev.platform,
+        "device_kind": getattr(dev, "device_kind", ""),
+        "batch": batch, "seq": seq, "steps": args.steps,
+        "step_ms": round(dt / args.steps * 1e3, 2),
+        "tokens_per_sec": round(batch * seq * args.steps / dt, 1),
+        "mfu": round(flops / bench._peak_flops(dev), 4) if on_tpu else None,
+        "ts": stamp,
+    }
+    out = os.path.join(REPO, "bench_results", "profiles", "summary.jsonl")
+    with open(out, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+    print(json.dumps(rec))
+
+
+if __name__ == "__main__":
+    main()

@@ -29,66 +29,37 @@ OUT = os.path.join(REPO, "bench_results", "flash_block_sweep.jsonl")
 if REPO not in sys.path:  # runnable as `python examples/tune_flash_blocks.py`
     sys.path.insert(0, REPO)
 
+# jax's reference TPU flash kernel defaults to 128/128 (BlockSizes.
+# get_default, with an open TODO for a real heuristic); cover that corner
+# of the space as well as the larger tiles our defaults use.
 GRID_Q = (128, 256, 512)
-GRID_K = (256, 512, 1024)
+GRID_K = (128, 256, 512, 1024)
 
 
 def run_point(block_q: int, block_k: int, seq: int, steps: int) -> None:
-    """Child: one grid point — compile + time the gpt_flash train step."""
+    """Child: one grid point — compile + time the gpt_flash train step
+    (the exact workload of ``bench.gpt_flash_setup``, so sweep results
+    transfer 1:1 to the bench/profile numbers)."""
     import jax
-    import jax.numpy as jnp
-    from functools import partial
 
     if os.environ.get("JAX_PLATFORMS", "").lower() == "cpu":
         from apex_tpu.utils.platform import pin_cpu
 
         pin_cpu()
 
-    cache = os.path.join(REPO, "bench_results", ".xla_cache")
-    os.makedirs(cache, exist_ok=True)
-    jax.config.update("jax_compilation_cache_dir", cache)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    import bench
 
-    from apex_tpu.optimizers import FusedAdam
-    from apex_tpu.transformer.testing import GPTModel, TransformerConfig
-
+    bench.enable_compilation_cache(jax)
     dev = jax.devices()[0]
     on_tpu = dev.platform == "tpu"
     if not on_tpu:  # CPU smoke: tiny shapes, still exercises the plumbing
-        seq, steps = min(seq, 128), min(steps, 2)
+        steps = min(steps, 2)
 
-    cfg = TransformerConfig(
-        hidden_size=768 if on_tpu else 64,
-        num_layers=12 if on_tpu else 2,
-        num_attention_heads=12 if on_tpu else 4,
-        padded_vocab_size=50304 if on_tpu else 512,
-        max_position_embeddings=seq,
-        hidden_dropout=0.0, attention_dropout=0.0, tensor_axis=None,
-        use_flash_attention=True,
-        dtype=jnp.bfloat16 if on_tpu else jnp.float32,
-    )
-    batch = 8 if on_tpu else 2
-    if on_tpu and seq > 1024:
-        batch = max(1, 8 * 1024 // seq)
-
-    model = GPTModel(cfg)
-    tokens = jnp.zeros((batch, seq), jnp.int32)
-    params = model.init(jax.random.PRNGKey(0), tokens)["params"]
-    n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
-    opt = FusedAdam(lr=1e-4)
-    state = opt.init(params)
-
-    @partial(jax.jit, donate_argnums=(0, 1))
-    def step(params, state):
-        def loss_fn(p):
-            return jnp.mean(model.apply({"params": p}, tokens,
-                                        labels=tokens))
-        loss, grads = jax.value_and_grad(loss_fn)(params)
-        params, state = opt.step(grads, state, params)
-        return params, state
+    cfg, step, st, batch, seq, n_params = bench.gpt_flash_setup(
+        jax, on_tpu, seq=seq)
 
     t0 = time.perf_counter()
-    st = step(params, state)
+    st = step(*st)
     jax.block_until_ready(st)
     compile_s = time.perf_counter() - t0
 
@@ -97,8 +68,6 @@ def run_point(block_q: int, block_k: int, seq: int, steps: int) -> None:
         st = step(*st)
     jax.block_until_ready(st)
     dt = time.perf_counter() - t0
-
-    import bench  # repo-root module: the flops/peak tables live there
 
     tps = batch * seq * steps / dt
     flops = bench._lm_train_flops(cfg, n_params, batch, seq) * steps / dt
