@@ -52,11 +52,7 @@ def main():
     trace_dir = os.path.join(REPO, "bench_results", "profiles", stamp)
     os.makedirs(trace_dir, exist_ok=True)
     with jax.profiler.trace(trace_dir):
-        t0 = time.perf_counter()
-        for _ in range(args.steps):
-            st = step(*st)
-        jax.block_until_ready(st)
-        dt = time.perf_counter() - t0
+        dt, st = bench._timeit(jax, step, st, args.steps)
 
     flops = bench._lm_train_flops(cfg, n_params, batch, seq) * args.steps / dt
     rec = {

@@ -63,11 +63,7 @@ def run_point(block_q: int, block_k: int, seq: int, steps: int) -> None:
     jax.block_until_ready(st)
     compile_s = time.perf_counter() - t0
 
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        st = step(*st)
-    jax.block_until_ready(st)
-    dt = time.perf_counter() - t0
+    dt, _ = bench._timeit(jax, step, st, steps)
 
     tps = batch * seq * steps / dt
     flops = bench._lm_train_flops(cfg, n_params, batch, seq) * steps / dt

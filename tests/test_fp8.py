@@ -190,8 +190,9 @@ def test_fp8_matmul_t_matches_dense_math():
 def _gpt_cfg(**kw):
     from apex_tpu.transformer.testing import TransformerConfig
 
+    kw.setdefault("num_layers", 2)
     return TransformerConfig(
-        hidden_size=64, num_layers=2, num_attention_heads=4,
+        hidden_size=64, num_attention_heads=4,
         padded_vocab_size=256, max_position_embeddings=32,
         hidden_dropout=0.0, attention_dropout=0.0, **kw)
 
@@ -286,7 +287,9 @@ def test_fp8_gpt_tp_amax_sharing():
 
     parallel.initialize_model_parallel(tensor_model_parallel_size=2)
     try:
-        cfg = _gpt_cfg(fp8=True, tensor_axis="tp")
+        # one layer: the pmax-sharing property is per-GEMM; a second layer
+        # only doubles the (expensive) shard_map compiles
+        cfg = _gpt_cfg(fp8=True, tensor_axis="tp", num_layers=1)
         model = GPTModel(cfg)
         tokens = jax.random.randint(jax.random.PRNGKey(7), (2, 32), 0, 256)
 
