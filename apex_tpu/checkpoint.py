@@ -100,10 +100,30 @@ def _snapshot(tree, step, copy_host_leaves=False):
 
 
 def _write_npz(path, manifest, arrays) -> str:
-    tmp = path + ".tmp"
-    with open(tmp, "wb") as f:
-        np.savez(f, __manifest__=json.dumps(manifest), **arrays)
-    os.replace(tmp, path)  # atomic: no torn checkpoints on preemption
+    # Unique temp file in the target dir: concurrent saves to the same
+    # path cannot race on a shared temp name, and os.replace stays atomic
+    # (same filesystem) so there are no torn checkpoints on preemption.
+    import tempfile
+
+    fd, tmp = tempfile.mkstemp(
+        prefix=os.path.basename(path) + ".tmp.",
+        dir=os.path.dirname(os.path.abspath(path)) or ".")
+    try:
+        # mkstemp creates 0600; restore the umask-based mode a plain
+        # open() would have given so checkpoints stay group/other-readable
+        # per the operator's umask
+        umask = os.umask(0)
+        os.umask(umask)
+        os.fchmod(fd, 0o666 & ~umask)
+        with os.fdopen(fd, "wb") as f:
+            np.savez(f, __manifest__=json.dumps(manifest), **arrays)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
     return path
 
 
@@ -140,7 +160,11 @@ def save_checkpoint_async(path: str, tree: Any,
 
     Returns a handle with ``result()`` (wait; re-raises write errors) and
     ``done()``.  Call ``result()`` before shutdown or the next save to the
-    same path.  Single-process only: the multi-host collective gather of
+    same path (concurrent writes cannot corrupt each other — each uses a
+    unique temp file — but last-replace-wins makes the surviving file
+    ambiguous).  A write failure is also logged from the worker thread,
+    so it is not silent even when the caller drops the handle.
+    Single-process only: the multi-host collective gather of
     :func:`save_checkpoint` must run synchronously on every rank.
     """
     if jax.process_count() > 1:
@@ -151,8 +175,19 @@ def save_checkpoint_async(path: str, tree: Any,
 
     # sync D2H (host-numpy leaves copied), then async IO
     arrays, manifest = _snapshot(tree, step, copy_host_leaves=True)
+
+    def _write_logged():
+        try:
+            return _write_npz(path, manifest, arrays)
+        except BaseException:
+            import logging
+
+            logging.getLogger(__name__).exception(
+                "async checkpoint write to %r failed", path)
+            raise
+
     pool = concurrent.futures.ThreadPoolExecutor(max_workers=1)
-    future = pool.submit(_write_npz, path, manifest, arrays)
+    future = pool.submit(_write_logged)
     pool.shutdown(wait=False)
     return future
 

@@ -20,12 +20,20 @@ TPU-first differences:
   resume works for vision runs too (one sampler per dp rank, stacked into
   the global batch that ``dp_shard_batch`` lays onto the mesh);
 - decode parallelism is a thread pool (PIL decode releases the GIL), the
-  analog of ``DataLoader(num_workers=...)`` without worker processes.
+  analog of ``DataLoader(num_workers=...)`` without worker processes;
+- batches are decoded ``prefetch`` steps ahead: the loader keeps the
+  decode futures for the next batches in flight while the caller's train
+  step runs on device, so host decode overlaps device compute — the role
+  of the reference's ``DataLoader`` worker queue + ``data_prefetcher``
+  double-buffering (``main_amp.py:207-232,256-276``) without a CUDA
+  stream.  ``consumed_samples`` always reflects batches *yielded*, not
+  batches decoding ahead, so checkpoint resume stays exact.
 """
 
 from __future__ import annotations
 
 import os
+from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 from typing import Iterator, Optional, Sequence, Tuple
 
@@ -169,7 +177,7 @@ class ImageFolderLoader:
     def __init__(self, dataset: ImageFolder, local_batch: int,
                  data_parallel_size: int = 1, image_size: int = 224,
                  consumed_samples: int = 0, train: bool = True,
-                 workers: int = 8, seed: int = 0):
+                 workers: int = 8, seed: int = 0, prefetch: int = 2):
         from apex_tpu.transformer._data import (
             MegatronPretrainingRandomSampler,
         )
@@ -180,6 +188,8 @@ class ImageFolderLoader:
         self.image_size = image_size
         self.train = train
         self.seed = seed
+        self.prefetch = max(0, prefetch)
+        self._inflight = 0  # batches decoded/decoding ahead of the caller
         self._pool = ThreadPoolExecutor(max_workers=workers)
         self.samplers = [
             MegatronPretrainingRandomSampler(
@@ -194,7 +204,13 @@ class ImageFolderLoader:
 
     @property
     def consumed_samples(self) -> int:
-        return self.samplers[0].consumed_samples
+        """Samples in batches already *yielded* to the caller.  The
+        samplers themselves run ``prefetch`` batches ahead; in-flight
+        (decoding, not yet delivered) batches are subtracted so a
+        checkpoint taken mid-epoch resumes at the first undelivered
+        batch."""
+        return (self.samplers[0].consumed_samples
+                - self._inflight * self.local_batch * self.dp)
 
     def close(self) -> None:
         """Shut down the decode thread pool (idempotent).  Loaders are
@@ -214,25 +230,75 @@ class ImageFolderLoader:
         except Exception:
             pass
 
-    def _decode(self, index: int) -> Tuple[np.ndarray, int]:
+    def _decode(self, index: int, consumed_marker: int
+                ) -> Tuple[np.ndarray, int]:
         img, label = self.dataset.load(index)
         if self.train:
-            # fold the sample index into the seed: deterministic but
-            # different augmentation per sample and epoch
+            # fold the sample index + sampler position into the seed:
+            # deterministic but different augmentation per sample and
+            # epoch.  The position is captured at submission time so the
+            # augmentation stream is identical at every prefetch depth.
             rng = np.random.RandomState(
-                (self.seed + self.consumed_samples + index) % (2 ** 31))
+                (self.seed + consumed_marker + index) % (2 ** 31))
             arr = random_resized_crop(rng, img, self.image_size)
         else:
             arr = center_crop_resize(img, self.image_size)
         return arr, label
 
     def __iter__(self) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
-        for per_rank in zip(*self.samplers):
-            indices = [i for rank_ids in per_rank for i in rank_ids]
-            decoded = list(self._pool.map(self._decode, indices))
-            x = np.stack([d[0] for d in decoded])
-            y = np.asarray([d[1] for d in decoded], np.int32)
-            yield x, y
+        """Yield global batches, keeping ``prefetch`` future batches'
+        decode work in flight: the next batches decode on the pool while
+        the caller's train step occupies the device, and assembly at
+        ``next()`` normally just collects already-finished futures."""
+        sampler_it = zip(*self.samplers)
+        pending: deque = deque()
+        # this iterator's OWN in-flight count: two live iterators over one
+        # loader must each rewind only their own undelivered batches
+        mine = 0
+
+        def submit_next() -> bool:
+            nonlocal mine
+            per_rank = next(sampler_it, None)
+            if per_rank is None:
+                return False
+            # sampler position *after* drawing this batch — the seed the
+            # synchronous (prefetch=0) loader would have used
+            marker = self.samplers[0].consumed_samples
+            futs = [self._pool.submit(self._decode, i, marker)
+                    for rank_ids in per_rank for i in rank_ids]
+            pending.append(futs)
+            mine += 1
+            self._inflight += 1
+            return True
+
+        try:
+            while True:
+                # top up to prefetch batches beyond the one about to be
+                # assembled; prefetch=0 degenerates to the synchronous
+                # decode-at-next() behavior
+                while len(pending) < self.prefetch + 1:
+                    if not submit_next():
+                        break
+                if not pending:
+                    break
+                futs = pending.popleft()
+                decoded = [f.result() for f in futs]
+                x = np.stack([d[0] for d in decoded])
+                y = np.asarray([d[1] for d in decoded], np.int32)
+                mine -= 1
+                self._inflight -= 1
+                yield x, y
+        finally:
+            # abandoned iterator (break / exception): the undelivered
+            # batches will never be yielded — rewind the samplers so
+            # consumed_samples and a fresh __iter__ restart from the
+            # first undelivered batch.
+            for f in (f for futs in pending for f in futs):
+                f.cancel()
+            if mine:
+                for s in self.samplers:
+                    s.consumed_samples -= mine * self.local_batch * self.dp
+                self._inflight -= mine
 
 
 def synthetic_image_batches(batch_size: int, image_size: int,

@@ -79,8 +79,26 @@ __all__ = [
 # (examples/tune_flash_blocks.py runs each grid point in a subprocess).
 import os as _os
 
-DEFAULT_BLOCK_Q = int(_os.environ.get("APEX_TPU_FLASH_BLOCK_Q", "256"))
-DEFAULT_BLOCK_K = int(_os.environ.get("APEX_TPU_FLASH_BLOCK_K", "512"))
+
+def _env_block(name: str, default: int) -> int:
+    raw = _os.environ.get(name)
+    if raw is None:
+        return default
+    try:
+        val = int(raw)
+        if val <= 0:
+            raise ValueError(f"must be positive, got {val}")
+        return val
+    except ValueError as e:
+        import warnings
+
+        warnings.warn(f"ignoring {name}={raw!r} ({e}); "
+                      f"using default {default}")
+        return default
+
+
+DEFAULT_BLOCK_Q = _env_block("APEX_TPU_FLASH_BLOCK_Q", 256)
+DEFAULT_BLOCK_K = _env_block("APEX_TPU_FLASH_BLOCK_K", 512)
 NEG_INF = -1e30
 _LANES = 128   # TPU lane count: minor-dim tile
 _SUBLANES = 8  # fp32 sublane tile

@@ -1,15 +1,25 @@
-"""L1-style stored-baseline training traces.
+"""L1-style stored-baseline training traces — the cross-product matrix.
 
-Behavioral spec: ``tests/L1/common/run_test.sh`` + ``compare.py`` in the
-reference — instrumented training runs record per-iteration loss and
-gradient norms, and CI diffs them against checked-in baselines, which
-catches silent numerics regressions that "loss decreases" tests cannot.
+Behavioral spec: ``tests/L1/common/run_test.sh`` + ``compare.py`` and
+``tests/L1/cross_product/run.sh`` in the reference — instrumented training
+runs record per-iteration loss / gradient norms (and loss scale), and CI
+diffs them against checked-in baselines, which catches silent numerics
+regressions that "loss decreases" tests cannot.  The reference sweeps
+opt-level x keep-batchnorm x loss-scale; the TPU analog sweeps:
 
-Two deterministic smoke configs mirror the reference's L1 workloads:
-``rn50_smoke`` (ResNet-50-style conv net, O2 policy, FusedSGD — the
-imagenet config shrunk to smoke size) and ``gpt_smoke`` (standalone GPT,
-FusedAdam).  Synthetic data, fixed seeds, fp32 accumulation — traces are
-reproducible to fp tolerance across XLA releases on the same platform.
+- RN50: policy (O0 / O2 / O3) x loss scale (none / static 128 / dynamic)
+  x BatchNorm flavor (local BN / SyncBatchNorm over a bound dp axis) —
+  the reference's ``--opt-level O{0..3} [--keep-batchnorm-fp32]
+  [--loss-scale ...]`` matrix (``tests/L1/cross_product/run.sh``);
+- GPT: fp32 / bf16 / fp8 (delayed-scaling e4m3 GEMMs) — the transformer
+  numerics axis the reference's L1 suite covers with its BERT recipes.
+
+Synthetic data, fixed seeds, fp32 accumulation — traces are reproducible
+to fp tolerance across XLA releases on the same platform.  Dynamic-scale
+configs also record the per-iteration ``loss_scale`` series (growth
+events land inside the 10-iteration window via a small
+``growth_interval``), so a scaler-semantics regression shows up as a
+trace diff, not just an eventual loss drift.
 
 Regenerate baselines after an *intended* numerics change::
 
@@ -21,6 +31,7 @@ from __future__ import annotations
 import json
 import os
 import sys
+from functools import partial
 from typing import Dict, List
 
 import jax
@@ -38,13 +49,39 @@ def _global_grad_norm(grads) -> float:
     return float(jnp.sqrt(total))
 
 
-def _trace_rn50() -> Dict[str, List[float]]:
+def _make_scaler(kind):
     from apex_tpu import amp
+
+    if kind is None:
+        return None
+    if kind == "dynamic":
+        # growth_interval=4 puts two growth events inside the ITERS=10
+        # window, so the baseline trace pins the growth schedule too
+        return amp.DynamicLossScale(init_scale=2.0 ** 10, growth_interval=4)
+    return amp.StaticLossScale(float(kind))
+
+
+def _trace_rn50(policy_name: str = "O2", loss_scale=None,
+                sync_bn: bool = False) -> Dict[str, List[float]]:
+    """One RN50 cross-product cell.
+
+    ``loss_scale``: ``None`` (no scaling), ``"dynamic"`` or a float
+    (static).  ``sync_bn=True`` binds the dp axis over all attached
+    devices via shard_map (8 virtual CPU devices under the test/record
+    environment) with the batch sharded across it, so cross-replica
+    Welford psums are part of the traced numerics.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from apex_tpu import amp
+    from apex_tpu.amp.scaler import all_finite
     from apex_tpu.models import ResNet50
     from apex_tpu.optimizers import FusedSGD
+    from apex_tpu.parallel import collectives as cc, mesh as mesh_lib
 
-    policy = amp.policy("O2")
-    model = ResNet50(num_classes=10, axis_name=None,
+    policy = amp.policy(policy_name)
+    scaler = _make_scaler(loss_scale)
+    model = ResNet50(num_classes=10, axis_name="dp" if sync_bn else None,
                      dtype=policy.compute_dtype)
     rng = np.random.RandomState(0)
     x = jnp.asarray(rng.randn(8, 32, 32, 3), jnp.float32)
@@ -55,62 +92,138 @@ def _trace_rn50() -> Dict[str, List[float]]:
     opt = FusedSGD(lr=0.005, momentum=0.9, weight_decay=1e-4,
                    master_weights=policy.master_weights)
     state = opt.init(params)
+    sstate = scaler.init() if scaler else None
 
-    def loss_fn(p, stats):
+    def forward(p, stats, x, y):
         logits, mut = model.apply(
             {"params": p, "batch_stats": stats},
             policy.cast_to_compute(x), train=True,
             mutable=["batch_stats"])
         logp = jax.nn.log_softmax(logits)
-        return -jnp.mean(logp[jnp.arange(8), y]), mut["batch_stats"]
+        n = y.shape[0]
+        return -jnp.mean(logp[jnp.arange(n), y]), mut["batch_stats"]
 
-    @jax.jit
-    def step(p, stats, state):
-        (loss, stats), grads = jax.value_and_grad(
-            loss_fn, has_aux=True)(p, stats)
-        p, state = opt.step(grads, state, p)
-        return p, stats, state, loss, grads
+    def local_step(p, stats, state, sstate, x, y):
+        def scaled_loss(p, stats):
+            loss, new_stats = forward(p, stats, x, y)
+            if sync_bn:
+                loss = jax.lax.pmean(loss, "dp")
+            scaled = scaler.scale(loss, sstate) if scaler else loss
+            return scaled, (loss, new_stats)
 
-    losses, gnorms = [], []
-    for _ in range(ITERS):
-        params, stats, state, loss, grads = step(params, stats, state)
-        losses.append(float(loss))
-        gnorms.append(_global_grad_norm(grads))
-    return {"loss": losses, "grad_norm": gnorms}
+        grads, (loss, new_stats) = jax.grad(
+            scaled_loss, has_aux=True)(p, stats)
+        if sync_bn:
+            grads = jax.tree_util.tree_map(
+                lambda g: jax.lax.pmean(g, "dp"), grads)
+        if scaler:
+            finite = all_finite(grads)
+            p2, state2 = opt.step(grads, state, p,
+                                  grad_scale=sstate.scale,
+                                  skip_update=~finite)
+            sstate2 = scaler.update(sstate, finite)
+            gnorm_grads = scaler.unscale(grads, sstate)
+        else:
+            p2, state2 = opt.step(grads, state, p)
+            sstate2 = sstate
+            gnorm_grads = grads
+        return p2, new_stats, state2, sstate2, loss, gnorm_grads
+
+    if sync_bn:
+        mesh = mesh_lib.initialize_model_parallel()
+        rep = lambda tree: jax.tree_util.tree_map(  # noqa: E731
+            lambda _: P(), tree)
+        dspec = P(("dcn", "dp"))
+
+        def step_fn(p, stats, state, sstate, x, y):
+            return cc.shard_over(
+                local_step, mesh=mesh,
+                in_specs=(rep(p), rep(stats), rep(state), rep(sstate),
+                          dspec, dspec),
+                out_specs=(rep(p), rep(stats), rep(state), rep(sstate),
+                           P(), rep(p)),
+            )(p, stats, state, sstate, x, y)
+
+        step = jax.jit(step_fn)
+    else:
+        step = jax.jit(local_step)
+
+    try:
+        out: Dict[str, List[float]] = {"loss": [], "grad_norm": []}
+        if scaler:
+            out["loss_scale"] = []
+        for _ in range(ITERS):
+            params, stats, state, sstate, loss, grads = step(
+                params, stats, state, sstate, x, y)
+            out["loss"].append(float(loss))
+            out["grad_norm"].append(_global_grad_norm(grads))
+            if scaler:
+                out["loss_scale"].append(float(sstate.scale))
+        return out
+    finally:
+        if sync_bn:
+            mesh_lib.destroy_model_parallel()
 
 
-def _trace_gpt() -> Dict[str, List[float]]:
+def _trace_gpt(dtype=None, fp8: bool = False) -> Dict[str, List[float]]:
     from apex_tpu.optimizers import FusedAdam
     from apex_tpu.transformer.testing import GPTModel, TransformerConfig
 
+    kw = {} if dtype is None else {"dtype": dtype}
     cfg = TransformerConfig(
         hidden_size=64, num_layers=2, num_attention_heads=4,
         padded_vocab_size=128, max_position_embeddings=32,
-        hidden_dropout=0.0, attention_dropout=0.0, tensor_axis=None)
+        hidden_dropout=0.0, attention_dropout=0.0, tensor_axis=None,
+        fp8=fp8, **kw)
     model = GPTModel(cfg)
     tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 32), 0, 128)
-    params = model.init(jax.random.PRNGKey(2), tokens)["params"]
+    variables = model.init(jax.random.PRNGKey(2), tokens)
+    params = variables["params"]
+    fp8_state = dict(variables.get("fp8_meta", {}))
     opt = FusedAdam(lr=1e-3)
     state = opt.init(params)
 
     @jax.jit
-    def step(p, state):
-        def loss_fn(p):
-            return jnp.mean(model.apply({"params": p}, tokens,
-                                        labels=tokens))
-        loss, grads = jax.value_and_grad(loss_fn)(p)
+    def step(p, state, fp8_state):
+        def loss_fn(p, fp8_state):
+            if not fp8_state:
+                return jnp.mean(model.apply({"params": p}, tokens,
+                                            labels=tokens)), fp8_state
+            losses, mut = model.apply(
+                {"params": p, "fp8_meta": fp8_state}, tokens,
+                labels=tokens, mutable=["fp8_meta"])
+            return jnp.mean(losses), dict(mut)["fp8_meta"]
+
+        (loss, fp8_state), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(p, fp8_state)
         p, state = opt.step(grads, state, p)
-        return p, state, loss, grads
+        return p, state, fp8_state, loss, grads
 
     losses, gnorms = [], []
     for _ in range(ITERS):
-        params, state, loss, grads = step(params, state)
+        params, state, fp8_state, loss, grads = step(
+            params, state, fp8_state)
         losses.append(float(loss))
         gnorms.append(_global_grad_norm(grads))
     return {"loss": losses, "grad_norm": gnorms}
 
 
-CONFIGS = {"rn50_smoke": _trace_rn50, "gpt_smoke": _trace_gpt}
+CONFIGS = {
+    # original two smoke configs (unchanged numerics, baselines kept)
+    "rn50_smoke": partial(_trace_rn50, "O2", None, False),
+    "gpt_smoke": partial(_trace_gpt),
+    # RN50 policy x loss-scale x BN cross-product
+    # (tests/L1/cross_product/run.sh analog)
+    "rn50_O0": partial(_trace_rn50, "O0", None, False),
+    "rn50_O2_static128": partial(_trace_rn50, "O2", 128.0, False),
+    "rn50_O2_dynamic": partial(_trace_rn50, "O2", "dynamic", False),
+    "rn50_O3": partial(_trace_rn50, "O3", None, False),
+    "rn50_O2_syncbn": partial(_trace_rn50, "O2", None, True),
+    "rn50_O2_dynamic_syncbn": partial(_trace_rn50, "O2", "dynamic", True),
+    # GPT numerics axis
+    "gpt_bf16": partial(_trace_gpt, jnp.bfloat16),
+    "gpt_fp8": partial(_trace_gpt, None, True),
+}
 
 
 def run_trace(name: str) -> Dict[str, List[float]]:
@@ -122,9 +235,14 @@ def compare_traces(got: Dict[str, List[float]],
                    loss_rtol: float = 1e-4,
                    grad_rtol: float = 1e-3) -> List[str]:
     """Per-iteration diff (reference ``tests/L1/common/compare.py``);
-    returns a list of mismatch descriptions (empty = pass)."""
+    returns a list of mismatch descriptions (empty = pass).  The
+    ``loss_scale`` series, when present, must match exactly — scaler
+    decisions are discrete."""
     problems = []
-    for key, rtol in (("loss", loss_rtol), ("grad_norm", grad_rtol)):
+    keys = [("loss", loss_rtol), ("grad_norm", grad_rtol)]
+    if "loss_scale" in baseline or "loss_scale" in got:
+        keys.append(("loss_scale", 0.0))
+    for key, rtol in keys:
         a, b = got.get(key, []), baseline.get(key, [])
         if len(a) != len(b):
             problems.append(f"{key}: {len(a)} iters vs baseline {len(b)}")
@@ -147,8 +265,9 @@ def _main(argv):
     pin_cpu()
     if len(argv) >= 1 and argv[0] == "record":
         outdir = argv[1] if len(argv) > 1 else "tests/L1/baselines"
+        names = argv[2:] or list(CONFIGS)
         os.makedirs(outdir, exist_ok=True)
-        for name in CONFIGS:
+        for name in names:
             trace = run_trace(name)
             path = os.path.join(outdir, f"{name}.json")
             with open(path, "w") as f:

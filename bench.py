@@ -44,6 +44,21 @@ whole round's TPU evidence even though the chip worked the same day):
   (``bench_results/.xla_cache``) so a bench killed mid-compile retries warm;
 - on child timeout the partial stderr breadcrumbs are logged, attributing
   the loss to backend-init vs compile vs run.
+
+Round-4 hardening (round-3 postmortem: BENCH_r03 was ``rc=124, parsed=null``
+— the CPU fallback suite had *finished* and the prior TPU record was sitting
+in memory, but the record was printed only at process exit and the internal
+deadline default of 2700 s exceeded the real driver window of ~2100 s, so
+the driver's kill mid-poll evaporated the evidence).  A record held in RAM
+is not a record:
+
+- **emit early, emit often**: a complete record line (embedding the newest
+  stamped prior TPU record) is printed the moment ``main()`` starts, then
+  re-printed after *every* bench result change; the driver parses the last
+  JSON line of the tail, so each emission supersedes the previous one and a
+  kill at any instant still leaves a parseable record behind;
+- the internal deadline default drops to 1800 s, safely inside the driver
+  window, so the epilogue normally runs before any kill anyway.
 """
 
 import json
@@ -527,13 +542,14 @@ def bench_tp_gpt(jax, on_tpu):
             )
             batch, seq, steps = 8, 1024, 10
         else:
+            # heads/hidden must split over tp (8 on the virtual CPU mesh)
             cfg = TransformerConfig(
-                hidden_size=64, num_layers=2, num_attention_heads=4,
+                hidden_size=128, num_layers=2, num_attention_heads=8,
                 padded_vocab_size=512, max_position_embeddings=64,
                 hidden_dropout=0.0, attention_dropout=0.0,
                 tensor_axis="tp", sequence_parallel=n > 1,
             )
-            batch, seq, steps = 2, 32, 2
+            batch, seq, steps = 2, 64, 2
 
         model = GPTModel(cfg)
         tokens = jnp.zeros((batch, seq), jnp.int32)
@@ -578,6 +594,7 @@ def bench_tp_gpt(jax, on_tpu):
         dt, _ = _timeit(jax, lambda p, s: step(p, s, tokens), st, steps)
 
         tps = batch * seq * steps / dt
+        on_cpu_mesh = jax.devices()[0].platform != "tpu" and n > 1
         rec = {
             "value": round(tps, 1),
             "unit": "tokens/sec",
@@ -585,19 +602,111 @@ def bench_tp_gpt(jax, on_tpu):
             "sequence_parallel": n > 1,
             "batch": batch,
             "seq": seq,
+            # exactly what this row measured (r3 VERDICT weak #5: no
+            # headline row whose collectives never execute)
+            "measured": (
+                "tp=%d shard_map step on a virtual %d-device CPU host "
+                "mesh: TP collectives (all-gather/reduce-scatter) "
+                "genuinely execute; step-time *shape* only, not TPU perf"
+                % (n, n) if on_cpu_mesh else
+                "tp=1 on the single attached chip: TP code path only, "
+                "zero TP collectives; multi-chip shardings validated by "
+                "dryrun_multichip + virtual-mesh scaling records" if n == 1
+                else "tp=%d on %d attached TPU chips" % (n, n)),
         }
-        if n == 1:
-            # VERDICT r2 weak #6: one attached chip makes this config
-            # exercise the TP *code path* but no TP collective; the
-            # multi-chip TP shardings are validated by the driver's
-            # dryrun_multichip and the tp-scaling records in
-            # bench_results/gpt_scaling_virtual_mesh.jsonl.
-            rec["note"] = ("tp=1 (single attached chip): TP code path "
-                           "only; collectives covered by dryrun_multichip "
-                           "+ virtual-mesh scaling records")
         return rec
     finally:
         parallel.mesh.destroy_model_parallel()
+
+
+def bench_input_pipeline(jax, on_tpu):
+    """Host input-pipeline throughput: images decoded+augmented per second
+    by ``ImageFolderLoader`` over a synthetic JPEG ImageFolder tree — the
+    "can the loader feed the chip?" number (the reference's flagship
+    recipe leans on DataLoader workers + DALI for this;
+    ``examples/imagenet/main_amp.py:207-232``).
+
+    Reported against the RN50 consumption rate (the round-2 TPU record's
+    2714 img/s/chip): ``vs_rn50_consumption > 1`` means decode outpaces
+    the chip, i.e. the real-data path is not input-bound.  Also reports
+    the overlapped stall per step — time ``next(loader)`` blocks a
+    consumer that sleeps an RN50-step's worth between batches."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+    from PIL import Image
+
+    from apex_tpu.data import ImageFolder, ImageFolderLoader
+
+    # enough images that several batches fit per epoch: the pipeline
+    # drains at epoch boundaries (by design), so a 1-batch epoch would
+    # measure un-overlapped decode, not steady-state prefetch
+    n_classes, per_class = 4, 128 if not on_tpu else 512
+    side = 300  # ~typical resized ImageNet shard JPEG
+    # consumption rate to beat: the newest stamped TPU headline (falls
+    # back to the adopted A100 baseline if no TPU record exists yet)
+    prior = _newest_prior_tpu_record()
+    if prior and prior["record"].get("headline", {}).get("value"):
+        rn50_rate = float(prior["record"]["headline"]["value"])
+        rate_src = prior["path"]
+    else:
+        rn50_rate = adopted_baseline()
+        rate_src = "BASELINE.json adopted (no stamped TPU record)"
+    root = tempfile.mkdtemp(prefix="bench_jpegs_")
+    try:
+        rng = np.random.RandomState(0)
+        for c in range(n_classes):
+            d = os.path.join(root, f"class_{c}")
+            os.makedirs(d)
+            for i in range(per_class):
+                arr = rng.randint(0, 256, (side, side, 3), dtype=np.uint8)
+                Image.fromarray(arr).save(
+                    os.path.join(d, f"{i}.jpg"), quality=90)
+
+        batch = 256 if on_tpu else 128  # >= 4 batches per epoch either way
+        workers = min(32, os.cpu_count() or 8)
+        ds = ImageFolder(root)
+
+        def measure(step_sleep: float):
+            with ImageFolderLoader(ds, local_batch=batch, image_size=224,
+                                   workers=workers, prefetch=2) as loader:
+                def epochs():
+                    while True:  # re-iterating advances to the next epoch
+                        yield from loader
+
+                it = epochs()
+                next(it)  # warm the pipeline
+                n, stall = 0, 0.0
+                t0 = time.perf_counter()
+                target = 6 if on_tpu else 2
+                for _ in range(target):
+                    if step_sleep:
+                        time.sleep(step_sleep)
+                    s0 = time.perf_counter()
+                    next(it)
+                    stall += time.perf_counter() - s0
+                    n += batch
+                return n / (time.perf_counter() - t0), stall / target
+
+        raw_ips, _ = measure(0.0)
+        step_s = batch / rn50_rate  # an RN50 step's device time
+        _, stall_s = measure(step_s)
+        return {
+            "value": round(raw_ips, 1),
+            "unit": "images-decoded/sec",
+            "vs_rn50_consumption": round(raw_ips / rn50_rate, 3),
+            "rn50_rate_source": rate_src,
+            "per_worker_ips": round(raw_ips / workers, 1),
+            "overlapped_stall_ms_per_step": round(stall_s * 1e3, 2),
+            "rn50_step_ms": round(step_s * 1e3, 2),
+            "batch": batch,
+            "workers": workers,
+            "jpeg_side": side,
+            "n_images": n_classes * per_class,
+        }
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
 
 
 def bench_fused_adam_step(jax, on_tpu):
@@ -684,11 +793,12 @@ BENCHES = {
     "gpt_long_context": bench_gpt_long_context,
     "tp_gpt": bench_tp_gpt,
     "fused_adam_step": bench_fused_adam_step,
+    "input_pipeline": bench_input_pipeline,
 }
 # headline first: if the deadline hits, the most important number exists.
 BENCH_ORDER = ["resnet50_o2", "gpt_flash", "bert_large",
                "resnet50_lamb_syncbn", "tp_gpt", "fused_adam_step",
-               "gpt_flash_fp8", "gpt_long_context"]
+               "gpt_flash_fp8", "gpt_long_context", "input_pipeline"]
 
 
 def run_one(name: str) -> None:
@@ -720,6 +830,14 @@ def _run_child(name: str, platform: str, timeout: float) -> dict:
     env = dict(os.environ)
     if platform == "cpu":
         env["JAX_PLATFORMS"] = "cpu"
+        if name == "tp_gpt":
+            # r3 VERDICT weak #5: tp_gpt at tp=1 on the single bench chip
+            # exercises zero TP collectives.  The CPU row instead runs a
+            # *real* tp=8 shard_map on a virtual 8-device host mesh, so at
+            # least the collective step-time shape is measured somewhere;
+            # the row's "measured" field states exactly what it is.
+            env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                                + " --xla_force_host_platform_device_count=8")
     _log(f"launching {name} (timeout {timeout:.0f}s)")
     try:
         proc = subprocess.run(
@@ -757,7 +875,8 @@ _TPU_FAILS: dict = {}
 _TPU_FAIL_CAP = 2
 
 
-def _run_suite(results, platform, deadline, per_bench, upgrade=True):
+def _run_suite(results, platform, deadline, per_bench, upgrade=True,
+               on_update=None):
     """Run every bench not yet successful on ``platform``.  Returns the
     platform still believed healthy ("tpu" may degrade to "cpu" after a
     timeout + failed re-probe; CPU runs never degrade).
@@ -766,7 +885,11 @@ def _run_suite(results, platform, deadline, per_bench, upgrade=True):
     satisfy the pass — the poll window exists to upgrade CPU records to
     TPU ones.  ``upgrade=False`` (CPU fallback passes): any error-free
     record satisfies the pass, so a fallback can never clobber TPU
-    evidence.  A failure never overwrites an existing success."""
+    evidence.  A failure never overwrites an existing success.
+
+    ``on_update`` is called after every change to ``results`` (r3
+    postmortem: emit the upgraded record *immediately*, never hold
+    evidence in RAM until process exit)."""
     for name in BENCH_ORDER:
         prev = results.get(name, {"error": "unrun"})
         if "error" not in prev and (
@@ -784,6 +907,8 @@ def _run_suite(results, platform, deadline, per_bench, upgrade=True):
         rec = _run_child(name, platform, budget)
         if "error" not in rec or "error" in prev:
             results[name] = rec
+            if on_update is not None:
+                on_update()
         # The tunneled TPU can die *mid-suite* (observed: backend init
         # wedges for every subsequent child).  After a timeout, re-probe
         # before burning the remaining budget a full cap at a time.
@@ -829,23 +954,75 @@ def _newest_prior_tpu_record():
     }
 
 
+# One stamp per bench run: repeated saves of an improving TPU record
+# overwrite the same file instead of littering bench_results/.
+_RUN_STAMP = time.strftime("%Y%m%d_%H%M%S")
+
+
 def _save_tpu_record(record) -> None:
-    stamp = time.strftime("%Y%m%d_%H%M%S")
-    path = os.path.join(_REPO, "bench_results", f"tpu_{stamp}.json")
+    path = os.path.join(_REPO, "bench_results", f"tpu_{_RUN_STAMP}.json")
     try:
         os.makedirs(os.path.dirname(path), exist_ok=True)
-        with open(path, "w") as f:
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
             json.dump(record, f)
+        os.replace(tmp, path)
         _log(f"tpu record saved to {path}")
     except Exception as e:
         _log(f"could not save tpu record: {e!r}")
+
+
+def build_record(results, platform) -> dict:
+    """Assemble the driver-contract record from the current results.
+    Safe to call at any point in the run — missing benches appear as
+    ``error: unrun`` and the newest stamped prior TPU record is embedded
+    whenever the headline itself did not run on TPU."""
+    headline = results.get("resnet50_o2", {"error": "unrun"})
+    ok = "error" not in headline
+    headline_on_tpu = headline.get("platform") == "tpu"
+    baseline = adopted_baseline()
+    record = {
+        "metric": "resnet50_o2_train_throughput",
+        "value": headline.get("value", 0.0) if ok else 0.0,
+        "unit": "images/sec/chip",
+        "vs_baseline": (round(headline["value"] / baseline, 3)
+                        if ok and headline_on_tpu else None),
+        "platform": headline.get("platform", platform),
+        "headline": headline,
+        "extras": {k: v for k, v in results.items() if k != "resnet50_o2"},
+    }
+    if not headline_on_tpu:
+        prior = _newest_prior_tpu_record()
+        if prior is not None:
+            record["prior_tpu_record"] = prior
+            if record["vs_baseline"] is None:
+                record["vs_baseline"] = prior["record"].get("vs_baseline")
+                record["vs_baseline_source"] = "prior_tpu_record"
+    return record
+
+
+def emit_record(results, platform) -> dict:
+    """Print the current record as one stdout JSON line (the driver keeps
+    the tail and parses the *last* JSON line, so each emission supersedes
+    the previous one — a kill at any instant leaves the newest evidence
+    behind), and stamp it to bench_results/ when the headline is TPU."""
+    record = build_record(results, platform)
+    if record["headline"].get("platform") == "tpu":
+        # Only a record whose *headline* ran on TPU is worth embedding in a
+        # later round as TPU evidence — a CPU headline with one stray TPU
+        # extra must not masquerade as a TPU run.
+        _save_tpu_record(record)
+    print(json.dumps(record), flush=True)
+    return record
 
 
 def main():
     from apex_tpu.utils.platform import probe_default_platform
 
     t_start = time.monotonic()
-    deadline = t_start + float(os.environ.get("BENCH_DEADLINE_S", "2700"))
+    # 1800s default: safely inside the observed ~2100s driver window (the
+    # r3 default of 2700s exceeded it and the kill landed mid-poll).
+    deadline = t_start + float(os.environ.get("BENCH_DEADLINE_S", "1800"))
     # Keep probing for the chip until ~80% of the window is gone — a wedge
     # at bench start must not forfeit the round's TPU evidence (BENCH_r02).
     # An explicit CPU pin disables the poll (the probe honors the pin, so
@@ -855,6 +1032,12 @@ def main():
         t_start + 0.8 * (deadline - t_start))
 
     results = {}
+    # Bootstrap record before anything that can hang (probe, suites): even a
+    # kill during the first backend probe leaves a parseable record carrying
+    # the embedded prior TPU evidence.
+    emit = lambda: emit_record(results, platform)
+    platform = "cpu"
+    emit()
     probed = None if cpu_pinned else probe_default_platform(
         max_tries=1, timeout=150.0, log=_log)
     platform = probed if probed is not None else "cpu"
@@ -878,7 +1061,7 @@ def main():
             _log("running cpu fallback suite")
             _run_suite(results, "cpu",
                        min(deadline, time.monotonic() + 900),
-                       per_bench=300.0, upgrade=False)
+                       per_bench=300.0, upgrade=False, on_update=emit)
             cpu_fallback_done = True
 
     if platform != "tpu":
@@ -887,7 +1070,8 @@ def main():
 
     while True:
         if platform == "tpu":
-            platform = _run_suite(results, "tpu", deadline, per_bench=900.0)
+            platform = _run_suite(results, "tpu", deadline, per_bench=900.0,
+                                  on_update=emit)
             done_or_capped = all(
                 r.get("platform") == "tpu"
                 or _TPU_FAILS.get(n, 0) >= _TPU_FAIL_CAP
@@ -907,35 +1091,11 @@ def main():
     # CPU fallback for anything that still has no record at all (never
     # clobbers an existing success on any platform).
     if any("error" in r for r in results.values()) or not results:
-        _run_suite(results, "cpu", deadline, per_bench=300.0, upgrade=False)
+        _run_suite(results, "cpu", deadline, per_bench=300.0, upgrade=False,
+                   on_update=emit)
 
-    headline = results.get("resnet50_o2", {"error": "unrun"})
-    ok = "error" not in headline
-    headline_on_tpu = headline.get("platform") == "tpu"
-    baseline = adopted_baseline()
-    record = {
-        "metric": "resnet50_o2_train_throughput",
-        "value": headline.get("value", 0.0) if ok else 0.0,
-        "unit": "images/sec/chip",
-        "vs_baseline": (round(headline["value"] / baseline, 3)
-                        if ok and headline_on_tpu else None),
-        "platform": headline.get("platform", platform),
-        "headline": headline,
-        "extras": {k: v for k, v in results.items() if k != "resnet50_o2"},
-    }
-    if headline_on_tpu:
-        # Only a record whose *headline* ran on TPU is worth embedding in a
-        # later round as TPU evidence — a CPU headline with one stray TPU
-        # extra must not masquerade as a TPU run.
-        _save_tpu_record(record)
-    if not headline_on_tpu:
-        prior = _newest_prior_tpu_record()
-        if prior is not None:
-            record["prior_tpu_record"] = prior
-            if record["vs_baseline"] is None:
-                record["vs_baseline"] = prior["record"].get("vs_baseline")
-                record["vs_baseline_source"] = "prior_tpu_record"
-    print(json.dumps(record))
+    # Final (possibly redundant) emission — the last JSON line wins.
+    emit()
 
 
 if __name__ == "__main__":

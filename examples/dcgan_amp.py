@@ -100,9 +100,12 @@ def folder_batches(root, batch_size, image_size=64, seed=0):
 
     loader = ImageFolderLoader(ImageFolder(root), local_batch=batch_size,
                                image_size=image_size, seed=seed)
-    while True:
-        for x, _ in loader:  # labels unused (unconditional GAN)
-            yield x.astype(np.float32) / 127.5 - 1.0
+    try:
+        while True:
+            for x, _ in loader:  # labels unused (unconditional GAN)
+                yield x.astype(np.float32) / 127.5 - 1.0
+    finally:
+        loader.close()  # generator finalization reclaims decode threads
 
 
 def fake_batches(batch_size, image_size=64, seed=0):

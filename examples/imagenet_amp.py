@@ -115,6 +115,7 @@ def main(argv=None):
         raise SystemExit(
             f"--batch-size {args.batch_size} must be divisible by the "
             f"data-parallel world size ({dp})")
+    loader = None
     if args.data is not None:
         dataset = ImageFolder(args.data)
         print(f"ImageFolder: {len(dataset)} samples, "
@@ -137,17 +138,21 @@ def main(argv=None):
 
     t0 = time.perf_counter()
     loss = None
-    for i in range(args.steps):
-        batch = dp_shard_batch(next(it), mesh)
-        params, batch_stats, opt_state, loss = train_step(
-            params, batch_stats, opt_state, batch
-        )
-        if i == 0:
-            jax.block_until_ready(loss)
-            t0 = time.perf_counter()  # exclude compile
-        if i % 10 == 0 or i == args.steps - 1:
-            print(f"step {i:4d} loss {float(loss):.4f}")
-    jax.block_until_ready(loss)
+    try:
+        for i in range(args.steps):
+            batch = dp_shard_batch(next(it), mesh)
+            params, batch_stats, opt_state, loss = train_step(
+                params, batch_stats, opt_state, batch
+            )
+            if i == 0:
+                jax.block_until_ready(loss)
+                t0 = time.perf_counter()  # exclude compile
+            if i % 10 == 0 or i == args.steps - 1:
+                print(f"step {i:4d} loss {float(loss):.4f}")
+        jax.block_until_ready(loss)
+    finally:
+        if loader is not None:
+            loader.close()  # reclaim the decode threads
     dt = time.perf_counter() - t0
     ips = args.batch_size * (args.steps - 1) / dt if args.steps > 1 else 0.0
     print(f"throughput: {ips:.1f} images/sec ({dt:.2f}s for {args.steps-1} steps)")
