@@ -103,18 +103,14 @@ def _write_npz(path, manifest, arrays) -> str:
     # Unique temp file in the target dir: concurrent saves to the same
     # path cannot race on a shared temp name, and os.replace stays atomic
     # (same filesystem) so there are no torn checkpoints on preemption.
-    import tempfile
+    # O_CREAT with mode 0o666 lets the kernel apply the process umask
+    # atomically (the file gets exactly the mode a plain open() would),
+    # with no umask() probing that could race other threads.
+    import uuid
 
-    fd, tmp = tempfile.mkstemp(
-        prefix=os.path.basename(path) + ".tmp.",
-        dir=os.path.dirname(os.path.abspath(path)) or ".")
+    tmp = f"{path}.tmp.{uuid.uuid4().hex}"
+    fd = os.open(tmp, os.O_CREAT | os.O_EXCL | os.O_WRONLY, 0o666)
     try:
-        # mkstemp creates 0600; restore the umask-based mode a plain
-        # open() would have given so checkpoints stay group/other-readable
-        # per the operator's umask
-        umask = os.umask(0)
-        os.umask(umask)
-        os.fchmod(fd, 0o666 & ~umask)
         with os.fdopen(fd, "wb") as f:
             np.savez(f, __manifest__=json.dumps(manifest), **arrays)
         os.replace(tmp, path)

@@ -16,12 +16,14 @@ from apex_tpu.data.image_folder import (
     random_resized_crop,
     synthetic_image_batches,
 )
+from apex_tpu.data.prefetch import prefetch_to_device
 
 __all__ = [
     "ImageFolder",
     "ImageFolderLoader",
     "center_crop_resize",
     "normalize_on_device",
+    "prefetch_to_device",
     "random_resized_crop",
     "synthetic_image_batches",
 ]

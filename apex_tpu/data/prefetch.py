@@ -1,0 +1,69 @@
+"""Device-transfer prefetch: overlap host->device copies with compute.
+
+Behavioral spec: the reference's ``data_prefetcher``
+(``examples/imagenet/main_amp.py:256-276``) — batch N+1's H2D copy runs
+on a side CUDA stream while the model computes on batch N, so the copy
+never sits on the step's critical path.
+
+The TPU redesign needs no stream machinery: ``jax.device_put`` is
+*asynchronous* — it returns immediately with arrays whose transfers are
+in flight, and any computation consuming them is sequenced after the
+copy by the runtime.  Keeping ``depth`` batches in a small queue
+therefore issues batch N+k's transfer while step N runs; by the time
+the train loop asks for the next batch, its bytes are already on the
+chip (uint8, so 4x less traffic than fp32 — ``normalize_on_device``
+upcasts inside the jitted step).
+
+Composes with :class:`~apex_tpu.data.image_folder.ImageFolderLoader`'s
+decode prefetch: decode overlaps on the thread pool, transfer overlaps
+on the device queue, and the step loop only ever blocks if *both*
+pipelines fall behind.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Callable, Iterable, Iterator, Optional
+
+__all__ = ["prefetch_to_device"]
+
+
+def prefetch_to_device(iterator: Iterable, mesh=None, depth: int = 2,
+                       place: Optional[Callable] = None) -> Iterator:
+    """Yield batches from ``iterator`` already placed on device,
+    ``depth`` transfers ahead of the consumer.
+
+    ``place`` maps a host batch to device arrays; the default shards the
+    leading dim over the data-parallel axes via
+    :func:`apex_tpu.parallel.dp_shard_batch` when a ``mesh`` is given
+    (or one is initialized), else a plain ``jax.device_put``.
+
+    ``depth=0`` degenerates to ``map(place, iterator)``.  The wrapped
+    iterator is advanced ``depth`` batches ahead — wrap the *device*
+    side of a resumable loader, and checkpoint the loader's own
+    ``consumed_samples`` only at step boundaries minus the in-flight
+    window, or simply re-wrap after restore (the underlying loader
+    rewinds abandoned in-flight batches itself).
+    """
+    import jax
+
+    from apex_tpu.parallel import distributed as dist
+    from apex_tpu.parallel import mesh as mesh_lib
+
+    if place is None:
+        if mesh is not None or mesh_lib.model_parallel_is_initialized():
+            place = lambda b: dist.dp_shard_batch(b, mesh)  # noqa: E731
+        else:
+            place = jax.device_put
+
+    it = iter(iterator)
+    queue: deque = deque()
+    while True:
+        while len(queue) < max(0, depth) + 1:
+            nxt = next(it, None)
+            if nxt is None:
+                break
+            queue.append(place(nxt))
+        if not queue:
+            return
+        yield queue.popleft()

@@ -32,11 +32,12 @@ from apex_tpu.data import (
     ImageFolder,
     ImageFolderLoader,
     normalize_on_device,
+    prefetch_to_device,
     synthetic_image_batches,
 )
 from apex_tpu.models import ResNet18, ResNet50, ResNet101
 from apex_tpu.optimizers import FusedLAMB, FusedSGD
-from apex_tpu.parallel import dp_shard_batch, replicate
+from apex_tpu.parallel import replicate
 
 ARCHS = {"resnet18": ResNet18, "resnet50": ResNet50, "resnet101": ResNet101}
 
@@ -136,11 +137,15 @@ def main(argv=None):
         it = synthetic_image_batches(args.batch_size, args.image_size,
                                      args.num_classes)
 
+    # H2D transfers issue 2 batches ahead of the step loop (the reference
+    # data_prefetcher's side-stream role; device_put is async under JAX)
+    dev_it = prefetch_to_device(it, mesh, depth=2)
+
     t0 = time.perf_counter()
     loss = None
     try:
         for i in range(args.steps):
-            batch = dp_shard_batch(next(it), mesh)
+            batch = next(dev_it)
             params, batch_stats, opt_state, loss = train_step(
                 params, batch_stats, opt_state, batch
             )

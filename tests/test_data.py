@@ -169,6 +169,45 @@ def test_normalize_on_device_matches_numpy():
     np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-5, atol=1e-5)
 
 
+def test_prefetch_to_device_sharding_and_order():
+    """Batches come back on-device, dp-sharded, in order, depth ahead."""
+    import itertools
+
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from apex_tpu import parallel
+    from apex_tpu.data import prefetch_to_device
+
+    mesh = parallel.initialize_model_parallel()
+    try:
+        host = list(itertools.islice(synthetic_image_batches(8, 8, 10), 4))
+        dev = list(prefetch_to_device(iter(host), mesh, depth=2))
+        assert len(dev) == 4
+        want = NamedSharding(mesh, P(("dcn", "dp"), None, None, None))
+        for (hx, hy), (dx, dy) in zip(host, dev):
+            assert dx.sharding.is_equivalent_to(want, dx.ndim)
+            np.testing.assert_array_equal(np.asarray(dx), hx)
+            np.testing.assert_array_equal(np.asarray(dy), hy)
+    finally:
+        parallel.mesh.destroy_model_parallel()
+
+
+def test_prefetch_to_device_plain_device_put():
+    """Without a mesh, falls back to plain device_put; depth=0 works."""
+    import jax
+
+    from apex_tpu.data import prefetch_to_device
+
+    host = [np.arange(6, dtype=np.float32).reshape(2, 3) + i
+            for i in range(3)]
+    out = list(prefetch_to_device(host, depth=0))
+    assert len(out) == 3
+    for h, d in zip(host, out):
+        assert isinstance(d, jax.Array)
+        np.testing.assert_array_equal(np.asarray(d), h)
+
+
 def test_synthetic_batches_contract():
     it = synthetic_image_batches(4, 16, 10)
     x, y = next(it)
