@@ -23,13 +23,81 @@ pipelines fall behind.
 from __future__ import annotations
 
 from collections import deque
-from typing import Callable, Iterable, Iterator, Optional
+from typing import Callable, Iterable, Optional
 
 __all__ = ["prefetch_to_device"]
 
 
+class DevicePrefetcher:
+    """Iterator over device-placed batches; see :func:`prefetch_to_device`.
+
+    ``consumed_samples`` (available when the wrapped source exposes its
+    own ``consumed_samples`` — e.g. :class:`ImageFolderLoader`) is the
+    checkpoint-correct resume point: the source's count *minus* the
+    batches sitting undelivered in the device queue.  The source alone
+    over-counts while the wrapper runs ahead, so checkpoint this
+    wrapper's value, not the loader's, and re-wrap a fresh loader from
+    it after restore.
+    """
+
+    def __init__(self, source, place: Optional[Callable], depth: int,
+                 mesh=None):
+        self._source = source
+        self._it = iter(source)
+        self._place = place  # None: resolved lazily at first __next__
+        self._mesh = mesh
+        self._depth = max(0, depth)
+        self._queue: deque = deque()
+
+    def _resolve_place(self) -> Callable:
+        # Deferred to first use so `prefetch_to_device(it)` constructed
+        # *before* initialize_model_parallel() still picks up dp sharding
+        # once iteration starts.
+        import jax
+
+        from apex_tpu.parallel import distributed as dist
+        from apex_tpu.parallel import mesh as mesh_lib
+
+        if (self._mesh is not None
+                or mesh_lib.model_parallel_is_initialized()):
+            mesh = self._mesh
+            return lambda b: dist.dp_shard_batch(b, mesh)
+        return jax.device_put
+
+    @property
+    def in_flight(self) -> int:
+        """Batches placed on device but not yet delivered to the caller."""
+        return len(self._queue)
+
+    @property
+    def consumed_samples(self) -> int:
+        src = getattr(self._source, "consumed_samples", None)
+        if src is None:
+            raise AttributeError(
+                "the wrapped source has no consumed_samples; wrap an "
+                "ImageFolderLoader (not a plain iterator) for resume "
+                "bookkeeping")
+        per_batch = self._source.local_batch * self._source.dp
+        return src - self.in_flight * per_batch
+
+    def __iter__(self) -> "DevicePrefetcher":
+        return self
+
+    def __next__(self):
+        if self._place is None:
+            self._place = self._resolve_place()
+        while len(self._queue) < self._depth + 1:
+            nxt = next(self._it, None)
+            if nxt is None:
+                break
+            self._queue.append(self._place(nxt))
+        if not self._queue:
+            raise StopIteration
+        return self._queue.popleft()
+
+
 def prefetch_to_device(iterator: Iterable, mesh=None, depth: int = 2,
-                       place: Optional[Callable] = None) -> Iterator:
+                       place: Optional[Callable] = None) -> DevicePrefetcher:
     """Yield batches from ``iterator`` already placed on device,
     ``depth`` transfers ahead of the consumer.
 
@@ -38,32 +106,14 @@ def prefetch_to_device(iterator: Iterable, mesh=None, depth: int = 2,
     :func:`apex_tpu.parallel.dp_shard_batch` when a ``mesh`` is given
     (or one is initialized), else a plain ``jax.device_put``.
 
-    ``depth=0`` degenerates to ``map(place, iterator)``.  The wrapped
-    iterator is advanced ``depth`` batches ahead — wrap the *device*
-    side of a resumable loader, and checkpoint the loader's own
-    ``consumed_samples`` only at step boundaries minus the in-flight
-    window, or simply re-wrap after restore (the underlying loader
-    rewinds abandoned in-flight batches itself).
+    ``depth=0`` degenerates to ``map(place, iterator)``.  For exact
+    mid-epoch resume, checkpoint the returned wrapper's
+    ``consumed_samples`` (NOT the loader's own, which runs ahead by the
+    in-flight window) and rebuild loader + wrapper from it after
+    restore.
+
+    The default placement is resolved at *first iteration*, not at
+    construction, so wrapping before ``initialize_model_parallel()``
+    still shards over the mesh that exists when batches start flowing.
     """
-    import jax
-
-    from apex_tpu.parallel import distributed as dist
-    from apex_tpu.parallel import mesh as mesh_lib
-
-    if place is None:
-        if mesh is not None or mesh_lib.model_parallel_is_initialized():
-            place = lambda b: dist.dp_shard_batch(b, mesh)  # noqa: E731
-        else:
-            place = jax.device_put
-
-    it = iter(iterator)
-    queue: deque = deque()
-    while True:
-        while len(queue) < max(0, depth) + 1:
-            nxt = next(it, None)
-            if nxt is None:
-                break
-            queue.append(place(nxt))
-        if not queue:
-            return
-        yield queue.popleft()
+    return DevicePrefetcher(iterator, place, depth, mesh=mesh)

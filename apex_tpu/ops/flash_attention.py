@@ -99,6 +99,56 @@ def _env_block(name: str, default: int) -> int:
 
 DEFAULT_BLOCK_Q = _env_block("APEX_TPU_FLASH_BLOCK_Q", 256)
 DEFAULT_BLOCK_K = _env_block("APEX_TPU_FLASH_BLOCK_K", 512)
+_ENV_SET = ("APEX_TPU_FLASH_BLOCK_Q" in _os.environ,
+            "APEX_TPU_FLASH_BLOCK_K" in _os.environ)
+_TUNED_CACHE: "tuple | None" = None
+
+
+def _tuned_blocks():
+    """(block_q, block_k) from ``bench_results/flash_blocks_tuned.json``
+    (written by ``examples/tune_flash_blocks.py`` when a TPU sweep at the
+    flagship seq finds a winner), or ``(None, None)``.
+
+    Read lazily at first kernel call (never at import: the gate needs a
+    live backend) and adopted ONLY when the record's ``device_kind``
+    matches the attached device — a winner swept on one TPU generation
+    must not leak onto another with a different VMEM budget."""
+    global _TUNED_CACHE
+    if _TUNED_CACHE is None:
+        q = k = None
+        try:
+            import json
+
+            repo = _os.path.dirname(_os.path.dirname(_os.path.dirname(
+                _os.path.abspath(__file__))))
+            with open(_os.path.join(repo, "bench_results",
+                                    "flash_blocks_tuned.json")) as f:
+                rec = json.load(f)
+            dev = jax.devices()[0]
+            if (dev.platform == "tpu"
+                    and rec.get("device_kind")
+                    and rec["device_kind"] == getattr(
+                        dev, "device_kind", None)):
+                q, k = int(rec["block_q"]), int(rec["block_k"])
+                if q <= 0 or k <= 0:
+                    q = k = None
+        except Exception:
+            q = k = None
+        _TUNED_CACHE = (q, k)
+    return _TUNED_CACHE
+
+
+def resolve_default_blocks(block_q=None, block_k=None):
+    """Fill unset block sizes.  Precedence per dimension: explicit arg >
+    ``APEX_TPU_FLASH_BLOCK_Q/K`` env > hardware-matched tuned file >
+    built-in 256/512."""
+    if block_q is None:
+        tuned = None if _ENV_SET[0] else _tuned_blocks()[0]
+        block_q = tuned or DEFAULT_BLOCK_Q
+    if block_k is None:
+        tuned = None if _ENV_SET[1] else _tuned_blocks()[1]
+        block_k = tuned or DEFAULT_BLOCK_K
+    return block_q, block_k
 NEG_INF = -1e30
 _LANES = 128   # TPU lane count: minor-dim tile
 _SUBLANES = 8  # fp32 sublane tile
@@ -593,7 +643,7 @@ def _fwd_call(q, k, v, seg_q, seg_k, seed, causal, scale, block_q, block_k,
 
 
 def dq_chunk(q, k, v, do, lse, delta, *, causal, scale=None,
-             block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
+             block_q=None, block_k=None,
              q_offset=0, kv_offset=0, segment_ids_q=None,
              segment_ids_kv=None, dropout_rate=0.0, dropout_seed=None):
     """dq contribution of one K/V chunk given the *global* ``lse``/``delta``.
@@ -602,6 +652,7 @@ def dq_chunk(q, k, v, do, lse, delta, *, causal, scale=None,
     depends on other blocks only through (lse, delta), so ring backward can
     re-drive this per visiting chunk.
     """
+    block_q, block_k = resolve_default_blocks(block_q, block_k)
     b, h, sq, d = q.shape
     sk = k.shape[2]
     bq, bk, sq_p, sk_p = _pick_blocks(sq, sk, block_q, block_k)
@@ -644,10 +695,11 @@ def dq_chunk(q, k, v, do, lse, delta, *, causal, scale=None,
 
 
 def dkv_chunk(q, k, v, do, lse, delta, *, causal, scale=None,
-              block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
+              block_q=None, block_k=None,
               q_offset=0, kv_offset=0, segment_ids_q=None,
               segment_ids_kv=None, dropout_rate=0.0, dropout_seed=None):
     """(dk, dv) of one K/V chunk given the global ``lse``/``delta``."""
+    block_q, block_k = resolve_default_blocks(block_q, block_k)
     b, h, sq, d = q.shape
     sk = k.shape[2]
     bq, bk, sq_p, sk_p = _pick_blocks(sq, sk, block_q, block_k)
@@ -734,8 +786,8 @@ def flash_attention_with_lse(
     q, k, v,
     causal: bool = False,
     scale: Optional[float] = None,
-    block_q: int = DEFAULT_BLOCK_Q,
-    block_k: int = DEFAULT_BLOCK_K,
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
     q_offset: int = 0,
     kv_offset: int = 0,
     *,
@@ -755,6 +807,7 @@ def flash_attention_with_lse(
     by-product for sharded-softmax composition (ring attention defines its
     own VJP at the ring level for exactly that reason).
     """
+    block_q, block_k = resolve_default_blocks(block_q, block_k)
     seed = _seed_array(dropout_seed) if dropout_rate > 0.0 else None
     return _flash_core(q, k, v, segment_ids_q, segment_ids_kv, seed,
                        causal, scale, block_q, block_k, q_offset, kv_offset,
@@ -763,8 +816,8 @@ def flash_attention_with_lse(
 
 def flash_attention(q, k, v, causal: bool = False,
                     scale: Optional[float] = None,
-                    block_q: int = DEFAULT_BLOCK_Q,
-                    block_k: int = DEFAULT_BLOCK_K,
+                    block_q: Optional[int] = None,
+                    block_k: Optional[int] = None,
                     *,
                     segment_ids_q=None,
                     segment_ids_kv=None,

@@ -126,6 +126,18 @@ def main():
             best = rec
     if best:
         print(json.dumps({"best": best}))
+        # Land the winner automatically: a TPU sweep at the flagship seq
+        # (1024) writes the tuned-defaults file that
+        # apex_tpu.ops.flash_attention reads at import (env overrides
+        # still win) — so an unattended chip-return capture upgrades the
+        # shipped defaults without a source edit.
+        if best["platform"] == "tpu" and args.seq == 1024 and not args.one:
+            tuned_path = os.path.join(REPO, "bench_results",
+                                      "flash_blocks_tuned.json")
+            with open(tuned_path, "w") as f:
+                json.dump(best, f)
+            print(f"tuned defaults written to {tuned_path}",
+                  file=sys.stderr, flush=True)
 
 
 if __name__ == "__main__":
