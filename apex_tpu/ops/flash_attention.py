@@ -80,27 +80,29 @@ __all__ = [
 import os as _os
 
 
-def _env_block(name: str, default: int) -> int:
+def _env_block(name: str, default: int):
+    """(value, applied): ``applied`` is True only when the env var held a
+    valid positive int — an ignored/invalid value must NOT also suppress
+    the tuned-file lookup downstream."""
     raw = _os.environ.get(name)
     if raw is None:
-        return default
+        return default, False
     try:
         val = int(raw)
         if val <= 0:
             raise ValueError(f"must be positive, got {val}")
-        return val
+        return val, True
     except ValueError as e:
         import warnings
 
         warnings.warn(f"ignoring {name}={raw!r} ({e}); "
                       f"using default {default}")
-        return default
+        return default, False
 
 
-DEFAULT_BLOCK_Q = _env_block("APEX_TPU_FLASH_BLOCK_Q", 256)
-DEFAULT_BLOCK_K = _env_block("APEX_TPU_FLASH_BLOCK_K", 512)
-_ENV_SET = ("APEX_TPU_FLASH_BLOCK_Q" in _os.environ,
-            "APEX_TPU_FLASH_BLOCK_K" in _os.environ)
+DEFAULT_BLOCK_Q, _Q_FROM_ENV = _env_block("APEX_TPU_FLASH_BLOCK_Q", 256)
+DEFAULT_BLOCK_K, _K_FROM_ENV = _env_block("APEX_TPU_FLASH_BLOCK_K", 512)
+_ENV_SET = (_Q_FROM_ENV, _K_FROM_ENV)
 _TUNED_CACHE: "tuple | None" = None
 
 
@@ -160,6 +162,16 @@ def _interpret() -> bool:
 
 def _scratch(shape, dtype=jnp.float32):
     return pltpu.VMEM(shape, dtype)
+
+
+def _flash_compiler_params():
+    """All three kernels iterate grid (batch*heads, outer-block, inner-block)
+    and accumulate scratch only over the *innermost* dim — dims 0/1 are
+    independent, so tell Mosaic: it may split them across cores (megacore
+    on v4/v5p) and reorder for pipelining; the innermost stays sequential
+    (init-at-0 / finalize-at-last scratch carry)."""
+    return pltpu.CompilerParams(
+        dimension_semantics=("parallel", "parallel", "arbitrary"))
 
 
 def _round_up(x: int, m: int) -> int:
@@ -637,6 +649,7 @@ def _fwd_call(q, k, v, seg_q, seg_k, seed, causal, scale, block_q, block_k,
             _scratch((bq, _LANES)),
             _scratch((bq, d)),
         ],
+        compiler_params=_flash_compiler_params(),
         interpret=_interpret(),
     )(*args)
     return out[:, :, :sq], lse4[:, :, :sq, 0]
@@ -689,6 +702,7 @@ def dq_chunk(q, k, v, do, lse, delta, *, causal, scale=None,
         out_specs=sp["q"],
         out_shape=jax.ShapeDtypeStruct((b, h, sq_p, d), q.dtype),
         scratch_shapes=[_scratch((bq, d))],
+        compiler_params=_flash_compiler_params(),
         interpret=_interpret(),
     )(*args)
     return dq[:, :, :sq]
@@ -739,6 +753,7 @@ def dkv_chunk(q, k, v, do, lse, delta, *, causal, scale=None,
             jax.ShapeDtypeStruct((b, h, sk_p, d), v.dtype),
         ],
         scratch_shapes=[_scratch((bk, d)), _scratch((bk, d))],
+        compiler_params=_flash_compiler_params(),
         interpret=_interpret(),
     )(*args)
     return dk[:, :, :sk], dv[:, :, :sk]

@@ -145,7 +145,20 @@ def _resnet_bench(jax, on_tpu, optimizer_name, sync_bn=False):
     from apex_tpu.parallel.distributed import all_reduce_gradients
 
     n_chips = len(jax.devices())
-    batch_per_chip = 128 if on_tpu else 4
+    # APEX_TPU_RN50_BATCH: batch-per-chip sweep knob for hardware capture
+    # (the shipped default stays 128 = the reference recipe's per-GPU
+    # batch; a sweep that finds a better point records it in
+    # bench_results/ and the default is bumped by hand, keeping records
+    # comparable)
+    try:
+        sweep_batch = int(os.environ.get("APEX_TPU_RN50_BATCH", "128"))
+        if sweep_batch <= 0:
+            raise ValueError(sweep_batch)
+    except ValueError:
+        _log("ignoring invalid APEX_TPU_RN50_BATCH="
+             f"{os.environ.get('APEX_TPU_RN50_BATCH')!r}; using 128")
+        sweep_batch = 128
+    batch_per_chip = sweep_batch if on_tpu else 4
     image_size = 224 if on_tpu else 32
     steps = 20 if on_tpu else 3
     batch = batch_per_chip * n_chips

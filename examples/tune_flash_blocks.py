@@ -11,9 +11,11 @@ cache on.
     python examples/tune_flash_blocks.py --seq 2048      # long-seq grid
     python examples/tune_flash_blocks.py --one 256 512   # single point
 
-Results append to ``bench_results/flash_block_sweep.jsonl``; pick the
-winner into DEFAULT_BLOCK_Q/K (or the env overrides) and record the
-tuning note in bench_results/.
+Results append to ``bench_results/flash_block_sweep.jsonl``.  A TPU
+sweep at the flagship seq (1024) auto-lands its winner in
+``bench_results/flash_blocks_tuned.json``, which the kernel consults
+lazily at first call and adopts only on a matching ``device_kind`` —
+no manual default-picking needed (env overrides still win).
 """
 
 import argparse
@@ -128,9 +130,10 @@ def main():
         print(json.dumps({"best": best}))
         # Land the winner automatically: a TPU sweep at the flagship seq
         # (1024) writes the tuned-defaults file that
-        # apex_tpu.ops.flash_attention reads at import (env overrides
-        # still win) — so an unattended chip-return capture upgrades the
-        # shipped defaults without a source edit.
+        # apex_tpu.ops.flash_attention consults lazily at first kernel
+        # call, gated on matching device_kind (env overrides still win) —
+        # so an unattended chip-return capture upgrades the shipped
+        # defaults without a source edit.
         if best["platform"] == "tpu" and args.seq == 1024 and not args.one:
             tuned_path = os.path.join(REPO, "bench_results",
                                       "flash_blocks_tuned.json")
