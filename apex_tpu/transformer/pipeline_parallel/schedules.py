@@ -90,6 +90,7 @@ __all__ = [
     "forward_backward_pipelining_with_interleaving",
     "pipeline_apply",
     "pipeline_bubble_fraction",
+    "pipeline_total_ticks",
     "split_into_microbatches",
     "stack_stage_params",
 ]
@@ -202,20 +203,26 @@ def _exit_schedule(total_ticks: int, period: int, pp: int, m: int,
     return np.where(valid, j_out, 0), valid
 
 
+def pipeline_total_ticks(m: int, pp: int, vpp: int = 1) -> int:
+    """Total schedule ticks per rank for one step: ``entry[-1] + pp*vpp``
+    (the single source of the tick-count formula — the bubble fraction
+    and the hardware tick-anchor harness both derive from it)."""
+    entry = _entry_ticks(m, pp, vpp)
+    return int(entry[-1]) + pp * vpp
+
+
 def pipeline_bubble_fraction(m: int, pp: int, vpp: int = 1) -> float:
     """Fraction of schedule ticks that are bubbles, from the schedule math.
 
-    The rotation schedule runs ``entry[-1] + pp*vpp`` ticks per rank, of
-    which ``m*vpp`` do useful stage work.  For ``vpp=1`` this reduces
-    exactly to 1F1B's textbook bubble ``(pp-1)/(m+pp-1)`` (reference
+    Of :func:`pipeline_total_ticks` ticks, ``m*vpp`` do useful stage
+    work.  For ``vpp=1`` this reduces exactly to 1F1B's textbook bubble
+    ``(pp-1)/(m+pp-1)`` (reference
     ``fwd_bwd_pipelining_without_interleaving.py`` warmup+cooldown count);
     interleaving divides the bubble by ``vpp`` as expected.  The perf
     harness (``examples/bench_pipeline.py``) checks measured step time
     against this prediction.
     """
-    entry = _entry_ticks(m, pp, vpp)
-    total = int(entry[-1]) + pp * vpp
-    return 1.0 - (m * vpp) / total
+    return 1.0 - (m * vpp) / pipeline_total_ticks(m, pp, vpp)
 
 
 def pipeline_apply(

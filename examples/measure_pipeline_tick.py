@@ -1,0 +1,105 @@
+"""Single-chip pipeline tick anchor (round-3 VERDICT stretch item 9).
+
+The rotation schedule's bubble model says a pp-stage pipeline with m
+microbatches spends ``pipeline_bubble_fraction(m, pp, vpp)`` of its ticks
+idle, so its step time is ``ticks(m, pp, vpp) * T_tick`` where ``T_tick``
+is one stage's fwd+bwd on one microbatch.  The virtual-CPU-mesh records
+(``bench_results/pipeline_virtual_mesh.jsonl``) validate the *tick
+counts* but their wall clock is meaningless (all "devices" share the
+host's cores).  This harness supplies the missing real-clock anchor: it
+times ``T_tick`` for the same stage shape on the one attached chip and
+prints the projected pp-pipeline step times next to the analytic bubble,
+so the model has one hardware-measured constant per configuration.
+
+Reference capability anchored: 1F1B's warmup+cooldown bubble
+(``fwd_bwd_pipelining_without_interleaving.py``: (pp-1)/(m+pp-1)).
+
+    python examples/measure_pipeline_tick.py          # TPU if attached
+    JAX_PLATFORMS=cpu python examples/measure_pipeline_tick.py   # smoke
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--width", type=int, default=256,
+                    help="stage width (matches pipeline_virtual_mesh rows)")
+    ap.add_argument("--microbatch", type=int, default=4)
+    ap.add_argument("--steps", type=int, default=50)
+    args = ap.parse_args()
+
+    if os.environ.get("JAX_PLATFORMS", "").lower() == "cpu":
+        from apex_tpu.utils.platform import pin_cpu
+
+        pin_cpu()
+    import jax
+    import jax.numpy as jnp
+
+    from apex_tpu.transformer.pipeline_parallel.schedules import (
+        pipeline_bubble_fraction,
+        pipeline_total_ticks,
+    )
+
+    width = args.width
+    params = {"w": jax.random.normal(jax.random.PRNGKey(0),
+                                     (width, width)) * 0.1,
+              "b": jnp.zeros((width,))}
+    h = jax.random.normal(jax.random.PRNGKey(1), (args.microbatch, width))
+
+    # one tick = one stage fwd+bwd on one microbatch (the schedule's unit
+    # of work; the same stage_fn bench_pipeline.py pipelines)
+    @jax.jit
+    def tick(params, h):
+        def loss(p):
+            return jnp.sum(jnp.tanh(h @ p["w"] + p["b"]) ** 2)
+        return jax.grad(loss)(params)
+
+    out = tick(params, h)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(args.steps):
+        out = tick(params, h)
+    jax.block_until_ready(out)
+    t_tick = (time.perf_counter() - t0) / args.steps
+
+    dev = jax.devices()[0]
+    projections = []
+    for pp, vpp, m in ((4, 1, 16), (4, 2, 16), (8, 1, 32), (8, 2, 32)):
+        ticks = pipeline_total_ticks(m, pp, vpp)
+        bubble = pipeline_bubble_fraction(m, pp, vpp)
+        projections.append({
+            "pp": pp, "vpp": vpp, "m": m,
+            "schedule_ticks": ticks,
+            "analytic_bubble": round(bubble, 4),
+            "projected_step_s": round(ticks * t_tick, 6),
+            "projected_ideal_s": round(m * vpp * t_tick, 6),
+        })
+    record = {
+        "width": width, "microbatch": args.microbatch,
+        "t_tick_s": round(t_tick, 7),
+        "platform": dev.platform,
+        "device_kind": getattr(dev, "device_kind", ""),
+        "projections": projections,
+        "note": ("real-clock anchor for the virtual-mesh tick-count "
+                 "records in bench_results/pipeline_virtual_mesh.jsonl"),
+        "ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
+    }
+    print(json.dumps(record))
+    if dev.platform == "tpu":
+        out_path = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "bench_results", "pipeline_tick_tpu.jsonl")
+        with open(out_path, "a") as f:
+            f.write(json.dumps(record) + "\n")
+
+
+if __name__ == "__main__":
+    main()
