@@ -1,0 +1,8 @@
+"""Host-side native helpers (C, compiled lazily with the system ``cc``).
+
+``flatcopy.c`` provides the parallel flat gather/scatter used by
+:mod:`apex_tpu.utils.flatten` — the host-memory analog of the reference's
+``multi_tensor_apply`` flat-buffer staging.  No build step at install
+time: :func:`apex_tpu.utils.flatten._build_and_load` compiles on first
+use and falls back to numpy when no compiler is available.
+"""

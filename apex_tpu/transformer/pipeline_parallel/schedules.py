@@ -302,7 +302,7 @@ def pipeline_apply(
             f"shard_microbatches requires num_microbatches ({m}) divisible "
             f"by pp ({pp})")
     entry = _entry_ticks(m, pp, vpp)
-    total_ticks = int(entry[-1]) + period
+    total_ticks = pipeline_total_ticks(m, pp, vpp)  # == entry[-1] + period
 
     fn = jax.checkpoint(stage_fn) if remat else stage_fn
     mpp = m // pp if shard_microbatches else m

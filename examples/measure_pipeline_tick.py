@@ -62,13 +62,13 @@ def main():
             return jnp.sum(jnp.tanh(h @ p["w"] + p["b"]) ** 2)
         return jax.grad(loss)(params)
 
-    out = tick(params, h)
-    jax.block_until_ready(out)
-    t0 = time.perf_counter()
-    for _ in range(args.steps):
-        out = tick(params, h)
-    jax.block_until_ready(out)
-    t_tick = (time.perf_counter() - t0) / args.steps
+    import bench  # shared timing methodology (bench._timeit)
+
+    step = lambda p, h: (tick(p, h), h)  # noqa: E731  carry drives timing
+    st = step(params, h)
+    jax.block_until_ready(st)
+    dt, _ = bench._timeit(jax, step, st, args.steps)
+    t_tick = dt / args.steps
 
     dev = jax.devices()[0]
     projections = []
