@@ -190,3 +190,128 @@ def test_zero_state_gather_scatter(remainders):
             np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
     finally:
         parallel.destroy_model_parallel()
+
+
+def test_sharded_roundtrip_single_process(tmp_path):
+    """Per-process sharded save/restore: dp/tp-sharded leaves come back
+    bit-exact with their shardings, each distinct slice stored once."""
+    from jax.sharding import NamedSharding
+
+    from apex_tpu.checkpoint import (
+        restore_checkpoint_sharded,
+        save_checkpoint_sharded,
+    )
+
+    mesh = parallel.initialize_model_parallel(tensor_model_parallel_size=2)
+    try:
+        rng = np.random.RandomState(0)
+        w = jax.device_put(
+            rng.randn(16, 8).astype(np.float32),
+            NamedSharding(mesh, P(("dcn", "dp"), "tp")))
+        b = jax.device_put(rng.randn(8).astype(np.float32),
+                           NamedSharding(mesh, P("tp")))
+        scale = jax.device_put(jnp.float32(3.5), NamedSharding(mesh, P()))
+        tree = {"w": w, "b": b, "scale": scale, "host": np.arange(3)}
+
+        ckpt = str(tmp_path / "sharded")
+        save_checkpoint_sharded(ckpt, tree, step=11)
+
+        like = jax.tree_util.tree_map(lambda x: x, tree)
+        restored, step = restore_checkpoint_sharded(ckpt, like)
+        assert step == 11
+        np.testing.assert_array_equal(np.asarray(restored["w"]),
+                                      np.asarray(w))
+        np.testing.assert_array_equal(np.asarray(restored["b"]),
+                                      np.asarray(b))
+        assert float(restored["scale"]) == 3.5
+        np.testing.assert_array_equal(restored["host"], np.arange(3))
+        assert restored["w"].sharding.is_equivalent_to(w.sharding, w.ndim)
+
+        # replicated/partially-replicated leaves stored once per slice,
+        # not once per replica: b is tp-sharded (2 slices) but replicated
+        # over dp — exactly 2 stored pieces
+        import json as _json
+
+        with np.load(f"{ckpt}/shard_0.npz") as data:
+            manifest = _json.loads(str(data["__manifest__"]))
+            b_i = next(i for i, rec in enumerate(manifest["leaves"])
+                       if rec["path"] == "b")
+            b_keys = [k for k in data.files
+                      if k.startswith(f"leaf_{b_i}|")]
+        assert len(b_keys) == 2, b_keys
+    finally:
+        parallel.mesh.destroy_model_parallel()
+
+
+def test_sharded_restore_across_mesh_shapes(tmp_path):
+    """Save under tp=2, restore under tp=4 (different slice boundaries):
+    the stitcher reassembles the needed slices."""
+    from jax.sharding import NamedSharding
+
+    from apex_tpu.checkpoint import (
+        restore_checkpoint_sharded,
+        save_checkpoint_sharded,
+    )
+
+    rng = np.random.RandomState(1)
+    host_w = rng.randn(8, 8).astype(np.float32)
+
+    mesh = parallel.initialize_model_parallel(tensor_model_parallel_size=2)
+    try:
+        w = jax.device_put(host_w, NamedSharding(mesh, P(None, "tp")))
+        save_checkpoint_sharded(str(tmp_path / "c"), {"w": w}, step=1)
+    finally:
+        parallel.mesh.destroy_model_parallel()
+
+    mesh4 = parallel.initialize_model_parallel(tensor_model_parallel_size=4)
+    try:
+        like = {"w": jax.device_put(jnp.zeros((8, 8), jnp.float32),
+                                    NamedSharding(mesh4, P("tp", None)))}
+        restored, _ = restore_checkpoint_sharded(str(tmp_path / "c"), like)
+        np.testing.assert_array_equal(np.asarray(restored["w"]), host_w)
+        assert restored["w"].sharding.is_equivalent_to(
+            like["w"].sharding, 2)
+    finally:
+        parallel.mesh.destroy_model_parallel()
+
+
+def test_sharded_rejects_stale_and_casts_dtype(tmp_path):
+    """Stale extra shard files fail loudly; restore casts to the
+    template's dtype (the portable-precision flow)."""
+    from jax.sharding import NamedSharding
+
+    from apex_tpu.checkpoint import (
+        restore_checkpoint_sharded,
+        save_checkpoint_sharded,
+    )
+
+    ckpt = str(tmp_path / "c")
+    mesh = parallel.initialize_model_parallel()
+    try:
+        w = jax.device_put(jnp.arange(32.0).reshape(8, 4),
+                           NamedSharding(mesh, P(("dcn", "dp"), None)))
+        save_checkpoint_sharded(ckpt, {"w": w}, step=2)
+
+        # stale file from an imaginary larger-cluster run
+        import shutil
+
+        shutil.copy(f"{ckpt}/shard_0.npz", f"{ckpt}/shard_7.npz")
+        like = {"w": w}
+        with pytest.raises(ValueError, match="stale|duplicate"):
+            restore_checkpoint_sharded(ckpt, like)
+
+        # re-saving into the same dir cleans the stale file
+        save_checkpoint_sharded(ckpt, {"w": w}, step=3)
+        restored, step = restore_checkpoint_sharded(ckpt, like)
+        assert step == 3
+
+        # dtype follows the template: restore fp32 shards into bf16
+        like_bf16 = {"w": jax.device_put(
+            jnp.zeros((8, 4), jnp.bfloat16),
+            NamedSharding(mesh, P(("dcn", "dp"), None)))}
+        r2, _ = restore_checkpoint_sharded(ckpt, like_bf16)
+        assert r2["w"].dtype == jnp.bfloat16
+        np.testing.assert_array_equal(
+            np.asarray(r2["w"], np.float32), np.arange(32.0).reshape(8, 4))
+    finally:
+        parallel.mesh.destroy_model_parallel()
