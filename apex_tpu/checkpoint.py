@@ -34,6 +34,7 @@ __all__ = [
     "save_checkpoint",
     "save_checkpoint_async",
     "save_checkpoint_sharded",
+    "save_checkpoint_sharded_async",
     "restore_checkpoint",
     "restore_checkpoint_sharded",
     "gather_zero_state",
@@ -168,11 +169,18 @@ def save_checkpoint_async(path: str, tree: Any,
     if jax.process_count() > 1:
         raise ValueError(
             "save_checkpoint_async is single-process; multi-host saves "
-            "need the collective gather of save_checkpoint")
-    import concurrent.futures
-
+            "need the collective gather of save_checkpoint (or the "
+            "gather-free save_checkpoint_sharded_async)")
     # sync D2H (host-numpy leaves copied), then async IO
     arrays, manifest = _snapshot(tree, step, copy_host_leaves=True)
+    return _submit_write(path, manifest, arrays, "async checkpoint")
+
+
+def _submit_write(path, manifest, arrays, label):
+    """Background write on a dedicated single-use worker; failures are
+    logged from the worker (not silent if the caller drops the handle)
+    AND re-raised through the returned future's ``result()``."""
+    import concurrent.futures
 
     def _write_logged():
         try:
@@ -181,7 +189,7 @@ def save_checkpoint_async(path: str, tree: Any,
             import logging
 
             logging.getLogger(__name__).exception(
-                "async checkpoint write to %r failed", path)
+                "%s write to %r failed", label, path)
             raise
 
     pool = concurrent.futures.ThreadPoolExecutor(max_workers=1)
@@ -276,22 +284,27 @@ def save_checkpoint_sharded(ckpt_dir: str, tree: Any,
     :func:`restore_checkpoint_sharded` will run with a different
     process-to-host mapping.
     """
-    os.makedirs(ckpt_dir, exist_ok=True)
+    _clean_stale_shards(ckpt_dir)
+    arrays, manifest, proc = _sharded_snapshot(tree, step)
+    _write_npz(os.path.join(ckpt_dir, f"shard_{proc}.npz"),
+               manifest, arrays)
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(
+            f"save_checkpoint_sharded:{ckpt_dir}")
+
+
+def _sharded_snapshot(tree, step, copy_host_leaves=False):
+    """Collect this process's shard arrays + manifest (stale-shard
+    cleanup is separate: :func:`_clean_stale_shards`).  D2H copies
+    complete before return, so the caller may donate/overwrite device
+    buffers immediately; ``copy_host_leaves`` additionally copies leaves
+    whose backing store is host memory — host-numpy leaves AND
+    CPU-backend device shards, where ``np.asarray`` is a zero-copy view
+    (the same donation-aliasing hazard :func:`_snapshot` guards)."""
     flat = jax.tree_util.tree_leaves_with_path(tree)
     proc = jax.process_index()
-    if proc == 0:
-        # drop stale shard files from an earlier save with MORE processes
-        # (restore validates file count == process_count; a leftover
-        # high-index shard would otherwise blend old weights in)
-        import glob as _glob
-
-        for old in _glob.glob(os.path.join(ckpt_dir, "shard_*.npz")):
-            try:
-                idx = int(os.path.basename(old)[len("shard_"):-len(".npz")])
-            except ValueError:
-                continue
-            if idx >= jax.process_count():
-                os.unlink(old)
     arrays, leaf_meta = {}, []
     for i, (p, x) in enumerate(flat):
         shape = tuple(np.shape(x))
@@ -303,22 +316,90 @@ def save_checkpoint_sharded(ckpt_dir: str, tree: Any,
                 # job writes each distinct slice
                 if sh.replica_id == 0 and key not in seen:
                     seen.add(key)
-                    arrays[f"leaf_{i}|{key}"] = np.asarray(sh.data)
+                    data = np.asarray(sh.data)
+                    if copy_host_leaves and sh.device.platform == "cpu":
+                        data = np.array(data)
+                    arrays[f"leaf_{i}|{key}"] = data
         elif proc == 0:  # host-numpy / scalar leaves: rank 0 owns
-            arrays[f"leaf_{i}|full"] = np.asarray(x)
+            host = np.asarray(x)
+            arrays[f"leaf_{i}|full"] = (np.array(host)
+                                        if copy_host_leaves else host)
         dtype = x.dtype if isinstance(x, jax.Array) else np.asarray(x).dtype
         leaf_meta.append({"path": _path_str(p), "shape": list(shape),
                           "dtype": str(dtype)})
     manifest = {"version": 1, "step": step, "sharded": True,
                 "process_count": jax.process_count(),
                 "leaves": leaf_meta}
-    _write_npz(os.path.join(ckpt_dir, f"shard_{proc}.npz"),
-               manifest, arrays)
-    if jax.process_count() > 1:
-        from jax.experimental import multihost_utils
+    return arrays, manifest, proc
 
-        multihost_utils.sync_global_devices(
-            f"save_checkpoint_sharded:{ckpt_dir}")
+
+def _clean_stale_shards(ckpt_dir) -> None:
+    """Rank 0 drops shard files from an earlier save with MORE processes
+    (restore validates file count == process_count; a leftover high-index
+    shard would otherwise blend old weights in)."""
+    os.makedirs(ckpt_dir, exist_ok=True)
+    if jax.process_index() != 0:
+        return
+    import glob as _glob
+
+    for old in _glob.glob(os.path.join(ckpt_dir, "shard_*.npz")):
+        try:
+            idx = int(os.path.basename(old)[len("shard_"):-len(".npz")])
+        except ValueError:
+            continue
+        if idx >= jax.process_count():
+            os.unlink(old)
+
+
+class ShardedSaveHandle:
+    """Handle for :func:`save_checkpoint_sharded_async`.
+
+    ``result()`` waits for this process's background write (re-raising
+    write errors).  ``finalize()`` waits and then runs the cross-process
+    barrier — call it from the **main thread on every process** before
+    relying on the checkpoint or starting the next save to the same dir
+    (collectives must not run on worker threads).
+    """
+
+    def __init__(self, future, ckpt_dir):
+        self._future = future
+        self._ckpt_dir = ckpt_dir
+
+    def done(self) -> bool:
+        return self._future.done()
+
+    def result(self, timeout=None):
+        return self._future.result(timeout)
+
+    def finalize(self, timeout=None):
+        path = self.result(timeout)
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices(
+                f"save_checkpoint_sharded:{self._ckpt_dir}")
+        return path
+
+
+def save_checkpoint_sharded_async(ckpt_dir: str, tree: Any,
+                                  step: Optional[int] = None
+                                  ) -> ShardedSaveHandle:
+    """Overlapped pod-scale checkpoint: the local-shard D2H snapshot runs
+    on the caller's thread (buffers may be donated immediately after the
+    call), the per-process ``shard_{p}.npz`` write runs in the
+    background.  Unlike :func:`save_checkpoint_async` this works
+    multi-host — no collective is needed for the snapshot (each process
+    touches only its own shards); the cross-process ordering barrier
+    moves into :meth:`ShardedSaveHandle.finalize`, which every process
+    must call from its main thread.
+    """
+    _clean_stale_shards(ckpt_dir)
+    arrays, manifest, proc = _sharded_snapshot(
+        tree, step, copy_host_leaves=True)
+    path = os.path.join(ckpt_dir, f"shard_{proc}.npz")
+    return ShardedSaveHandle(
+        _submit_write(path, manifest, arrays, "async sharded checkpoint"),
+        ckpt_dir)
 
 
 def restore_checkpoint_sharded(ckpt_dir: str, like: Any):

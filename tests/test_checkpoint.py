@@ -315,3 +315,41 @@ def test_sharded_rejects_stale_and_casts_dtype(tmp_path):
             np.asarray(r2["w"], np.float32), np.arange(32.0).reshape(8, 4))
     finally:
         parallel.mesh.destroy_model_parallel()
+
+
+def test_sharded_async_save_roundtrip(tmp_path):
+    """Async sharded save: device buffers may be donated immediately; the
+    background write lands and restores bit-exact after finalize()."""
+    from jax.sharding import NamedSharding
+
+    from apex_tpu.checkpoint import (
+        restore_checkpoint_sharded,
+        save_checkpoint_sharded_async,
+    )
+
+    mesh = parallel.initialize_model_parallel()
+    try:
+        sharding = NamedSharding(mesh, P(("dcn", "dp"), None))
+        w = jax.device_put(jnp.arange(32.0).reshape(8, 4), sharding)
+        host = np.arange(5)
+        ckpt = str(tmp_path / "async_sharded")
+        handle = save_checkpoint_sharded_async(
+            ckpt, {"w": w, "host": host}, step=9)
+
+        # overwrite the sources immediately (donation hazard): the
+        # snapshot must not see it
+        w_new = jax.jit(lambda a: a * 0 - 1.0, donate_argnums=0)(w)
+        host += 100
+        assert float(w_new[0, 0]) == -1.0
+
+        path = handle.finalize(timeout=30)
+        assert path.endswith("shard_0.npz")
+        like = {"w": jax.device_put(jnp.zeros((8, 4)), sharding),
+                "host": np.zeros(5, np.int64)}
+        restored, step = restore_checkpoint_sharded(ckpt, like)
+        assert step == 9
+        np.testing.assert_array_equal(np.asarray(restored["w"]),
+                                      np.arange(32.0).reshape(8, 4))
+        np.testing.assert_array_equal(restored["host"], np.arange(5))
+    finally:
+        parallel.mesh.destroy_model_parallel()
