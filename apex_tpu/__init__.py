@@ -28,7 +28,7 @@ Top-level layout (mirrors the reference export list ``apex/__init__.py:9``):
 
 import logging as _logging
 
-__version__ = "0.1.0"
+__version__ = "0.4.0"  # keep in sync with pyproject.toml
 
 __all__ = [
     "amp",

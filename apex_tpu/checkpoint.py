@@ -359,6 +359,14 @@ class ShardedSaveHandle:
     barrier — call it from the **main thread on every process** before
     relying on the checkpoint or starting the next save to the same dir
     (collectives must not run on worker threads).
+
+    ``timeout`` bounds only the **local** write wait; the barrier itself
+    is an unbounded collective, so if a peer rank's write fails (it
+    raises before reaching the barrier) the surviving ranks block in
+    ``finalize`` until the job's own failure detection (e.g.
+    ``jax.distributed`` heartbeats / the cluster runtime) tears the
+    collective down — the same failure mode as every collective save,
+    including the reference's rank-0 NCCL gather.
     """
 
     def __init__(self, future, ckpt_dir):

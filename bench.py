@@ -739,6 +739,9 @@ def bench_input_pipeline(jax, on_tpu):
             "workers": workers,
             "jpeg_side": side,
             "n_images": n_classes * per_class,
+            # host context: decode scales ~per core, so the same loader
+            # reads very differently on a 1-core sandbox vs a TPU-VM host
+            "host_cpus": os.cpu_count(),
         }
     finally:
         shutil.rmtree(root, ignore_errors=True)
