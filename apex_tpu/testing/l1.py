@@ -12,7 +12,10 @@ opt-level x keep-batchnorm x loss-scale; the TPU analog sweeps:
   the reference's ``--opt-level O{0..3} [--keep-batchnorm-fp32]
   [--loss-scale ...]`` matrix (``tests/L1/cross_product/run.sh``);
 - GPT: fp32 / bf16 / fp8 (delayed-scaling e4m3 GEMMs) — the transformer
-  numerics axis the reference's L1 suite covers with its BERT recipes.
+  numerics axis the reference's L1 suite covers with its BERT recipes;
+- GPT 3D-parallel: one dp=2 x pp=2(xvpp=2) x tp=2+sp train trace on the
+  8-virtual-device mesh, pinning the *parallel* numerics (collectives,
+  pipeline rotation, vocab-parallel CE) to a stored baseline.
 
 Synthetic data, fixed seeds, fp32 accumulation — traces are reproducible
 to fp tolerance across XLA releases on the same platform.  Dynamic-scale
@@ -208,6 +211,47 @@ def _trace_gpt(dtype=None, fp8: bool = False) -> Dict[str, List[float]]:
     return {"loss": losses, "grad_norm": gnorms}
 
 
+def _trace_gpt_3d() -> Dict[str, List[float]]:
+    """3D-parallel (dp=2 x pp=2(xvpp=2) x tp=2+sp) GPT train trace on the
+    8-virtual-device mesh — pins the *parallel* numerics (collectives,
+    pipeline rotation, vocab-parallel CE) to a stored baseline, not just
+    to same-run serial parity (``tests/test_gpt_3d.py``)."""
+    from apex_tpu import parallel
+    from apex_tpu.optimizers import FusedAdam
+    from apex_tpu.transformer.testing import TransformerConfig
+    from apex_tpu.transformer.testing.gpt_parallel_train import build_gpt_3d
+
+    mesh = parallel.initialize_model_parallel(
+        tensor_model_parallel_size=2,
+        pipeline_model_parallel_size=2,
+        virtual_pipeline_model_parallel_size=2,
+    )
+    try:
+        cfg = TransformerConfig(
+            hidden_size=32, num_layers=4, num_attention_heads=4,
+            padded_vocab_size=64, max_position_embeddings=16,
+            hidden_dropout=0.0, attention_dropout=0.0,
+            tensor_axis="tp", sequence_parallel=True,
+        )
+        init_fn, _, make_train_step = build_gpt_3d(
+            cfg, num_chunks=2, num_microbatches=2, mesh=mesh)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 16), 0, 64)
+        params, specs = init_fn(jax.random.PRNGKey(0), tokens)
+        opt = FusedAdam(lr=1e-3)
+        state = opt.init(params)
+        step = jax.jit(make_train_step(opt, specs))
+
+        losses = []
+        for _ in range(ITERS):
+            params, state, loss = step(params, state, tokens)
+            losses.append(float(loss))
+        # grad norms are inside the sharded step; the loss series alone
+        # pins the end-to-end parallel numerics
+        return {"loss": losses, "grad_norm": []}
+    finally:
+        parallel.mesh.destroy_model_parallel()
+
+
 CONFIGS = {
     # original two smoke configs (unchanged numerics, baselines kept)
     "rn50_smoke": partial(_trace_rn50, "O2", None, False),
@@ -223,6 +267,8 @@ CONFIGS = {
     # GPT numerics axis
     "gpt_bf16": partial(_trace_gpt, jnp.bfloat16),
     "gpt_fp8": partial(_trace_gpt, None, True),
+    # parallel numerics axis (dp x pp(xvpp) x tp+sp on the virtual mesh)
+    "gpt_3d": _trace_gpt_3d,
 }
 
 

@@ -741,7 +741,10 @@ def bench_input_pipeline(jax, on_tpu):
             "n_images": n_classes * per_class,
             # host context: decode scales ~per core, so the same loader
             # reads very differently on a 1-core sandbox vs a TPU-VM host
-            "host_cpus": os.cpu_count(),
+            # (sched_getaffinity = the EFFECTIVE quota under cgroups)
+            "host_cpus": (len(os.sched_getaffinity(0))
+                          if hasattr(os, "sched_getaffinity")
+                          else os.cpu_count()),
         }
     finally:
         shutil.rmtree(root, ignore_errors=True)
