@@ -691,7 +691,11 @@ def bench_input_pipeline(jax, on_tpu):
                     os.path.join(d, f"{i}.jpg"), quality=90)
 
         batch = 256 if on_tpu else 128  # >= 4 batches per epoch either way
-        workers = min(32, os.cpu_count() or 8)
+        # effective quota, not raw core count (matches the host_cpus field)
+        eff_cpus = (len(os.sched_getaffinity(0))
+                    if hasattr(os, "sched_getaffinity")
+                    else (os.cpu_count() or 8))
+        workers = min(32, eff_cpus)
         ds = ImageFolder(root)
 
         def measure(step_sleep: float):
@@ -742,9 +746,7 @@ def bench_input_pipeline(jax, on_tpu):
             # host context: decode scales ~per core, so the same loader
             # reads very differently on a 1-core sandbox vs a TPU-VM host
             # (sched_getaffinity = the EFFECTIVE quota under cgroups)
-            "host_cpus": (len(os.sched_getaffinity(0))
-                          if hasattr(os, "sched_getaffinity")
-                          else os.cpu_count()),
+            "host_cpus": eff_cpus,
         }
     finally:
         shutil.rmtree(root, ignore_errors=True)

@@ -42,6 +42,16 @@ from apex_tpu.parallel import replicate
 ARCHS = {"resnet18": ResNet18, "resnet50": ResNet50, "resnet101": ResNet101}
 
 
+def _split_dir(root, split):
+    """The reference's layout: ``root/train`` + ``root/val``
+    (``main_amp.py:205-206``); a flat class-dir root is used as-is for
+    both splits (handy for smoke runs)."""
+    import os
+
+    cand = os.path.join(root, split)
+    return cand if os.path.isdir(cand) else root
+
+
 def main(argv=None):
     p = argparse.ArgumentParser()
     p.add_argument("--data", default=None, metavar="DIR",
@@ -58,7 +68,13 @@ def main(argv=None):
     p.add_argument("--lr", type=float, default=0.1)
     p.add_argument("--steps", type=int, default=20)
     p.add_argument("--workers", type=int, default=8)
+    p.add_argument("--evaluate", action="store_true",
+                   help="run a validation pass (top-1/top-5) after "
+                        "training — the reference's validate() loop "
+                        "(main_amp.py:284-342); requires --data")
     args = p.parse_args(argv)
+    if args.evaluate and args.data is None:
+        p.error("--evaluate requires --data")
 
     mesh = parallel.initialize_model_parallel()
     print(parallel.mesh.get_rank_info())
@@ -118,7 +134,7 @@ def main(argv=None):
             f"data-parallel world size ({dp})")
     loader = None
     if args.data is not None:
-        dataset = ImageFolder(args.data)
+        dataset = ImageFolder(_split_dir(args.data, "train"))
         print(f"ImageFolder: {len(dataset)} samples, "
               f"{len(dataset.classes)} classes, dp={dp}")
         loader = ImageFolderLoader(
@@ -161,7 +177,72 @@ def main(argv=None):
     dt = time.perf_counter() - t0
     ips = args.batch_size * (args.steps - 1) / dt if args.steps > 1 else 0.0
     print(f"throughput: {ips:.1f} images/sec ({dt:.2f}s for {args.steps-1} steps)")
+
+    if args.evaluate:
+        prec1, prec5 = validate(model, params, batch_stats, policy, mesh,
+                                args)
+        print(f"validation: prec@1 {prec1:.3f}  prec@5 {prec5:.3f}")
     return ips
+
+
+def validate(model, params, batch_stats, policy, mesh, args):
+    """One pass over the eval split: center-crop transform, running BN
+    stats, top-1/top-5 accuracy — the reference's ``validate()`` +
+    ``accuracy(output, target, topk=(1, 5))`` (``main_amp.py:284-342,
+    391-403``), as a jitted eval step over the dp mesh.
+
+    Covers **every** sample (the reference's non-drop_last val loader):
+    images are walked in order and the final partial batch is padded to
+    the fixed batch shape with a validity mask, so no tail is dropped,
+    shapes stay static for jit, and sets smaller than one batch work.
+    """
+    import numpy as np
+
+    from apex_tpu.data import center_crop_resize
+    from apex_tpu.parallel import dp_shard_batch
+
+    val_dir = _split_dir(args.data, "val")
+    if val_dir == args.data:
+        print("warning: no val/ subdirectory under --data; evaluating "
+              "over the full folder (train accuracy, not validation)")
+    dataset = ImageFolder(val_dir)
+    k = min(5, args.num_classes)
+
+    @jax.jit
+    def eval_step(params, batch_stats, batch):
+        x_uint8, y, valid = batch
+        x = normalize_on_device(x_uint8, dtype=policy.compute_dtype)
+        logits = model.apply(
+            {"params": params, "batch_stats": batch_stats}, x, train=False)
+        topk = jax.lax.top_k(logits.astype(jnp.float32), k)[1]
+        hit1 = (topk[:, 0] == y) & valid
+        hitk = (topk == y[:, None]).any(axis=1) & valid
+        return jnp.sum(hit1), jnp.sum(hitk)
+
+    from concurrent.futures import ThreadPoolExecutor
+
+    def decode(i):
+        img, label = dataset.load(i)
+        return center_crop_resize(img, args.image_size), label
+
+    n = c1 = c5 = 0
+    batch = args.batch_size
+    with ThreadPoolExecutor(max_workers=args.workers) as pool:
+        for start in range(0, len(dataset), batch):
+            idxs = list(range(start, min(start + batch, len(dataset))))
+            decoded = list(pool.map(decode, idxs))
+            pad = batch - len(decoded)
+            xs = np.stack([d[0] for d in decoded]
+                          + [decoded[-1][0]] * pad)
+            ys = np.asarray([d[1] for d in decoded]
+                            + [decoded[-1][1]] * pad, np.int32)
+            valid = np.arange(batch) < len(decoded)
+            h1, h5 = eval_step(params, batch_stats,
+                               dp_shard_batch((xs, ys, valid), mesh))
+            c1 += int(h1)
+            c5 += int(h5)
+            n += len(decoded)
+    return (c1 / max(n, 1), c5 / max(n, 1))
 
 
 if __name__ == "__main__":
