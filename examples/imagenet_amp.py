@@ -44,12 +44,23 @@ ARCHS = {"resnet18": ResNet18, "resnet50": ResNet50, "resnet101": ResNet101}
 
 def _split_dir(root, split):
     """The reference's layout: ``root/train`` + ``root/val``
-    (``main_amp.py:205-206``); a flat class-dir root is used as-is for
-    both splits (handy for smoke runs)."""
+    (``main_amp.py:205-206``).  A flat class-dir root (no ``train/`` AND
+    no ``val/``) is used as-is for both splits (handy for smoke runs);
+    a *partial* layout (one split dir present, the other missing) is an
+    error — falling back silently would scan the wrong directory level
+    and mislabel or crash after training."""
     import os
 
-    cand = os.path.join(root, split)
-    return cand if os.path.isdir(cand) else root
+    have = {s: os.path.isdir(os.path.join(root, s))
+            for s in ("train", "val")}
+    if not any(have.values()):
+        return root  # flat layout
+    if not have[split]:
+        raise SystemExit(
+            f"--data {root!r} has a {'train' if have['train'] else 'val'}/ "
+            f"subdirectory but no {split}/ — partial split layouts are "
+            "ambiguous (reference layout: root/train + root/val)")
+    return os.path.join(root, split)
 
 
 def main(argv=None):
@@ -75,6 +86,8 @@ def main(argv=None):
     args = p.parse_args(argv)
     if args.evaluate and args.data is None:
         p.error("--evaluate requires --data")
+    if args.evaluate:
+        _split_dir(args.data, "val")  # fail fast on partial layouts
 
     mesh = parallel.initialize_model_parallel()
     print(parallel.mesh.get_rank_info())
@@ -179,9 +192,9 @@ def main(argv=None):
     print(f"throughput: {ips:.1f} images/sec ({dt:.2f}s for {args.steps-1} steps)")
 
     if args.evaluate:
-        prec1, prec5 = validate(model, params, batch_stats, policy, mesh,
-                                args)
-        print(f"validation: prec@1 {prec1:.3f}  prec@5 {prec5:.3f}")
+        prec1, preck, k = validate(model, params, batch_stats, policy,
+                                   mesh, args)
+        print(f"validation: prec@1 {prec1:.3f}  prec@{k} {preck:.3f}")
     return ips
 
 
@@ -203,7 +216,7 @@ def validate(model, params, batch_stats, policy, mesh, args):
 
     val_dir = _split_dir(args.data, "val")
     if val_dir == args.data:
-        print("warning: no val/ subdirectory under --data; evaluating "
+        print("warning: flat --data layout (no val/ split); evaluating "
               "over the full folder (train accuracy, not validation)")
     dataset = ImageFolder(val_dir)
     k = min(5, args.num_classes)
@@ -225,24 +238,34 @@ def validate(model, params, batch_stats, policy, mesh, args):
         img, label = dataset.load(i)
         return center_crop_resize(img, args.image_size), label
 
-    n = c1 = c5 = 0
     batch = args.batch_size
+    n = 0
+    c1 = c5 = jnp.int32(0)  # device accumulators: no per-batch host sync
+
+    def assemble(futs):
+        decoded = [f.result() for f in futs]
+        pad = batch - len(decoded)
+        xs = np.stack([d[0] for d in decoded] + [decoded[-1][0]] * pad)
+        ys = np.asarray([d[1] for d in decoded]
+                        + [decoded[-1][1]] * pad, np.int32)
+        valid = np.arange(batch) < len(decoded)
+        return dp_shard_batch((xs, ys, valid), mesh), len(decoded)
+
     with ThreadPoolExecutor(max_workers=args.workers) as pool:
-        for start in range(0, len(dataset), batch):
-            idxs = list(range(start, min(start + batch, len(dataset))))
-            decoded = list(pool.map(decode, idxs))
-            pad = batch - len(decoded)
-            xs = np.stack([d[0] for d in decoded]
-                          + [decoded[-1][0]] * pad)
-            ys = np.asarray([d[1] for d in decoded]
-                            + [decoded[-1][1]] * pad, np.int32)
-            valid = np.arange(batch) < len(decoded)
-            h1, h5 = eval_step(params, batch_stats,
-                               dp_shard_batch((xs, ys, valid), mesh))
-            c1 += int(h1)
-            c5 += int(h5)
-            n += len(decoded)
-    return (c1 / max(n, 1), c5 / max(n, 1))
+        starts = list(range(0, len(dataset), batch))
+        submit = lambda s: [  # noqa: E731
+            pool.submit(decode, i)
+            for i in range(s, min(s + batch, len(dataset)))]
+        pending = submit(starts[0])
+        for j, start in enumerate(starts):
+            batch_dev, n_real = assemble(pending)
+            if j + 1 < len(starts):  # decode of batch j+1 overlaps eval j
+                pending = submit(starts[j + 1])
+            h1, h5 = eval_step(params, batch_stats, batch_dev)
+            c1 = c1 + h1
+            c5 = c5 + h5
+            n += n_real
+    return (int(c1) / max(n, 1), int(c5) / max(n, 1), k)
 
 
 if __name__ == "__main__":

@@ -118,6 +118,34 @@ class TestExampleCLIs:
                     "--num-classes", "2", "--steps", "3", "--workers", "2"])
         assert ips > 0
 
+    def test_imagenet_amp_evaluate(self, tmp_path, capsys):
+        """--evaluate: train/val layout, full-coverage top-k validation
+        incl. a val set smaller than one batch (padded+masked tail)."""
+        from PIL import Image
+
+        rng = np.random.RandomState(1)
+        for split, per_cls in (("train", 12), ("val", 5)):
+            for ci, cls in enumerate(("dark", "bright")):
+                d = tmp_path / split / cls
+                d.mkdir(parents=True)
+                lo, hi = (0, 100) if ci == 0 else (156, 256)
+                for i in range(per_cls):
+                    arr = rng.randint(lo, hi, (48, 48, 3), dtype=np.uint8)
+                    Image.fromarray(arr).save(d / f"{i}.png")
+
+        from examples.imagenet_amp import main
+
+        # val set (10) < batch (16): exercises the padded/masked tail
+        main(["--data", str(tmp_path), "--arch", "resnet18",
+              "--batch-size", "16", "--image-size", "32",
+              "--num-classes", "2", "--steps", "25", "--lr", "0.01",
+              "--workers", "2", "--evaluate"])
+        out = capsys.readouterr().out
+        line = [l for l in out.splitlines() if "validation:" in l]
+        assert line, out
+        prec1 = float(line[0].split("prec@1")[1].split()[0])
+        assert prec1 >= 0.8, line[0]  # separable classes: learned
+
     def test_dcgan_amp(self):
         from examples.dcgan_amp import main
 
