@@ -217,13 +217,19 @@ def main(argv=None):
         s_gen = conf.loss_scaler.update(s_gen, finite)
         return new_pG, bsG, new_osG, s_gen, errG, d_g2
 
-    it = (folder_batches(args.dataroot, args.batch_size)
-          if args.dataroot else fake_batches(args.batch_size))
+    from apex_tpu.data import prefetch_to_device
+
+    host_it = (folder_batches(args.dataroot, args.batch_size)
+               if args.dataroot else fake_batches(args.batch_size))
+    # H2D transfers run 2 batches ahead of the D/G steps (the reference
+    # data_prefetcher role).  Plain device_put placement: this example's
+    # jitted steps use default sharding (the GAN batch is not dp-sharded).
+    it = prefetch_to_device(host_it, depth=2, place=jax.device_put)
     rng = np.random.RandomState(args.seed)
     t0 = time.perf_counter()
     errD = errG = None
     for i in range(args.steps):
-        real = jnp.asarray(next(it))
+        real = next(it)
         z = jnp.asarray(rng.randn(args.batch_size, args.nz), np.float32)
         (pD, bsD, osD, s_real, s_fake, errD, d_x, d_g1) = d_step(
             pD, bsD, osD, pG, bsG, real, z, s_real, s_fake)

@@ -258,9 +258,12 @@ def validate(model, params, batch_stats, policy, mesh, args):
             for i in range(s, min(s + batch, len(dataset)))]
         pending = submit(starts[0])
         for j, start in enumerate(starts):
-            batch_dev, n_real = assemble(pending)
-            if j + 1 < len(starts):  # decode of batch j+1 overlaps eval j
+            futs = pending
+            if j + 1 < len(starts):
+                # submit j+1 BEFORE blocking on j's stragglers: freed
+                # workers roll straight into the next batch
                 pending = submit(starts[j + 1])
+            batch_dev, n_real = assemble(futs)
             h1, h5 = eval_step(params, batch_stats, batch_dev)
             c1 = c1 + h1
             c5 = c5 + h5
