@@ -1,0 +1,51 @@
+"""Shared lazy cc-compile-and-load for the native host kernels.
+
+One implementation of the build contract both ``utils/flatten.py``
+(``flatcopy.c``) and ``data/_jpeg_native.py`` (``jpegdec.c``) rely on:
+
+- rebuild only when the source is newer than the ``.so`` (mtime);
+- compile to a pid-suffixed temp name and ``os.replace`` — an atomic
+  publish, so concurrent processes never load a half-written library,
+  and the temp file is removed when the compile fails;
+- any failure (no compiler, missing system lib, ...) returns ``None``
+  and the caller keeps its pure-Python fallback.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional, Sequence
+
+__all__ = ["build_and_load"]
+
+_NATIVE_DIR = os.path.dirname(os.path.abspath(__file__))
+
+
+def build_and_load(src_name: str, so_name: str,
+                   extra_flags: Sequence[str] = ()
+                   ) -> Optional[ctypes.CDLL]:
+    """Compile ``_native/<src_name>`` -> ``_native/<so_name>`` (if stale)
+    and load it; ``None`` on any failure.  Callers add their own argtypes
+    and caching (this function does a filesystem stat per call)."""
+    src = os.path.join(_NATIVE_DIR, src_name)
+    so = os.path.join(_NATIVE_DIR, so_name)
+    tmp = f"{so}.{os.getpid()}.tmp"
+    try:
+        needs_build = os.path.exists(src) and (
+            not os.path.exists(so)
+            or os.path.getmtime(so) < os.path.getmtime(src))
+        if needs_build:
+            try:
+                subprocess.run(
+                    ["cc", "-O3", "-shared", "-fPIC", src, "-o", tmp,
+                     *extra_flags],
+                    check=True, capture_output=True, timeout=120)
+                os.replace(tmp, so)
+            finally:
+                if os.path.exists(tmp):
+                    os.unlink(tmp)
+        return ctypes.CDLL(so)
+    except Exception:
+        return None

@@ -14,6 +14,7 @@ from apex_tpu.data.image_folder import (
     center_crop_resize,
     normalize_on_device,
     random_resized_crop,
+    sample_crop_box,
     synthetic_image_batches,
 )
 from apex_tpu.data.prefetch import prefetch_to_device
@@ -25,5 +26,6 @@ __all__ = [
     "normalize_on_device",
     "prefetch_to_device",
     "random_resized_crop",
+    "sample_crop_box",
     "synthetic_image_batches",
 ]

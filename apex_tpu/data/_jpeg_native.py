@@ -1,0 +1,98 @@
+"""ctypes loader for the native JPEG decode kernel (``_native/jpegdec.c``).
+
+Compiled lazily with the system ``cc`` and linked against the system
+libjpeg (same build pattern as :mod:`apex_tpu.utils.flatten`); every entry
+point degrades cleanly — :func:`native_available` is False when there is
+no compiler or no libjpeg, and :func:`decode_crop_resize` returns ``None``
+on any per-image decode failure (corrupt file, CMYK, ...) so the caller
+can fall back to PIL for that image.
+
+This is the decode stage of the input pipeline the reference recipe gets
+from DataLoader workers + DALI (``examples/imagenet/main_amp.py:207-232``);
+see ``jpegdec.c`` for what the kernel fuses.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+__all__ = ["native_available", "jpeg_dims", "decode_crop_resize"]
+
+_LOCK = threading.Lock()
+_LIB: Optional[ctypes.CDLL] = None
+_TRIED = False
+
+
+def _build_and_load() -> Optional[ctypes.CDLL]:
+    global _LIB, _TRIED
+    if _TRIED:  # lock-free fast path
+        return _LIB
+    with _LOCK:
+        if _TRIED:
+            return _LIB
+        try:
+            from apex_tpu._native.build import build_and_load
+
+            lib = build_and_load("jpegdec.c", "libjpegdec.so", ["-ljpeg"])
+            if lib is not None:
+                # inside the except: a stale .so missing the symbols must
+                # degrade to PIL, not raise out of the loader constructor
+                lib.jpegdec_dims.argtypes = [
+                    ctypes.c_char_p, ctypes.c_size_t,
+                    ctypes.POINTER(ctypes.c_int),
+                    ctypes.POINTER(ctypes.c_int)]
+                lib.jpegdec_dims.restype = ctypes.c_int
+                lib.jpegdec_decode_crop_resize.argtypes = [
+                    ctypes.c_char_p, ctypes.c_size_t,
+                    ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+                    ctypes.c_int, ctypes.c_int, ctypes.c_int,
+                    ctypes.c_void_p]
+                lib.jpegdec_decode_crop_resize.restype = ctypes.c_int
+        except Exception:
+            lib = None
+        _LIB = lib
+        _TRIED = True
+        return _LIB
+
+
+def native_available() -> bool:
+    """True when the kernel compiled and loaded (cc + libjpeg present)."""
+    return _build_and_load() is not None
+
+
+def jpeg_dims(data: bytes) -> Optional[Tuple[int, int]]:
+    """Header-only ``(height, width)`` of a JPEG byte string, or ``None``
+    when the native kernel is unavailable or the header does not parse."""
+    lib = _build_and_load()
+    if lib is None:
+        return None
+    h = ctypes.c_int()
+    w = ctypes.c_int()
+    if lib.jpegdec_dims(data, len(data), ctypes.byref(h),
+                        ctypes.byref(w)) != 0:
+        return None
+    return h.value, w.value
+
+
+def decode_crop_resize(data: bytes, cy: int, cx: int, ch: int, cw: int,
+                       out_h: int, out_w: int, hflip: bool = False
+                       ) -> Optional[np.ndarray]:
+    """Decode + crop (full-res source coords) + bilinear resize in one
+    native call -> uint8 HWC ``(out_h, out_w, 3)``, or ``None`` on any
+    failure (caller falls back to PIL).  The decode runs at the smallest
+    M/8 DCT scale that still covers the output size."""
+    lib = _build_and_load()
+    if lib is None:
+        return None
+    out = np.empty((out_h, out_w, 3), np.uint8)
+    rc = lib.jpegdec_decode_crop_resize(
+        data, len(data), int(cy), int(cx), int(ch), int(cw),
+        int(out_h), int(out_w), int(bool(hflip)),
+        out.ctypes.data_as(ctypes.c_void_p))
+    if rc != 0:
+        return None
+    return out

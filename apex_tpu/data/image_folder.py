@@ -19,8 +19,12 @@ TPU-first differences:
   (:mod:`apex_tpu.transformer._data`) so ``consumed_samples`` checkpoint
   resume works for vision runs too (one sampler per dp rank, stacked into
   the global batch that ``dp_shard_batch`` lays onto the mesh);
-- decode parallelism is a thread pool (PIL decode releases the GIL), the
-  analog of ``DataLoader(num_workers=...)`` without worker processes;
+- decode parallelism is a thread pool (both decode paths release the
+  GIL), the analog of ``DataLoader(num_workers=...)`` without worker
+  processes; per-image decode prefers the native C kernel
+  (``_native/jpegdec.c`` — DCT-scaled libjpeg decode fused with the
+  crop + bilinear resize, ~1.5-2x a PIL worker per core, the role of
+  the reference recipe's DALI stage) and falls back to PIL per-image;
 - batches are decoded ``prefetch`` steps ahead: the loader keeps the
   decode futures for the next batches in flight while the caller's train
   step runs on device, so host decode overlaps device compute — the role
@@ -47,6 +51,7 @@ __all__ = [
     "center_crop_resize",
     "normalize_on_device",
     "random_resized_crop",
+    "sample_crop_box",
     "synthetic_image_batches",
 ]
 
@@ -97,14 +102,16 @@ class ImageFolder:
             return img.convert("RGB"), label
 
 
-def random_resized_crop(rng: np.random.RandomState, img, size: int,
-                        scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
-                        flip: bool = True) -> np.ndarray:
-    """``RandomResizedCrop(size)`` + ``RandomHorizontalFlip`` -> uint8
-    HWC (the reference's train transform, ``main_amp.py:209-214``)."""
-    from PIL import Image
-
-    w, h = img.size
+def sample_crop_box(rng: np.random.RandomState, w: int, h: int,
+                    scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3)
+                    ) -> Tuple[int, int, int, int]:
+    """``RandomResizedCrop``'s box sampler -> ``(x0, y0, cw, ch)`` in
+    source coordinates.  After 10 rejected draws it falls back to
+    torchvision's ratio-clamped center crop (the whole image when its
+    aspect ratio is inside ``ratio``, else the largest in-bounds region).
+    Shared by the PIL and native decode paths so both consume the *same*
+    RNG draw sequence — the augmentation stream is identical whichever
+    path decodes."""
     area = w * h
     for _ in range(10):
         target = area * rng.uniform(*scale)
@@ -115,10 +122,29 @@ def random_resized_crop(rng: np.random.RandomState, img, size: int,
         if 0 < cw <= w and 0 < ch <= h:
             x0 = rng.randint(0, w - cw + 1)
             y0 = rng.randint(0, h - ch + 1)
-            img = img.crop((x0, y0, x0 + cw, y0 + ch))
-            break
-    else:  # fallback: center crop of the maximal in-ratio region
-        img = center_crop(img, min(w, h))
+            return x0, y0, cw, ch
+    in_ratio = w / h
+    if in_ratio < min(ratio):
+        cw = w
+        ch = int(round(cw / min(ratio)))
+    elif in_ratio > max(ratio):
+        ch = h
+        cw = int(round(ch * max(ratio)))
+    else:
+        cw, ch = w, h
+    return (w - cw) // 2, (h - ch) // 2, cw, ch
+
+
+def random_resized_crop(rng: np.random.RandomState, img, size: int,
+                        scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                        flip: bool = True) -> np.ndarray:
+    """``RandomResizedCrop(size)`` + ``RandomHorizontalFlip`` -> uint8
+    HWC (the reference's train transform, ``main_amp.py:209-214``)."""
+    from PIL import Image
+
+    w, h = img.size
+    x0, y0, cw, ch = sample_crop_box(rng, w, h, scale, ratio)
+    img = img.crop((x0, y0, x0 + cw, y0 + ch))
     img = img.resize((size, size), Image.BILINEAR)
     out = np.asarray(img, np.uint8)
     if flip and rng.rand() < 0.5:
@@ -133,13 +159,33 @@ def center_crop(img, crop: int):
     return img.crop((x0, y0, x0 + crop, y0 + crop))
 
 
+def _default_eval_resize(size: int) -> int:
+    """The eval transform's short-side resize for a given crop size —
+    256 for the canonical 224 (``main_amp.py:216-219``).  One definition
+    shared by the PIL and native eval paths so they cannot skew."""
+    return int(size * 256 / 224)
+
+
+def eval_crop_box(w: int, h: int, size: int,
+                  resize: Optional[int] = None) -> Tuple[int, int, int]:
+    """Source-coordinate square ``(x0, y0, side)`` that the eval
+    transform ``Resize(resize)`` + ``CenterCrop(size)`` keeps.  The
+    native decode path crops this region and resizes straight to
+    ``(size, size)``; :func:`center_crop_resize` realizes the same
+    geometry through PIL's resize-then-crop."""
+    resize = resize or _default_eval_resize(size)
+    short = min(w, h)
+    side = min(int(round(short * size / resize)), short)
+    return (w - side) // 2, (h - side) // 2, side
+
+
 def center_crop_resize(img, size: int, resize: Optional[int] = None
                        ) -> np.ndarray:
     """``Resize(resize)`` + ``CenterCrop(size)`` -> uint8 HWC (the
     reference's eval transform, ``main_amp.py:216-219``)."""
     from PIL import Image
 
-    resize = resize or int(size * 256 / 224)
+    resize = resize or _default_eval_resize(size)
     w, h = img.size
     short = min(w, h)
     img = img.resize((int(round(w * resize / short)),
@@ -177,7 +223,8 @@ class ImageFolderLoader:
     def __init__(self, dataset: ImageFolder, local_batch: int,
                  data_parallel_size: int = 1, image_size: int = 224,
                  consumed_samples: int = 0, train: bool = True,
-                 workers: int = 8, seed: int = 0, prefetch: int = 2):
+                 workers: int = 8, seed: int = 0, prefetch: int = 2,
+                 native: Optional[bool] = None):
         from apex_tpu.transformer._data import (
             MegatronPretrainingRandomSampler,
         )
@@ -189,6 +236,21 @@ class ImageFolderLoader:
         self.train = train
         self.seed = seed
         self.prefetch = max(0, prefetch)
+        # native=None -> auto: the C decode kernel when it builds (cc +
+        # libjpeg present), PIL otherwise; failures of either the build
+        # or any single image fall back to PIL per-image.  An explicit
+        # native=True warns when the kernel is unavailable so an A/B
+        # comparison cannot silently run PIL on both sides.
+        if native is None or native:
+            from apex_tpu.data import _jpeg_native
+            self._native = _jpeg_native.native_available()
+            if native and not self._native:
+                import warnings
+                warnings.warn(
+                    "ImageFolderLoader(native=True): native JPEG kernel "
+                    "unavailable (no cc or libjpeg?); decoding via PIL")
+        else:
+            self._native = False
         self._inflight = 0  # batches decoded/decoding ahead of the caller
         self._pool = ThreadPoolExecutor(max_workers=workers)
         self.samplers = [
@@ -232,7 +294,6 @@ class ImageFolderLoader:
 
     def _decode(self, index: int, consumed_marker: int
                 ) -> Tuple[np.ndarray, int]:
-        img, label = self.dataset.load(index)
         if self.train:
             # fold the sample index + sampler position into the seed:
             # deterministic but different augmentation per sample and
@@ -240,9 +301,60 @@ class ImageFolderLoader:
             # augmentation stream is identical at every prefetch depth.
             rng = np.random.RandomState(
                 (self.seed + consumed_marker + index) % (2 ** 31))
+        else:
+            rng = None
+        if self._native:
+            # snapshot the RNG: a native failure *after* the crop draws
+            # (e.g. truncated file) must hand PIL the same stream it
+            # would have seen had the native path never run
+            state = rng.get_state() if rng is not None else None
+            out = self._decode_native(index, rng)
+            if out is not None:
+                return out
+            if state is not None:
+                rng.set_state(state)
+        img, label = self.dataset.load(index)
+        if self.train:
             arr = random_resized_crop(rng, img, self.image_size)
         else:
             arr = center_crop_resize(img, self.image_size)
+        return arr, label
+
+    def _decode_native(self, index: int,
+                       rng: Optional[np.random.RandomState]
+                       ) -> Optional[Tuple[np.ndarray, int]]:
+        """One-call C decode+crop+resize (``_native/jpegdec.c``) — DCT
+        scaled decode fused with the transform, ~2x a PIL worker on the
+        same core.  Returns ``None`` (caller decodes via PIL) for
+        non-JPEG files or any per-image failure.  Draws the crop box
+        from the SAME :func:`sample_crop_box` stream as the PIL path, so
+        augmentation determinism is path-independent."""
+        from apex_tpu.data import _jpeg_native
+
+        path, label = self.dataset.samples[index]
+        if not path.lower().endswith((".jpg", ".jpeg")):
+            return None
+        try:
+            with open(path, "rb") as f:
+                data = f.read()
+        except OSError:
+            return None
+        dims = _jpeg_native.jpeg_dims(data)
+        if dims is None:
+            return None
+        h, w = dims
+        size = self.image_size
+        if rng is not None:  # train transform
+            x0, y0, cw, ch = sample_crop_box(rng, w, h)
+            flip = bool(rng.rand() < 0.5)
+        else:  # eval: the region center_crop_resize would keep
+            x0, y0, side = eval_crop_box(w, h, size)
+            cw = ch = side
+            flip = False
+        arr = _jpeg_native.decode_crop_resize(
+            data, y0, x0, ch, cw, size, size, hflip=flip)
+        if arr is None:
+            return None
         return arr, label
 
     def __iter__(self) -> Iterator[Tuple[np.ndarray, np.ndarray]]:

@@ -24,8 +24,6 @@ build scaffolding for future native host components.
 from __future__ import annotations
 
 import ctypes
-import os
-import subprocess
 import threading
 from typing import List, Optional, Sequence
 
@@ -46,33 +44,23 @@ def _build_and_load() -> Optional[ctypes.CDLL]:
     with _LOCK:
         if _TRIED:
             return _LIB
-        here = os.path.dirname(os.path.abspath(__file__))
-        src = os.path.join(os.path.dirname(here), "_native", "flatcopy.c")
-        so = os.path.join(os.path.dirname(here), "_native",
-                          "libflatcopy.so")
         try:
-            needs_build = os.path.exists(src) and (
-                not os.path.exists(so)
-                or os.path.getmtime(so) < os.path.getmtime(src))
-            if needs_build:
-                # compile to a temp name and rename: atomic publish, so
-                # concurrent processes never load a half-written .so
-                tmp = f"{so}.{os.getpid()}.tmp"
-                subprocess.run(
-                    ["cc", "-O3", "-shared", "-fPIC", "-fopenmp",
-                     src, "-o", tmp],
-                    check=True, capture_output=True, timeout=120)
-                os.replace(tmp, so)
-            lib = ctypes.CDLL(so)
-            lib.flat_gather.argtypes = [
-                ctypes.c_void_p, ctypes.POINTER(ctypes.c_void_p),
-                ctypes.POINTER(ctypes.c_int64), ctypes.c_int64]
-            lib.flat_scatter.argtypes = [
-                ctypes.c_void_p, ctypes.POINTER(ctypes.c_void_p),
-                ctypes.POINTER(ctypes.c_int64), ctypes.c_int64]
-            _LIB = lib
+            from apex_tpu._native.build import build_and_load
+
+            lib = build_and_load("flatcopy.c", "libflatcopy.so",
+                                 ["-fopenmp"])
+            if lib is not None:
+                # inside the except: a loaded .so missing the expected
+                # symbols (stale artifact) must also fall back to numpy
+                lib.flat_gather.argtypes = [
+                    ctypes.c_void_p, ctypes.POINTER(ctypes.c_void_p),
+                    ctypes.POINTER(ctypes.c_int64), ctypes.c_int64]
+                lib.flat_scatter.argtypes = [
+                    ctypes.c_void_p, ctypes.POINTER(ctypes.c_void_p),
+                    ctypes.POINTER(ctypes.c_int64), ctypes.c_int64]
         except Exception:
-            _LIB = None
+            lib = None
+        _LIB = lib
         _TRIED = True
         return _LIB
 

@@ -747,9 +747,20 @@ def bench_input_pipeline(jax, on_tpu):
             # reads very differently on a 1-core sandbox vs a TPU-VM host
             # (sched_getaffinity = the EFFECTIVE quota under cgroups)
             "host_cpus": eff_cpus,
+            # which decode stage ran: the C kernel (_native/jpegdec.c,
+            # DCT-scaled decode fused with crop+resize) or the PIL path
+            "native_decode": _native_decode_available(),
         }
     finally:
         shutil.rmtree(root, ignore_errors=True)
+
+
+def _native_decode_available() -> bool:
+    try:
+        from apex_tpu.data import _jpeg_native
+        return _jpeg_native.native_available()
+    except Exception:
+        return False
 
 
 def bench_fused_adam_step(jax, on_tpu):

@@ -1,0 +1,227 @@
+"""Native JPEG decode kernel (``_native/jpegdec.c``) and its loader wiring.
+
+The kernel is the decode stage of the input pipeline the reference recipe
+gets from DataLoader workers/DALI (``examples/imagenet/main_amp.py:207-232``):
+DCT-scaled decode fused with crop + bilinear resize.  Bit-exactness with
+PIL is a non-goal (different resamplers: PIL's BILINEAR is an antialiased
+filter, the kernel point-samples); the contract tested here is
+  - geometry: same crop region, same output shape, close pixels on
+    smooth images;
+  - the augmentation RNG stream is identical on the native and PIL paths
+    (same boxes, same flips), so swapping decoders never changes the
+    data order or the draw sequence;
+  - every failure (corrupt file, CMYK, non-JPEG) degrades to PIL
+    per-image, never raises out of the loader.
+"""
+
+import io
+import os
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from apex_tpu.data import _jpeg_native as jn
+from apex_tpu.data import (
+    ImageFolder,
+    ImageFolderLoader,
+    center_crop_resize,
+    random_resized_crop,
+    sample_crop_box,
+)
+
+pytestmark = pytest.mark.skipif(
+    not jn.native_available(), reason="no cc/libjpeg: native decode absent")
+
+
+def smooth_image(h, w):
+    yy, xx = np.mgrid[0:h, 0:w]
+    return np.stack([xx * 255 // max(w, 1), yy * 255 // max(h, 1),
+                     (xx + yy) * 255 // (h + w)], -1).astype(np.uint8)
+
+
+def jpeg_bytes(arr, quality=95):
+    buf = io.BytesIO()
+    Image.fromarray(arr).save(buf, format="JPEG", quality=quality)
+    return buf.getvalue()
+
+
+def test_dims():
+    data = jpeg_bytes(smooth_image(300, 400))
+    assert jn.jpeg_dims(data) == (300, 400)
+    assert jn.jpeg_dims(data[:50]) is None
+
+
+@pytest.mark.parametrize("src,crop,out", [
+    ((300, 400), (10, 20, 280, 360), 224),   # downscale, 7/8 DCT scale
+    ((600, 800), (0, 0, 600, 800), 224),     # deep downscale, <=4/8
+    ((100, 120), (5, 5, 90, 110), 224),      # upscale (crop < out)
+    ((300, 300), (140, 140, 20, 20), 64),    # tiny crop upscaled
+])
+def test_decode_matches_pil_geometry(src, crop, out):
+    arr = smooth_image(*src)
+    data = jpeg_bytes(arr)
+    cy, cx, ch, cw = crop
+    got = jn.decode_crop_resize(data, cy, cx, ch, cw, out, out)
+    assert got.shape == (out, out, 3) and got.dtype == np.uint8
+    img = Image.open(io.BytesIO(data)).convert("RGB")
+    ref = np.asarray(
+        img.crop((cx, cy, cx + cw, cy + ch)).resize((out, out),
+                                                    Image.BILINEAR),
+        np.uint8)
+    # smooth content: resampler differences stay small
+    assert np.abs(got.astype(int) - ref.astype(int)).mean() < 4.0
+
+
+def test_hflip_is_exact_mirror():
+    data = jpeg_bytes(smooth_image(200, 260))
+    a = jn.decode_crop_resize(data, 8, 12, 180, 240, 128, 128)
+    b = jn.decode_crop_resize(data, 8, 12, 180, 240, 128, 128, hflip=True)
+    assert np.array_equal(b, a[:, ::-1])
+
+
+def test_grayscale_promoted_to_rgb():
+    arr = smooth_image(180, 220)[:, :, 0]
+    buf = io.BytesIO()
+    Image.fromarray(arr, "L").save(buf, format="JPEG", quality=95)
+    got = jn.decode_crop_resize(buf.getvalue(), 0, 0, 180, 220, 96, 96)
+    assert got.shape == (96, 96, 3)
+    assert np.ptp(got[..., 0].astype(int) - got[..., 1].astype(int)) <= 2
+
+
+def test_failures_return_none():
+    data = jpeg_bytes(smooth_image(100, 100))
+    assert jn.decode_crop_resize(data[:60], 0, 0, 50, 50, 32, 32) is None
+    assert jn.decode_crop_resize(b"not a jpeg", 0, 0, 1, 1, 8, 8) is None
+    # out-of-bounds crop is an argument error, not a crash
+    assert jn.decode_crop_resize(data, 90, 90, 50, 50, 32, 32) is None
+    assert jn.decode_crop_resize(data, 0, 0, 0, 10, 8, 8) is None
+
+
+def test_truncated_body_is_rejected_not_gray_padded():
+    """libjpeg fakes an EOI for streams cut mid-scan and pads gray; the
+    kernel must report that (rc!=0 -> None), not return garbage rows."""
+    data = jpeg_bytes(smooth_image(300, 300), quality=95)
+    # cut inside the entropy-coded body (past the headers)
+    for frac in (0.4, 0.7, 0.95):
+        cut = data[:int(len(data) * frac)]
+        assert jn.decode_crop_resize(cut, 0, 0, 300, 300, 128, 128) is None
+
+
+def _folder(tmp_path, n_classes=2, per_class=6, sizes=((240, 300),)):
+    for c in range(n_classes):
+        d = tmp_path / f"class_{c}"
+        d.mkdir()
+        for i in range(per_class):
+            h, w = sizes[i % len(sizes)]
+            Image.fromarray(smooth_image(h, w)).save(
+                str(d / f"{i}.jpg"), quality=95)
+    return ImageFolder(str(tmp_path))
+
+
+def _collect(loader, n):
+    it = iter(loader)
+    return [next(it) for _ in range(n)]
+
+
+@pytest.mark.parametrize("train", [True, False])
+def test_loader_native_vs_pil_same_stream(tmp_path, train):
+    ds = _folder(tmp_path, sizes=((240, 300), (320, 260)))
+    kw = dict(local_batch=4, image_size=64, train=train, workers=2,
+              seed=7, prefetch=1)
+    with ImageFolderLoader(ds, native=True, **kw) as nat, \
+            ImageFolderLoader(ds, native=False, **kw) as pil:
+        assert nat._native and not pil._native
+        for (xn, yn), (xp, yp) in zip(_collect(nat, 2), _collect(pil, 2)):
+            # identical sample order + labels (same sampler draw),
+            # identical shapes, close pixels (different resamplers)
+            assert np.array_equal(yn, yp)
+            assert xn.shape == xp.shape
+            assert np.abs(xn.astype(int) - xp.astype(int)).mean() < 6.0
+
+
+def test_loader_native_is_deterministic(tmp_path):
+    ds = _folder(tmp_path)
+    kw = dict(local_batch=4, image_size=64, train=True, workers=2, seed=3)
+    with ImageFolderLoader(ds, **kw) as a, ImageFolderLoader(ds, **kw) as b:
+        for (xa, ya), (xb, yb) in zip(_collect(a, 2), _collect(b, 2)):
+            assert np.array_equal(xa, xb) and np.array_equal(ya, yb)
+
+
+def test_loader_corrupt_file_falls_back_without_stream_skew(tmp_path):
+    """A truncated JPEG must decode via PIL (PIL tolerates truncation with
+    LOAD_TRUNCATED_IMAGES off -> raises; our loader falls back per-image
+    only when native fails, so make the file valid-for-PIL but
+    native-feasible) — here we check the RNG-restore contract instead:
+    native failure after the box draws hands PIL the same stream."""
+    ds = _folder(tmp_path, n_classes=1, per_class=4)
+    # overwrite one sample with a PNG disguised as .jpg: native rejects
+    # (header parse fails before any RNG draw), PIL decodes fine
+    path, _ = ds.samples[1]
+    Image.fromarray(smooth_image(240, 300)).save(path, format="PNG")
+    kw = dict(local_batch=4, image_size=64, train=True, workers=2, seed=5)
+    with ImageFolderLoader(ds, native=True, **kw) as nat, \
+            ImageFolderLoader(ds, native=False, **kw) as pil:
+        (xn, yn), = _collect(nat, 1)
+        (xp, yp), = _collect(pil, 1)
+        assert np.array_equal(yn, yp)
+        assert np.abs(xn.astype(int) - xp.astype(int)).mean() < 6.0
+
+
+def test_eval_crop_region_matches_pil_semantics():
+    """Eval path: native's source-coordinate center crop covers the same
+    region as Resize(256)+CenterCrop(224)."""
+    arr = smooth_image(375, 500)
+    data = jpeg_bytes(arr)
+    got = None
+    h, w = 375, 500
+    size, resize = 224, 256
+    short = min(w, h)
+    side = min(int(round(short * size / resize)), short)
+    x0, y0 = (w - side) // 2, (h - side) // 2
+    got = jn.decode_crop_resize(data, y0, x0, side, side, size, size)
+    img = Image.open(io.BytesIO(data)).convert("RGB")
+    ref = center_crop_resize(img, size)
+    assert got.shape == ref.shape
+    assert np.abs(got.astype(int) - ref.astype(int)).mean() < 6.0
+
+
+def test_sample_crop_box_stream_stability():
+    """Pin the RNG draw-count contract: the PIL path
+    (random_resized_crop) consumes exactly sample_crop_box's draws plus
+    ONE flip draw — the native path's accounting.  If either side's
+    draw count drifts, the two augmentation streams desync and this
+    equality fails."""
+    for seed in (11, 12, 13, 99):
+        rng1 = np.random.RandomState(seed)
+        rng2 = np.random.RandomState(seed)
+        x0, y0, cw, ch = sample_crop_box(rng1, 300, 240)
+        assert 0 <= x0 <= 300 - cw and 0 <= y0 <= 240 - ch
+        rng1.rand()  # the flip draw the loader's native path performs
+        img = Image.fromarray(smooth_image(240, 300))
+        random_resized_crop(rng2, img, 64)
+        # streams aligned again -> next draws identical
+        assert rng1.rand() == rng2.rand()
+
+
+def test_fallback_crop_is_ratio_clamped():
+    """10 rejected draws -> torchvision's fallback: whole image when its
+    aspect is within ratio bounds, largest in-bounds region otherwise."""
+    class NoFit:
+        """rng whose draws always request more area than the image has"""
+        def uniform(self, a, b):
+            return b
+        def randint(self, a, b=None):
+            return a
+        def rand(self):
+            return 0.9
+
+    # 300x240 (ratio 1.25, inside (3/4, 4/3)): full image kept
+    x0, y0, cw, ch = sample_crop_box(NoFit(), 300, 240, scale=(2.0, 2.0))
+    assert (x0, y0, cw, ch) == (0, 0, 300, 240)
+    # 600x200 (ratio 3.0 > 4/3): height-bound, width clamped to 4/3*h
+    x0, y0, cw, ch = sample_crop_box(NoFit(), 600, 200, scale=(2.0, 2.0))
+    assert ch == 200 and cw == int(round(200 * 4 / 3)) and y0 == 0
+    # 200x600 (ratio 1/3 < 3/4): width-bound, height clamped to w/(3/4)
+    x0, y0, cw, ch = sample_crop_box(NoFit(), 200, 600, scale=(2.0, 2.0))
+    assert cw == 200 and ch == int(round(200 / (3 / 4))) and x0 == 0
