@@ -15,7 +15,12 @@ code keeps its import path.
 """
 
 from apex_tpu.parallel import mesh as parallel_state
-from apex_tpu.transformer import context_parallel, pipeline_parallel, tensor_parallel
+from apex_tpu.transformer import (
+    context_parallel,
+    pipeline_parallel,
+    rope,
+    tensor_parallel,
+)
 from apex_tpu.transformer.pipeline_parallel import get_forward_backward_func
 
 __all__ = [
@@ -23,5 +28,6 @@ __all__ = [
     "tensor_parallel",
     "pipeline_parallel",
     "context_parallel",
+    "rope",
     "get_forward_backward_func",
 ]

@@ -68,14 +68,41 @@ def _rotate(tree, axis):
     return jax.tree_util.tree_map(lambda l: lax.ppermute(l, axis, perm), tree)
 
 
+def _gqa_rep(q, k):
+    """Query-heads-per-KV-head broadcast factor (1 = MHA).  K/V may carry
+    fewer heads than q (grouped-query attention): the ring rotates and the
+    a2a transfers only the grouped K/V — ``1/rep`` of the MHA bytes, GQA's
+    whole point in the long-context regime — and the broadcast to query
+    heads happens locally right before each kernel call."""
+    h, g = q.shape[1], k.shape[1]
+    if h % g:
+        raise ValueError(f"q heads ({h}) must be a multiple of kv heads "
+                         f"({g})")
+    return h // g
+
+
+def _expand_kv(x, rep):
+    return x if rep == 1 else jnp.repeat(x, rep, axis=1)
+
+
+def _reduce_kv_grad(dx, rep):
+    """Adjoint of :func:`_expand_kv`: sum each group's query-head grads."""
+    if rep == 1:
+        return dx
+    b, h, s, d = dx.shape
+    return dx.reshape(b, h // rep, rep, s, d).sum(axis=2)
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
 def ring_attention(q, k, v, axis: str = CONTEXT_AXIS, causal: bool = True,
                    scale: Optional[float] = None):
     """Flash attention over a ring-sharded sequence.
 
-    ``q, k, v``: local shards ``[b, h, s_local, d]`` of a sequence of global
+    ``q``: local shard ``[b, h, s_local, d]`` of a sequence of global
     length ``s_local * cp``; rank ``r`` owns positions
-    ``[r*s_local, (r+1)*s_local)``.  Returns the local output shard.
+    ``[r*s_local, (r+1)*s_local)``.  ``k, v``: ``[b, g, s_local, d]``
+    where ``g`` divides ``h`` (``g < h`` = grouped-query attention; only
+    the g-head K/V travels the ring).  Returns the local output shard.
     """
     out, _ = _ring_fwd_math(q, k, v, axis, causal, scale)
     return out
@@ -85,6 +112,7 @@ def _ring_fwd_math(q, k, v, axis, causal, scale):
     cp = lax.axis_size(axis)
     r = lax.axis_index(axis)
     b, h, s_local, d = q.shape
+    rep = _gqa_rep(q, k)
 
     o = jnp.zeros(q.shape, jnp.float32)
     lse = jnp.full((b, h, s_local), -jnp.inf, jnp.float32)
@@ -94,7 +122,9 @@ def _ring_fwd_math(q, k, v, axis, causal, scale):
         o, lse, kv = carry
         k_cur, v_cur = kv
         chunk = (r - t) % cp  # home rank of the visiting chunk
-        o_t, lse_t = _chunk_attn(q, k_cur, v_cur, causal, scale, r, chunk)
+        o_t, lse_t = _chunk_attn(q, _expand_kv(k_cur, rep),
+                                 _expand_kv(v_cur, rep), causal, scale, r,
+                                 chunk)
         o, lse = _merge(o, lse, o_t, lse_t)
         kv = _rotate(kv, axis)
         return o, lse, kv
@@ -141,30 +171,34 @@ def _ring_vjp_bwd(axis, causal, scale, res, do):
     q, k, v, out, lse = res
     cp = lax.axis_size(axis)
     r = lax.axis_index(axis)
+    rep = _gqa_rep(q, k)
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
 
     dq = jnp.zeros(q.shape, jnp.float32)
-    # dk/dv accumulators travel with their chunk: start at home, after cp
-    # rotations they are home again.
+    # dk/dv accumulators travel with their chunk (in the compact g-head
+    # form — the per-chunk h-head grads reduce over each group before
+    # accumulating, the adjoint of the _expand_kv broadcast): start at
+    # home, after cp rotations they are home again.
     state = (k, v, jnp.zeros(k.shape, jnp.float32),
              jnp.zeros(v.shape, jnp.float32))
 
     def step(t, carry):
         dq, state = carry
         k_cur, v_cur, dk_acc, dv_acc = state
+        k_exp, v_exp = _expand_kv(k_cur, rep), _expand_kv(v_cur, rep)
         chunk = (r - t) % cp
 
         def grads(is_causal):
-            dq_t = dq_chunk(q, k_cur, v_cur, do, lse, delta,
+            dq_t = dq_chunk(q, k_exp, v_exp, do, lse, delta,
                             causal=is_causal, scale=scale)
-            dk_t, dv_t = dkv_chunk(q, k_cur, v_cur, do, lse, delta,
+            dk_t, dv_t = dkv_chunk(q, k_exp, v_exp, do, lse, delta,
                                    causal=is_causal, scale=scale)
             return dq_t, dk_t, dv_t
 
         if causal:
             def zeros(_):
-                return (jnp.zeros_like(q), jnp.zeros_like(k_cur),
-                        jnp.zeros_like(v_cur))
+                return (jnp.zeros_like(q), jnp.zeros_like(k_exp),
+                        jnp.zeros_like(v_exp))
 
             dq_t, dk_t, dv_t = lax.switch(
                 _causal_case(chunk, r),
@@ -175,8 +209,8 @@ def _ring_vjp_bwd(axis, causal, scale, res, do):
             dq_t, dk_t, dv_t = grads(False)
 
         dq = dq + dq_t.astype(jnp.float32)
-        dk_acc = dk_acc + dk_t.astype(jnp.float32)
-        dv_acc = dv_acc + dv_t.astype(jnp.float32)
+        dk_acc = dk_acc + _reduce_kv_grad(dk_t.astype(jnp.float32), rep)
+        dv_acc = dv_acc + _reduce_kv_grad(dv_t.astype(jnp.float32), rep)
         state = _rotate((k_cur, v_cur, dk_acc, dv_acc), axis)
         return dq, state
 
@@ -208,6 +242,17 @@ def ulysses_attention(q, k, v, axis: str = CONTEXT_AXIS,
         raise ValueError(
             f"heads ({q.shape[1]}) must be divisible by cp ({cp})"
         )
+    rep = _gqa_rep(q, k)
+    # GQA: when the K/V groups themselves split over cp, a2a the compact
+    # g-head K/V (1/rep of the MHA bytes) and broadcast after; otherwise
+    # (g % cp != 0) the broadcast must happen first — the a2a needs a
+    # head dim divisible by cp.
+    if rep > 1 and k.shape[1] % cp == 0:
+        post_rep = rep
+    else:
+        k, v = _expand_kv(k, rep), _expand_kv(v, rep)
+        post_rep = 1
+
     # [b, h, s_local, d] -> [b, h/cp, s_global, d]
     def scatter_heads(x):
         return lax.all_to_all(x, axis, split_axis=1, concat_axis=2,
@@ -229,5 +274,6 @@ def ulysses_attention(q, k, v, axis: str = CONTEXT_AXIS,
             + lax.axis_index(axis),
         )
     qg, kg, vg = scatter_heads(q), scatter_heads(k), scatter_heads(v)
+    kg, vg = _expand_kv(kg, post_rep), _expand_kv(vg, post_rep)
     out, _ = flash_attention_with_lse(qg, kg, vg, causal, scale, **drop)
     return gather_heads(out)

@@ -35,9 +35,12 @@ __all__ = ["DEFAULT_RULES", "infer_param_specs"]
 DEFAULT_RULES: Tuple[Tuple[str, Tuple[Optional[str], ...]], ...] = (
     # vocab-parallel embedding table: [vocab/tp, h]
     (r"word_embeddings/embedding$", ("tp", None)),
-    # column-parallel linears (QKV, h->4h): kernel [out/tp, in], bias [out/tp]
-    (r"(query_key_value|query|key_value|dense_h_to_4h)/kernel$", ("tp", None)),
-    (r"(query_key_value|query|key_value|dense_h_to_4h)/bias$", ("tp",)),
+    # column-parallel linears (QKV, h->4h, swiglu gate): kernel
+    # [out/tp, in], bias [out/tp]
+    (r"(query_key_value|query|key_value|dense_h_to_4h(_gate)?)/kernel$",
+     ("tp", None)),
+    (r"(query_key_value|query|key_value|dense_h_to_4h(_gate)?)/bias$",
+     ("tp",)),
     # row-parallel linears (attention out, 4h->h): kernel [out, in/tp],
     # bias replicated (added after the reduction, layers.py:806-812).
     # NB: "dense" alone would also match the plain (replicated) pooler /

@@ -25,6 +25,13 @@ TPU-first notes
   drive them with :func:`~apex_tpu.transformer.pipeline_parallel.pipeline_apply`
   (embedding/head live outside the pipelined region — see
   ``standalone_gpt.py``).
+- Modern-architecture options beyond the reference's testing GPT
+  (parity-plus, from its Megatron lineage): RoPE / NoPE
+  (``position_embedding_type``, ``transformer/rope.py``), grouped-query
+  attention (``num_query_groups`` — group-major fused QKV so tp chops
+  land on whole groups), and SwiGLU (``swiglu`` — separate gate/up
+  column linears, TP-exact).  All compose with tp/sp/cp and the flash
+  path; defaults reproduce the reference exactly.
 - Dropout uses the flax ``"dropout"`` rng; pass seeds derived with
   :func:`apex_tpu.transformer.tensor_parallel.random.model_parallel_rng_key`
   so tp ranks decorrelate exactly like the reference's
@@ -121,12 +128,42 @@ class TransformerConfig:
             raise ValueError(
                 f"context_impl must be 'ring' or 'ulysses', got "
                 f"{self.context_impl!r}")
+        if self.position_embedding_type not in ("learned", "rope", "none"):
+            raise ValueError(
+                f"position_embedding_type must be 'learned', 'rope' or "
+                f"'none', got {self.position_embedding_type!r}")
+        if (self.num_query_groups is not None
+                and (self.num_query_groups <= 0
+                     or self.num_attention_heads % self.num_query_groups)):
+            raise ValueError(
+                f"num_query_groups ({self.num_query_groups}) must be "
+                f"positive and divide num_attention_heads "
+                f"({self.num_attention_heads})")
 
     # Mixture-of-experts (parity-plus: the reference stubs SwitchMLP out,
     # standalone_transformer_lm.py:675; see apex_tpu/transformer/moe.py).
     num_experts: Optional[int] = None
     expert_capacity_factor: float = 1.25
     expert_axis: Optional[str] = None
+
+    # --- modern-architecture options (parity-plus: the reference's testing
+    # GPT is learned-positions/MHA/GeLU only; its Megatron lineage grew
+    # RoPE/GQA/SwiGLU and this stack supports them across tp/sp/cp) ---
+    # "learned" (reference behavior), "rope" (rotary on q/k, no position
+    # table — see transformer/rope.py), or "none" (NoPE).
+    position_embedding_type: str = "learned"
+    rotary_base: float = 10000.0
+    # fraction of head_dim rotated (Megatron --rotary-percent)
+    rotary_percent: float = 1.0
+    # Grouped-query attention: number of K/V head groups (None = MHA,
+    # 1 = MQA).  Must divide num_attention_heads; under tensor
+    # parallelism the tp world size must divide it (groups are
+    # column-sharded alongside their query heads).
+    num_query_groups: Optional[int] = None
+    # LLaMA-style gated MLP: silu(gate(x)) * up(x) with separate gate/up
+    # column linears (TP-exact under any tp size; ffn_hidden_size is NOT
+    # auto-scaled by 2/3 — set it explicitly for iso-params).
+    swiglu: bool = False
 
     dtype: Any = jnp.float32        # compute dtype (bf16 under the O2 policy)
     param_dtype: Any = jnp.float32
@@ -150,6 +187,16 @@ class TransformerConfig:
     def head_dim(self) -> int:
         return self.kv_channels or divide(self.hidden_size,
                                           self.num_attention_heads)
+
+    @property
+    def query_groups(self) -> int:
+        """K/V head groups (== num_attention_heads for MHA)."""
+        return self.num_query_groups or self.num_attention_heads
+
+    @property
+    def rotary_dim(self) -> int:
+        """Rotated leading channels of each head (even, >= 2)."""
+        return max(2, int(self.head_dim * self.rotary_percent) // 2 * 2)
 
     def init_method(self):
         """``init_method_normal`` (reference ``:96-103``)."""
@@ -183,9 +230,27 @@ class ParallelMLP(nn.Module):
             dtype=cfg.dtype, param_dtype=cfg.param_dtype, fp8=cfg.fp8,
             name="dense_h_to_4h",
         )(x)
-        # bias_gelu fusion (reference fused_bias_gelu.py): one fused
-        # elementwise region under XLA either way.
-        h = jax.nn.gelu(h + bias, approximate=cfg.bias_gelu_fusion)
+        if cfg.swiglu:
+            # LLaMA-style gated MLP: a SEPARATE gate column linear (w1/w3
+            # split) rather than one fused 2*ffn projection — the fused
+            # form's gate/up split lands differently on each tp chop,
+            # while two linears are TP-exact under any tp size.  XLA
+            # fuses silu+multiply into one elementwise region between
+            # the GEMMs.
+            gate, gate_bias = ColumnParallelLinear(
+                cfg.hidden_size, cfg.ffn_size,
+                sequence_parallel=cfg.sequence_parallel,
+                skip_bias_add=True,
+                axis=cfg.tensor_axis,
+                kernel_init=cfg.init_method(),
+                dtype=cfg.dtype, param_dtype=cfg.param_dtype, fp8=cfg.fp8,
+                name="dense_h_to_4h_gate",
+            )(x)
+            h = jax.nn.silu(gate + gate_bias) * (h + bias)
+        else:
+            # bias_gelu fusion (reference fused_bias_gelu.py): one fused
+            # elementwise region under XLA either way.
+            h = jax.nn.gelu(h + bias, approximate=cfg.bias_gelu_fusion)
         out, out_bias = RowParallelLinear(
             cfg.ffn_size, cfg.hidden_size,
             input_is_parallel=True,
@@ -340,6 +405,27 @@ class ParallelAttention(nn.Module):
     attention_type: AttnType = AttnType.self_attn
     attn_mask_type: AttnMaskType = AttnMaskType.padding
 
+    def _maybe_rotary(self, q, k):
+        """Rotate q/k (RoPE) when configured; no-op otherwise.  Runs
+        BEFORE the GQA broadcast (rotating ``g_local`` K heads, not
+        ``n_local`` copies) and before any cp exchange — under context
+        parallelism the positions are this rank's *global* token indices
+        (shard offset + local arange), so rotated keys travel the
+        ring/all-to-all already position-stamped."""
+        cfg = self.config
+        if cfg.position_embedding_type != "rope":
+            return q, k
+        from apex_tpu.transformer.rope import apply_rotary, rotary_cos_sin
+
+        s_local = q.shape[0]
+        positions = jnp.arange(s_local)
+        if cfg.context_axis is not None:
+            positions = positions + (
+                jax.lax.axis_index(cfg.context_axis) * s_local)
+        cos, sin = rotary_cos_sin(positions, cfg.rotary_dim,
+                                  cfg.rotary_base, q.dtype)
+        return apply_rotary(q, cos, sin), apply_rotary(k, cos, sin)
+
     @nn.compact
     def __call__(self, x, mask, encoder_output=None, deterministic=True,
                  segment_ids=None):
@@ -350,8 +436,18 @@ class ParallelAttention(nn.Module):
         proj = cfg.num_attention_heads * d
 
         if self.attention_type == AttnType.self_attn:
+            # Fused QKV in GROUP-MAJOR layout: for each of the
+            # ``query_groups`` K/V groups, its ``heads_per_group`` query
+            # heads then its one K and one V head — so the column-parallel
+            # chop hands every tp rank whole groups and the layout is
+            # identical for any tp size dividing ``query_groups``.  MHA
+            # (groups == heads) degenerates to the per-head [q|k|v]
+            # triples this module always used.
+            g = cfg.query_groups
+            hpg = divide(cfg.num_attention_heads, g)
+            g_local = divide(g, world)
             qkv = ColumnParallelLinear(
-                cfg.hidden_size, 3 * proj,
+                cfg.hidden_size, (cfg.num_attention_heads + 2 * g) * d,
                 sequence_parallel=cfg.sequence_parallel,
                 axis=cfg.tensor_axis,
                 kernel_init=cfg.init_method(),
@@ -359,8 +455,21 @@ class ParallelAttention(nn.Module):
                 name="query_key_value",
             )(x)
             s, b = qkv.shape[0], qkv.shape[1]
-            qkv = qkv.reshape(s, b, n_local, 3 * d)
-            q, k, v = jnp.split(qkv, 3, axis=-1)
+            qkv = qkv.reshape(s, b, g_local, (hpg + 2) * d)
+            q = qkv[..., :hpg * d].reshape(s, b, n_local, d)
+            k = qkv[..., hpg * d:(hpg + 1) * d]  # [s, b, g_local, d]
+            v = qkv[..., (hpg + 1) * d:]
+            q, k = self._maybe_rotary(q, k)
+            if hpg > 1 and cfg.context_axis is None:
+                # broadcast each K/V group across its query heads for the
+                # single-rank flash/softmax cores (XLA fuses the repeat
+                # into the operand read).  Under context parallelism the
+                # grouped K/V passes through: ring/ulysses transfer the
+                # compact g-head K/V over the interconnect and broadcast
+                # locally per chunk (context_parallel._expand_kv) — the
+                # GQA bandwidth saving is exactly the long-context win.
+                k = jnp.repeat(k, hpg, axis=2)
+                v = jnp.repeat(v, hpg, axis=2)
         else:
             q = ColumnParallelLinear(
                 cfg.hidden_size, proj,
@@ -509,13 +618,18 @@ class Embedding(nn.Module):
     # setup-style so ``word_embeddings`` is shareable for the tied LM head.
     def setup(self):
         cfg = self.config
+        # rope/none position types carry no learned position table — the
+        # position signal lives in the attention rotation (or nowhere)
+        self._learned_positions = (self.add_position_embedding
+                                   and cfg.position_embedding_type
+                                   == "learned")
         self.word_embeddings = VocabParallelEmbedding(
             cfg.padded_vocab_size, cfg.hidden_size,
             axis=cfg.tensor_axis,
             embedding_init=cfg.init_method(),
             dtype=cfg.dtype, param_dtype=cfg.param_dtype,
         )
-        if self.add_position_embedding:
+        if self._learned_positions:
             self.position_embeddings = nn.Embed(
                 cfg.max_position_embeddings, cfg.hidden_size,
                 embedding_init=cfg.init_method(),
@@ -526,7 +640,7 @@ class Embedding(nn.Module):
     def __call__(self, token_ids, position_ids=None, deterministic=True):
         cfg = self.config
         words = self.word_embeddings(token_ids)  # [b, s, h]
-        if self.add_position_embedding:
+        if self._learned_positions:
             if position_ids is None:
                 position_ids = jnp.arange(token_ids.shape[1])[None, :]
             words = words + self.position_embeddings(position_ids)
