@@ -168,11 +168,14 @@ def _trace_rn50(policy_name: str = "O2", loss_scale=None,
             mesh_lib.destroy_model_parallel()
 
 
-def _trace_gpt(dtype=None, fp8: bool = False) -> Dict[str, List[float]]:
+def _trace_gpt(dtype=None, fp8: bool = False,
+               **cfg_kw) -> Dict[str, List[float]]:
     from apex_tpu.optimizers import FusedAdam
     from apex_tpu.transformer.testing import GPTModel, TransformerConfig
 
-    kw = {} if dtype is None else {"dtype": dtype}
+    kw = dict(cfg_kw)
+    if dtype is not None:
+        kw["dtype"] = dtype
     cfg = TransformerConfig(
         hidden_size=64, num_layers=2, num_attention_heads=4,
         padded_vocab_size=128, max_position_embeddings=32,
@@ -267,6 +270,11 @@ CONFIGS = {
     # GPT numerics axis
     "gpt_bf16": partial(_trace_gpt, jnp.bfloat16),
     "gpt_fp8": partial(_trace_gpt, None, True),
+    # modern-architecture axis (RoPE + GQA + SwiGLU — the LLaMA-shaped
+    # stack of transformer/rope.py and standalone_transformer_lm.py)
+    "gpt_modern": partial(_trace_gpt, None, False,
+                          position_embedding_type="rope",
+                          num_query_groups=2, swiglu=True),
     # parallel numerics axis (dp x pp(xvpp) x tp+sp on the virtual mesh)
     "gpt_3d": _trace_gpt_3d,
 }

@@ -84,7 +84,14 @@ def build_gpt_cp(
         """Logits for this rank's [b_local, s_local] token shard."""
         s_local = tokens_local.shape[1]
         r = lax.axis_index(cp_axis)
-        pos = r * s_local + jnp.arange(s_local)[None, :]
+        if cfg.position_embedding_type == "learned":
+            # global position ids for this rank's sequence shard; under
+            # rope the attention derives the same offsets itself
+            # (ParallelAttention._maybe_rotary) and the embedding takes
+            # no position argument
+            pos = r * s_local + jnp.arange(s_local)[None, :]
+        else:
+            pos = None
         h = embed.apply({"params": params["embedding"]}, tokens_local,
                         position_ids=pos)  # [s_local, b, h]
         for i in range(cfg.num_layers):

@@ -132,6 +132,11 @@ class TransformerConfig:
             raise ValueError(
                 f"position_embedding_type must be 'learned', 'rope' or "
                 f"'none', got {self.position_embedding_type!r}")
+        if not 0.0 < self.rotary_percent <= 1.0:
+            raise ValueError(
+                f"rotary_percent must be in (0, 1], got "
+                f"{self.rotary_percent} (use position_embedding_type="
+                f"'none' for no position signal)")
         if (self.num_query_groups is not None
                 and (self.num_query_groups <= 0
                      or self.num_attention_heads % self.num_query_groups)):
@@ -639,6 +644,15 @@ class Embedding(nn.Module):
 
     def __call__(self, token_ids, position_ids=None, deterministic=True):
         cfg = self.config
+        if position_ids is not None and not self._learned_positions:
+            # RoPE derives positions inside the attention (arange +
+            # cp-shard offset) and has no hook for caller ids yet;
+            # dropping them silently would mis-rotate packed sequences.
+            raise NotImplementedError(
+                "custom position_ids are only honored with "
+                "position_embedding_type='learned'; the rope path "
+                "derives positions internally (packed-sequence resets "
+                "are not yet supported under rope)")
         words = self.word_embeddings(token_ids)  # [b, s, h]
         if self._learned_positions:
             if position_ids is None:

@@ -144,8 +144,26 @@ def test_gqa_groups_equal_heads_is_bit_identical_to_mha():
 def test_config_validation():
     with pytest.raises(ValueError, match="num_query_groups"):
         small_cfg(num_query_groups=3)  # does not divide 4 heads
+    with pytest.raises(ValueError, match="num_query_groups"):
+        small_cfg(num_query_groups=0)
     with pytest.raises(ValueError, match="position_embedding_type"):
         small_cfg(position_embedding_type="alibi")
+    with pytest.raises(ValueError, match="rotary_percent"):
+        small_cfg(rotary_percent=1.5)
+    with pytest.raises(ValueError, match="rotary_percent"):
+        small_cfg(rotary_percent=0.0)
+
+
+def test_rope_rejects_custom_position_ids():
+    """Silently dropping caller position_ids under rope would mis-rotate
+    packed sequences — must raise instead."""
+    cfg = small_cfg(position_embedding_type="rope")
+    model = GPTModel(cfg)
+    tokens = tokens_for(20)
+    params = model.init(jax.random.PRNGKey(21), tokens)["params"]
+    pos = jnp.zeros_like(tokens)
+    with pytest.raises(NotImplementedError, match="position_ids"):
+        model.apply({"params": params}, tokens, position_ids=pos)
 
 
 # ------------------------------------------------------- each option works
@@ -267,14 +285,18 @@ def test_modern_stack_tp_parity_and_trains():
 # ---------------------------------------------------------------- CP + GQA
 
 @pytest.mark.slow
-@pytest.mark.parametrize("impl", ["ring", "ulysses"])
-def test_cp_attention_grouped_kv_matches_expanded(impl):
+@pytest.mark.parametrize("impl,g", [
+    ("ring", 2),
+    ("ulysses", 2),   # g % cp != 0: expand-before-a2a fallback
+    ("ulysses", 4),   # g % cp == 0: compact g-head a2a + post-broadcast
+])
+def test_cp_attention_grouped_kv_matches_expanded(impl, g):
     """ring/ulysses accept compact g-head K/V (only the grouped K/V
     travels the interconnect) — output and q/k/v grads must match the
     same attention fed pre-broadcast h-head K/V."""
     from apex_tpu.transformer import context_parallel as cp_lib
 
-    CP, b, h, g, s, d = 4, 2, 8, 2, 32, 8
+    CP, b, h, s, d = 4, 2, 8, 32, 8
     parallel.initialize_model_parallel(context_parallel_size=CP)
     ks = jax.random.split(jax.random.PRNGKey(15), 3)
     q = jax.random.normal(ks[0], (b, h, s, d))
