@@ -577,8 +577,6 @@ def bench_tp_gpt(jax, on_tpu):
             )
             batch, seq, steps = 2, 64, 2
 
-        from jax.sharding import NamedSharding
-
         model = GPTModel(cfg)
         tokens = jnp.zeros((batch, seq), jnp.int32)
 
@@ -590,7 +588,7 @@ def bench_tp_gpt(jax, on_tpu):
 
         def shardings_of(spec_tree):
             return jax.tree_util.tree_map(
-                lambda s: NamedSharding(mesh, s), spec_tree,
+                lambda s: cc.named_sharding(*s, mesh=mesh), spec_tree,
                 is_leaf=lambda x: isinstance(x, P))
 
         # Init through plain jit with output shardings (the idiomatic

@@ -1,0 +1,58 @@
+#!/bin/bash
+# TPU evidence capture, v2 — wedge-aware sequel to tpu_capture.sh.
+#
+# What r4's first window taught us (.tpu_watch/capture.log):
+#   * the tunneled chip gives SHORT windows (15 min up, then wedged for
+#     40+ min) — a fixed stage list burns hours of timeouts against a
+#     dead tunnel (observed: 4x 420 s sweep-point timeouts in a row);
+#   * bench.py's own poll loop (probe -> suite -> wedge-pause -> re-poll)
+#     is the right shape, so stage 1 just runs it with a LONG window and
+#     the stages that lack a poller get an explicit wait_for_chip gate.
+#
+# Evidence lands incrementally (stamped bench_results/tpu_*.json after
+# every config; sweep jsonl per point), so a kill at any moment keeps
+# everything already measured.
+set -u
+cd "$(dirname "$0")/.."
+LOG=.tpu_watch/capture2.log
+mkdir -p .tpu_watch bench_results
+stamp() { date +%H:%M:%S; }
+log() { echo "== $(stamp) $*" >> "$LOG"; }
+probe() {
+  timeout 90 python -c \
+    "import jax; assert jax.devices()[0].platform == 'tpu'" \
+    >/dev/null 2>&1
+}
+wait_for_chip() {
+  until probe; do log "chip down; re-probing in 120s"; sleep 120; done
+  log "chip up"
+}
+run() {
+  log "start: $*"
+  timeout "${STAGE_TIMEOUT:-2400}" "$@" >> "$LOG" 2>&1
+  log "rc=$? ($1 $2)"
+}
+
+log "capture2 start"
+# Stage 1: the full 9-config matrix. bench.py polls for the chip across
+# the whole window and handles mid-suite wedges itself; 6 h window.
+STAGE_TIMEOUT=22000 BENCH_DEADLINE_S=21600 run python bench.py
+
+# Hardware tuning/profiling stages: each gated on a live chip so a wedge
+# costs probe-time, not stage-timeouts.  Sweep points get 600 s (420 s
+# proved tight even healthy: full train-step recompile per block size).
+wait_for_chip
+run python examples/tune_flash_blocks.py --seq 1024 --timeout 600
+wait_for_chip
+run python examples/profile_gpt.py
+wait_for_chip
+run python examples/tune_flash_blocks.py --seq 8192 --steps 5 --timeout 600
+wait_for_chip
+run python examples/measure_remat_memory.py
+wait_for_chip
+run python examples/measure_pipeline_tick.py
+# Final re-bench picks up any tuned flash blocks; never overwrites
+# earlier stamped records.
+wait_for_chip
+BENCH_DEADLINE_S=2100 run python bench.py
+log "capture2 done"
