@@ -6,6 +6,11 @@ through a ``DataLoader`` with ``fast_collate`` and a CUDA-side
 This package is the TPU-native analog: a pure PIL/numpy ImageFolder, DP
 sharding through the Megatron samplers, threaded decode, and uint8 batches
 normalized on-device inside the jitted step.
+
+For hosts whose decode rate cannot feed the chip (the DALI situation),
+:mod:`apex_tpu.data.packed` packs the dataset once into a memory-mapped
+uint8 shard; training then gathers batches decode-free and augments
+on-device.
 """
 
 from apex_tpu.data.image_folder import (
@@ -17,11 +22,19 @@ from apex_tpu.data.image_folder import (
     sample_crop_box,
     synthetic_image_batches,
 )
+from apex_tpu.data.packed import (
+    PackedImageDataset,
+    PackedLoader,
+    pack_image_folder,
+)
 from apex_tpu.data.prefetch import prefetch_to_device
 
 __all__ = [
     "ImageFolder",
     "ImageFolderLoader",
+    "PackedImageDataset",
+    "PackedLoader",
+    "pack_image_folder",
     "center_crop_resize",
     "normalize_on_device",
     "prefetch_to_device",

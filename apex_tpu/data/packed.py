@@ -1,0 +1,346 @@
+"""Packed (decode-free) image input path — pack once, memcpy at train time.
+
+Role in the reference lineage: the apex imagenet recipe's answer to an
+input-bound loader is more DataLoader workers and ultimately DALI
+(``examples/imagenet/main_amp.py:207-232``; the example README points at
+DALI when JPEG decode can't keep up).  Both scale *decode* horizontally.
+On a TPU-VM class host the idiomatic fix is to move decode out of the
+training job entirely: preprocess the dataset once into a fixed-shape
+array shard (tf.data/grain's array_record pattern), then the per-step
+host work is a fancy-index gather out of a memory-mapped uint8 array —
+pure memcpy, no codec — and the *augmentation* runs on-device inside the
+jitted train step where it fuses with the input normalize.
+
+Measured context (bench_input_pipeline): one PIL/native-JPEG worker
+decodes ~110 img/s, so a 1-CPU host can never feed the ~8.8k img/s the
+single-chip RN50 step consumes; the packed path's gather costs
+~150 KB/image of memcpy (~1.3 GB/s at chip rate) which the same host
+sustains.
+
+Format (``<prefix>.data`` + ``<prefix>.labels.npy`` + ``<prefix>.json``):
+
+- ``.data``  — raw uint8, shape [N, side, side, 3] (NHWC, C-order), the
+  storage layout a memmap gather turns into a training batch with one
+  copy;
+- ``.labels.npy`` — int32 [N];
+- ``.json`` — {"n", "side", "classes", "version"} metadata.
+
+Records are stored at ``side`` (default 232 — slightly larger than the
+224 train crop) so the on-device random crop (:func:`random_crop_flip`)
+retains translation augmentation; RandomResizedCrop's scale/aspect
+jitter is intentionally traded away (decode-free means fixed-shape
+records — the same trade DALI's fused ``decode_random_crop`` pipelines
+make when fed pre-resized shards).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue
+import threading
+from typing import Iterator, Optional, Tuple
+
+import numpy as np
+
+from apex_tpu.data.image_folder import (
+    IMAGENET_MEAN,
+    IMAGENET_STD,
+    ImageFolder,
+    center_crop_resize,
+    normalize_on_device,
+)
+
+__all__ = [
+    "PackedImageDataset",
+    "PackedLoader",
+    "center_crop",
+    "pack_image_folder",
+    "random_crop_flip",
+]
+
+
+def pack_image_folder(root_or_dataset, out_prefix: str, side: int = 232,
+                      workers: int = 8) -> "PackedImageDataset":
+    """Decode an ImageFolder tree once into a packed array shard.
+
+    Each image is center-crop-resized to ``side``x``side`` uint8 (the
+    deterministic eval transform — augmentation happens on-device at
+    train time) and appended to ``<out_prefix>.data``.  Decode fans out
+    over ``workers`` PIL threads; packing is a one-time cost, so the
+    online loader's native JPEG fast path is not plumbed through here.
+    """
+    from concurrent.futures import ThreadPoolExecutor
+
+    ds = (root_or_dataset if isinstance(root_or_dataset, ImageFolder)
+          else ImageFolder(root_or_dataset))
+    n = len(ds)
+    if n == 0:
+        raise ValueError("empty dataset")
+    os.makedirs(os.path.dirname(os.path.abspath(out_prefix)), exist_ok=True)
+    # raw file (not .npy): the loader memmaps with an explicit shape from
+    # the sidecar json, and raw bytes keep the format trivially
+    # inspectable/appendable for sharded packers.
+    mm = np.memmap(out_prefix + ".data", dtype=np.uint8, mode="w+",
+                   shape=(n, side, side, 3))
+    labels = np.empty((n,), np.int32)
+
+    def one(i: int) -> None:
+        img, label = ds.load(i)
+        mm[i] = center_crop_resize(img, side)
+        labels[i] = label
+
+    with ThreadPoolExecutor(max_workers=workers) as pool:
+        list(pool.map(one, range(n)))
+    mm.flush()
+    del mm
+    np.save(out_prefix + ".labels.npy", labels)
+    with open(out_prefix + ".json", "w") as f:
+        json.dump({"n": n, "side": side, "classes": ds.classes,
+                   "version": 1}, f)
+    return PackedImageDataset(out_prefix)
+
+
+class _ProducerError:
+    """Exception relay from the producer thread to the consuming iterator."""
+
+    def __init__(self, exc: BaseException):
+        self.exc = exc
+
+
+class _Iteration:
+    """Per-``__iter__`` state: its own stop flag, bounded queue, producer
+    thread, and count of sampler-advanced-but-undelivered batches."""
+
+    def __init__(self, prefetch: int):
+        self.stop = threading.Event()
+        self.queue: "queue.Queue" = queue.Queue(maxsize=prefetch)
+        self.thread: Optional[threading.Thread] = None
+        self.mine = 0
+
+
+class PackedImageDataset:
+    """Memory-mapped view over a packed shard (see module docstring)."""
+
+    def __init__(self, prefix: str):
+        with open(prefix + ".json") as f:
+            meta = json.load(f)
+        if meta.get("version") != 1:
+            raise ValueError(f"unknown packed format version: {meta}")
+        self.side = int(meta["side"])
+        self.classes = list(meta["classes"])
+        self._n = int(meta["n"])
+        self.images = np.memmap(prefix + ".data", dtype=np.uint8, mode="r",
+                                shape=(self._n, self.side, self.side, 3))
+        self.labels = np.load(prefix + ".labels.npy")
+        if self.labels.shape != (self._n,):
+            raise ValueError(
+                f"labels shape {self.labels.shape} != ({self._n},)")
+
+    def __len__(self) -> int:
+        return self._n
+
+
+class PackedLoader:
+    """DP-sharded train iterator over a :class:`PackedImageDataset`.
+
+    Same surface and contracts as
+    :class:`~apex_tpu.data.image_folder.ImageFolderLoader` — yields
+    global ``(uint8 [B, side, side, 3], int32 [B])`` with rank ``r``'s
+    shard at rows ``[r*local : (r+1)*local]``, Megatron-sampler epoch
+    shuffling, ``consumed_samples`` mid-epoch resume, context-manager
+    ``close()`` — so ``prefetch_to_device`` and the examples compose
+    unchanged.  The producer is a single background thread: per batch it
+    fancy-indexes the memmap (gather-memcpy, no codec), which one core
+    sustains at chip rate; ``prefetch`` bounds the queue.
+
+    Batches are full ``side``-sized records; run
+    :func:`random_crop_flip` (train) or :func:`center_crop` (eval)
+    on-device inside the jitted step.
+    """
+
+    def __init__(self, dataset: PackedImageDataset, local_batch: int,
+                 data_parallel_size: int = 1, consumed_samples: int = 0,
+                 seed: int = 0, prefetch: int = 2):
+        from apex_tpu.transformer._data import (
+            MegatronPretrainingRandomSampler,
+        )
+
+        self.dataset = dataset
+        self.local_batch = local_batch
+        self.dp = data_parallel_size
+        self.seed = seed
+        self.prefetch = max(1, prefetch)
+        self.samplers = [
+            MegatronPretrainingRandomSampler(
+                total_samples=len(dataset),
+                consumed_samples=consumed_samples,
+                local_minibatch_size=local_batch,
+                data_parallel_rank=r,
+                data_parallel_size=data_parallel_size,
+            )
+            for r in range(data_parallel_size)
+        ]
+        self._lock = threading.Lock()
+        self._active: list = []  # live _Iteration states (usually 0 or 1)
+
+    @property
+    def consumed_samples(self) -> int:
+        """Samples in batches already yielded.  Producer threads run the
+        samplers ``prefetch`` batches ahead; batches pulled but not
+        delivered (queued, mid-gather, or discarded by an early
+        ``close()``) are subtracted under the same lock the producers
+        advance under, so a checkpoint taken between steps resumes at the
+        first undelivered batch — exactly ImageFolderLoader's contract."""
+        with self._lock:
+            return (self.samplers[0].consumed_samples
+                    - sum(st.mine for st in self._active)
+                    * self.local_batch * self.dp)
+
+    def close(self) -> None:
+        """Stop every live iteration and rewind the samplers past any
+        batches gathered but never delivered, so re-iterating (or
+        resuming from ``consumed_samples``) replays exactly the
+        undelivered data — ImageFolderLoader's abandoned-iteration
+        contract."""
+        with self._lock:
+            states = list(self._active)
+        for st in states:
+            self._finish(st)
+
+    def __enter__(self) -> "PackedLoader":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def _gather(self, idx_per_rank) -> Tuple[np.ndarray, np.ndarray]:
+        idx = np.concatenate(idx_per_rank)
+        # single fancy-index: one gather-memcpy out of the page cache
+        return (self.dataset.images[idx],
+                self.dataset.labels[idx].astype(np.int32))
+
+    def _produce(self, st: "_Iteration") -> None:
+        its = [iter(s) for s in self.samplers]
+        while not st.stop.is_set():
+            try:
+                with self._lock:
+                    idx_per_rank = [next(it) for it in its]
+                    st.mine += 1
+                batch = self._gather(idx_per_rank)
+            except StopIteration:
+                # epoch end: sentinel wakes the consumer, which returns
+                st.queue.put(None)
+                return
+            except BaseException as e:  # noqa: BLE001 — relayed, not eaten
+                # a dead producer must fail the training loop, not wedge
+                # it in queue.get() (ImageFolderLoader propagates decode
+                # errors through future.result() the same way)
+                st.queue.put(_ProducerError(e))
+                return
+            while not st.stop.is_set():
+                try:
+                    st.queue.put(batch, timeout=0.2)
+                    break
+                except queue.Full:
+                    continue
+
+    def _finish(self, st: "_Iteration") -> None:
+        """Tear down one iteration: stop+join its producer, then rewind
+        the samplers by its undelivered batches (``st.mine``)."""
+        st.stop.set()
+        if st.thread is not None:
+            # unblock a producer waiting on a full queue; drained batches
+            # stay counted in st.mine (they were never delivered)
+            try:
+                while True:
+                    st.queue.get_nowait()
+            except queue.Empty:
+                pass
+            st.thread.join(timeout=5.0)
+            st.thread = None
+        with self._lock:
+            if st in self._active:
+                self._active.remove(st)
+            undelivered, st.mine = st.mine, 0
+            if undelivered:
+                for s in self.samplers:
+                    s.consumed_samples -= (
+                        undelivered * self.local_batch * self.dp)
+
+    def __iter__(self) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+        # one epoch per __iter__ call, mirroring ImageFolderLoader: the
+        # samplers hold position, so re-iterating starts the next epoch.
+        # All iteration state is per-call so overlapping/abandoned
+        # iterators never share a stop flag or queue.
+        st = _Iteration(self.prefetch)
+        with self._lock:
+            self._active.append(st)
+        st.thread = threading.Thread(
+            target=self._produce, args=(st,), daemon=True)
+        st.thread.start()
+        try:
+            while True:
+                batch = st.queue.get()
+                if batch is None:
+                    return
+                if isinstance(batch, _ProducerError):
+                    raise batch.exc
+                with self._lock:
+                    st.mine -= 1
+                yield batch
+        finally:
+            self._finish(st)
+
+
+# ---------------------------------------------------------------------------
+# On-device augmentation (jittable; fuses into the train step)
+# ---------------------------------------------------------------------------
+
+def random_crop_flip(images_u8, key, out_size: int,
+                     mean=IMAGENET_MEAN, std=IMAGENET_STD,
+                     dtype=None):
+    """Per-example random crop + horizontal flip + normalize, on device.
+
+    ``images_u8``: uint8 [B, S, S, 3] from :class:`PackedLoader`;
+    returns normalized [B, out_size, out_size, 3] in ``dtype`` (default
+    fp32).  Designed to sit first in the jitted train step: XLA fuses
+    the u8->f32 convert, crop gather, flip select and normalize into the
+    input of the first conv — the device-side role the reference's
+    ``data_prefetcher`` normalize plays on a CUDA stream
+    (``examples/imagenet/main_amp.py:256-276``), plus the crop/flip that
+    its host-side transforms did before the codec trade (module
+    docstring).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    b, s = images_u8.shape[0], images_u8.shape[1]
+    margin = s - out_size
+    if margin < 0:
+        raise ValueError(f"out_size {out_size} > stored side {s}")
+    k_h, k_w, k_f = jax.random.split(key, 3)
+    off_h = jax.random.randint(k_h, (b,), 0, margin + 1)
+    off_w = jax.random.randint(k_w, (b,), 0, margin + 1)
+    flip = jax.random.bernoulli(k_f, 0.5, (b,))
+
+    def one(img, oh, ow, fl):
+        crop = jax.lax.dynamic_slice(img, (oh, ow, 0),
+                                     (out_size, out_size, 3))
+        return jnp.where(fl, crop[:, ::-1, :], crop)
+
+    cropped = jax.vmap(one)(images_u8, off_h, off_w, flip)
+    # same arithmetic as the online path so --packed is not a numerics
+    # A/B confounder
+    return normalize_on_device(cropped, mean, std, dtype)
+
+
+def center_crop(images_u8, out_size: int, mean=IMAGENET_MEAN,
+                std=IMAGENET_STD, dtype=None):
+    """Deterministic eval transform: center crop + normalize, on device."""
+    s = images_u8.shape[1]
+    off = (s - out_size) // 2
+    if off < 0:
+        raise ValueError(f"out_size {out_size} > stored side {s}")
+    crop = images_u8[:, off:off + out_size, off:off + out_size, :]
+    return normalize_on_device(crop, mean, std, dtype)

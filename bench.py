@@ -715,15 +715,18 @@ def bench_input_pipeline(jax, on_tpu):
         workers = min(32, eff_cpus)
         ds = ImageFolder(root)
 
-        def measure(step_sleep: float):
-            with ImageFolderLoader(ds, local_batch=batch, image_size=224,
-                                   workers=workers, prefetch=2) as loader:
+        # target + warm batch stays under the batches-per-epoch (8 on tpu
+        # shapes, 4 on cpu) so neither loop times an epoch-boundary drain
+        # + producer restart
+        target = 6 if on_tpu else 2
+
+        def measure(make_loader, step_sleep: float):
+            with make_loader() as loader:
                 def epochs():
                     while True:  # re-iterating advances to the next epoch
                         yield from loader
 
                 it = epochs()
-                target = 6 if on_tpu else 4
                 if step_sleep:
                     # steady-state stall: warm the pipeline first, then
                     # measure how long next() blocks a consumer pacing at
@@ -745,9 +748,28 @@ def bench_input_pipeline(jax, on_tpu):
                 n = (target + 1) * batch
                 return n / (time.perf_counter() - t0), None
 
-        raw_ips, _ = measure(0.0)
+        def jpeg_loader():
+            return ImageFolderLoader(ds, local_batch=batch, image_size=224,
+                                     workers=workers, prefetch=2)
+
+        raw_ips, _ = measure(jpeg_loader, 0.0)
         step_s = batch / rn50_rate  # an RN50 step's device time
-        _, stall_s = measure(step_s)
+        _, stall_s = measure(jpeg_loader, step_s)
+
+        # Packed (decode-free) path: pack the same tree once, then measure
+        # the memmap-gather loader the same two ways.  This is the path
+        # that must feed the chip when per-core decode can't (the DALI
+        # role; apex_tpu/data/packed.py module docstring).
+        from apex_tpu.data import PackedLoader, pack_image_folder
+
+        pds = pack_image_folder(
+            ds, os.path.join(root, "packed"), side=232, workers=workers)
+
+        def packed_loader():
+            return PackedLoader(pds, local_batch=batch, prefetch=2)
+
+        packed_ips, _ = measure(packed_loader, 0.0)
+        _, packed_stall_s = measure(packed_loader, step_s)
         return {
             "value": round(raw_ips, 1),
             "unit": "images-decoded/sec",
@@ -756,6 +778,10 @@ def bench_input_pipeline(jax, on_tpu):
             "per_worker_ips": round(raw_ips / workers, 1),
             "overlapped_stall_ms_per_step": round(stall_s * 1e3, 2),
             "rn50_step_ms": round(step_s * 1e3, 2),
+            # decode-free packed shard (gather-memcpy + on-device augment)
+            "packed_ips": round(packed_ips, 1),
+            "packed_vs_rn50_consumption": round(packed_ips / rn50_rate, 3),
+            "packed_stall_ms_per_step": round(packed_stall_s * 1e3, 2),
             "batch": batch,
             "workers": workers,
             "jpeg_side": side,
@@ -865,6 +891,14 @@ BENCHES = {
     "tp_gpt": bench_tp_gpt,
     "fused_adam_step": bench_fused_adam_step,
     "input_pipeline": bench_input_pipeline,
+    # Diagnostic-only combos (run via ``--one``, not in BENCH_ORDER):
+    # isolate which factor of the lamb+syncbn row costs what — the r4
+    # first window measured resnet50_o2 (sgd, plain BN, pjit) 3.4x faster
+    # than resnet50_lamb_syncbn (lamb, SyncBN, shard_map) on one chip.
+    "resnet50_sgd_syncbn": lambda jax, on_tpu: _resnet_bench(
+        jax, on_tpu, "sgd", sync_bn=True),
+    "resnet50_lamb_nosync": lambda jax, on_tpu: _resnet_bench(
+        jax, on_tpu, "lamb"),
 }
 # headline first: if the deadline hits, the most important number exists.
 # tp_gpt deliberately LAST: its r2/r3 mode of failure was a 900 s setup

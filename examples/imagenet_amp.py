@@ -31,15 +31,28 @@ from apex_tpu import amp, parallel
 from apex_tpu.data import (
     ImageFolder,
     ImageFolderLoader,
+    PackedImageDataset,
+    PackedLoader,
     normalize_on_device,
+    pack_image_folder,
     prefetch_to_device,
     synthetic_image_batches,
 )
+from apex_tpu.data.packed import random_crop_flip
 from apex_tpu.models import ResNet18, ResNet50, ResNet101
 from apex_tpu.optimizers import FusedLAMB, FusedSGD
 from apex_tpu.parallel import replicate
 
 ARCHS = {"resnet18": ResNet18, "resnet50": ResNet50, "resnet101": ResNet101}
+
+
+def _check_num_classes(classes, args):
+    """Labels >= num_classes would be silently clamped by XLA's gather,
+    training garbage with no diagnostic — reject up front."""
+    if len(classes) > args.num_classes:
+        raise SystemExit(
+            f"dataset has {len(classes)} classes > --num-classes "
+            f"{args.num_classes}")
 
 
 def _split_dir(root, split):
@@ -79,6 +92,13 @@ def main(argv=None):
     p.add_argument("--lr", type=float, default=0.1)
     p.add_argument("--steps", type=int, default=20)
     p.add_argument("--workers", type=int, default=8)
+    p.add_argument("--packed", default=None, metavar="PREFIX",
+                   help="train from a packed (decode-free) shard at "
+                        "PREFIX (apex_tpu.data.packed). Missing shard + "
+                        "--data: packs the train split there first. The "
+                        "random crop/flip then runs on-device inside the "
+                        "jitted step. Use when host decode can't feed "
+                        "the chip (the reference recipe's DALI role).")
     p.add_argument("--evaluate", action="store_true",
                    help="run a validation pass (top-1/top-5) after "
                         "training — the reference's validate() loop "
@@ -115,9 +135,16 @@ def main(argv=None):
                         master_weights=policy.master_weights)
     opt_state = opt.init(params)
 
-    def loss_fn(params, batch_stats, batch):
+    def loss_fn(params, batch_stats, batch, key):
         x_uint8, y = batch
-        x = normalize_on_device(x_uint8, dtype=policy.compute_dtype)
+        if args.packed is not None:
+            # packed records are stored at side > image_size: the train
+            # crop + flip + normalize all happen here, on device, fused
+            # into the step (packed.py module docstring)
+            x = random_crop_flip(x_uint8, key, args.image_size,
+                                 dtype=policy.compute_dtype)
+        else:
+            x = normalize_on_device(x_uint8, dtype=policy.compute_dtype)
         logits, mutated = model.apply(
             {"params": params, "batch_stats": batch_stats},
             x,
@@ -129,9 +156,9 @@ def main(argv=None):
         return loss, mutated["batch_stats"]
 
     @jax.jit
-    def train_step(params, batch_stats, opt_state, batch):
+    def train_step(params, batch_stats, opt_state, batch, key):
         (loss, new_stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-            params, batch_stats, batch
+            params, batch_stats, batch, key
         )
         params, opt_state = opt.step(grads, opt_state, params)
         return params, new_stats, opt_state, loss
@@ -145,22 +172,55 @@ def main(argv=None):
         raise SystemExit(
             f"--batch-size {args.batch_size} must be divisible by the "
             f"data-parallel world size ({dp})")
+    def epochs(loader):
+        # re-iterating resumes from consumed_samples -> next epoch
+        # permutation (the reference's `for epoch in range(...)` loop)
+        while True:
+            yield from loader
+
     loader = None
-    if args.data is not None:
+    if args.packed is not None:
+        import os
+
+        if not os.path.exists(args.packed + ".json"):
+            if args.data is None:
+                raise SystemExit(
+                    f"--packed {args.packed}: shard not found and no "
+                    f"--data folder to pack it from")
+            # store records slightly larger than the train crop so the
+            # on-device random crop keeps translation augmentation (232
+            # for the standard 224 recipe; small-image runs pack small so
+            # the crop fraction — and H2D bytes — stay proportionate)
+            side = (args.image_size + 8 if args.image_size < 224
+                    else max(232, args.image_size + 8))
+            print(f"packing {args.data} -> {args.packed} "
+                  f"(one-time, side={side})")
+            pds = pack_image_folder(
+                _split_dir(args.data, "train"), args.packed, side=side,
+                workers=args.workers)
+        else:
+            pds = PackedImageDataset(args.packed)
+        if pds.side < args.image_size:
+            # fail before training (and before a fresh multi-hour pack
+            # would have): this shard cannot produce the requested crop
+            raise SystemExit(
+                f"--packed shard stores side={pds.side} < --image-size "
+                f"{args.image_size}; re-pack with a larger side")
+        _check_num_classes(pds.classes, args)
+        print(f"Packed shard: {len(pds)} samples at side {pds.side}, "
+              f"{len(pds.classes)} classes, dp={dp}")
+        loader = PackedLoader(pds, local_batch=args.batch_size // dp,
+                              data_parallel_size=dp)
+        it = epochs(loader)
+    elif args.data is not None:
         dataset = ImageFolder(_split_dir(args.data, "train"))
+        _check_num_classes(dataset.classes, args)
         print(f"ImageFolder: {len(dataset)} samples, "
               f"{len(dataset.classes)} classes, dp={dp}")
         loader = ImageFolderLoader(
             dataset, local_batch=args.batch_size // dp,
             data_parallel_size=dp, image_size=args.image_size,
             workers=args.workers)
-
-        def epochs(loader):
-            # re-iterating resumes from consumed_samples -> next epoch
-            # permutation (the reference's `for epoch in range(...)` loop)
-            while True:
-                yield from loader
-
         it = epochs(loader)
     else:
         it = synthetic_image_batches(args.batch_size, args.image_size,
@@ -173,10 +233,12 @@ def main(argv=None):
     t0 = time.perf_counter()
     loss = None
     try:
+        aug_key = jax.random.PRNGKey(17)
         for i in range(args.steps):
             batch = next(dev_it)
             params, batch_stats, opt_state, loss = train_step(
-                params, batch_stats, opt_state, batch
+                params, batch_stats, opt_state, batch,
+                jax.random.fold_in(aug_key, i)
             )
             if i == 0:
                 jax.block_until_ready(loss)

@@ -1,0 +1,228 @@
+"""Packed (decode-free) input path: pack round-trip, loader contracts,
+on-device augmentation.  Spec: apex_tpu/data/packed.py module docstring
+(the DALI-role preprocessed-shard pipeline; reference recipe context
+``examples/imagenet/main_amp.py:207-232``)."""
+
+import os
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp
+
+from apex_tpu.data import ImageFolder
+from apex_tpu.data.image_folder import center_crop_resize
+from apex_tpu.data.packed import (
+    PackedImageDataset,
+    PackedLoader,
+    center_crop,
+    pack_image_folder,
+    random_crop_flip,
+)
+
+N_CLASSES, PER_CLASS, SIDE = 3, 24, 40
+
+
+@pytest.fixture(scope="module")
+def image_tree(tmp_path_factory):
+    from PIL import Image
+
+    root = tmp_path_factory.mktemp("jpegs")
+    rng = np.random.RandomState(0)
+    for c in range(N_CLASSES):
+        d = root / f"class_{c}"
+        d.mkdir()
+        for i in range(PER_CLASS):
+            # varied source sizes: packing must normalize geometry
+            h, w = rng.randint(SIDE, 80, size=2)
+            arr = rng.randint(0, 256, (h, w, 3), dtype=np.uint8)
+            Image.fromarray(arr).save(d / f"{i}.jpg", quality=95)
+    return str(root)
+
+
+@pytest.fixture(scope="module")
+def packed(image_tree, tmp_path_factory):
+    prefix = str(tmp_path_factory.mktemp("packed") / "train")
+    ds = pack_image_folder(image_tree, prefix, side=SIDE, workers=4)
+    return prefix, ds
+
+
+def test_pack_round_trip(image_tree, packed):
+    prefix, ds = packed
+    src = ImageFolder(image_tree)
+    assert len(ds) == len(src) == N_CLASSES * PER_CLASS
+    assert ds.classes == src.classes
+    # a fresh open sees the same bytes
+    ds2 = PackedImageDataset(prefix)
+    assert ds2.side == SIDE
+    for i in (0, 7, len(ds) - 1):
+        img, label = src.load(i)
+        np.testing.assert_array_equal(
+            np.asarray(ds2.images[i]), center_crop_resize(img, SIDE))
+        assert int(ds2.labels[i]) == label
+
+
+def test_loader_shapes_and_disjoint_dp_shards(packed):
+    _, ds = packed
+    with PackedLoader(ds, local_batch=4, data_parallel_size=2) as loader:
+        images, labels = next(iter(loader))
+    assert images.shape == (8, SIDE, SIDE, 3) and images.dtype == np.uint8
+    assert labels.shape == (8,) and labels.dtype == np.int32
+    # rank shards must match a per-rank gather of the same sampler state
+    fresh = PackedLoader(ds, local_batch=4, data_parallel_size=2)
+    idx = [next(iter(s)) for s in fresh.samplers]
+    assert not set(idx[0]) & set(idx[1]), "dp shards overlap"
+    np.testing.assert_array_equal(images[:4], ds.images[idx[0]])
+    np.testing.assert_array_equal(images[4:], ds.images[idx[1]])
+
+
+def test_loader_epoch_determinism_and_advance(packed):
+    _, ds = packed
+    def first_labels(consumed=0):
+        with PackedLoader(ds, local_batch=6, consumed_samples=consumed,
+                          seed=3) as loader:
+            return [labels.tolist() for _, labels in loader]
+
+    a, b = first_labels(), first_labels()
+    assert a == b, "same consumed_samples must replay the same epoch"
+    assert len(a) == (N_CLASSES * PER_CLASS) // 6
+    # advancing by one batch drops exactly the first batch of the epoch
+    c = first_labels(consumed=6)
+    assert c == a[1:]
+
+
+def test_loader_resume_contract(packed):
+    _, ds = packed
+    loader = PackedLoader(ds, local_batch=4)
+    it = iter(loader)
+    seen = [next(it) for _ in range(3)]
+    consumed = loader.consumed_samples
+    assert consumed == 12, consumed  # 3 yielded batches, prefetch excluded
+    loader.close()
+    # a fresh loader from the checkpoint yields batch 4 onward, bitwise
+    with PackedLoader(ds, local_batch=4, consumed_samples=consumed) as l2:
+        nxt = next(iter(l2))
+    with PackedLoader(ds, local_batch=4) as l3:
+        it3 = iter(l3)
+        for _ in range(3):
+            next(it3)
+        expect = next(it3)
+    np.testing.assert_array_equal(nxt[0], expect[0])
+    np.testing.assert_array_equal(nxt[1], expect[1])
+
+
+def test_device_prefetch_composition(packed):
+    from apex_tpu.data import prefetch_to_device
+
+    _, ds = packed
+    with PackedLoader(ds, local_batch=4) as loader:
+        pf = prefetch_to_device(loader, depth=1, place=lambda b: b)
+        first = next(pf)
+        assert first[0].shape == (4, SIDE, SIDE, 3)
+        # wrapper subtracts its queued batches: multiples of the batch,
+        # at least one batch delivered
+        assert pf.consumed_samples % 4 == 0
+        assert pf.consumed_samples >= 4
+
+
+def test_random_crop_flip_on_device(packed):
+    _, ds = packed
+    batch = jnp.asarray(np.asarray(ds.images[:8]))
+    out = random_crop_flip(batch, jax.random.PRNGKey(0), out_size=32)
+    assert out.shape == (8, 32, 32, 3) and out.dtype == jnp.float32
+    # jittable + dtype option
+    out_bf16 = jax.jit(
+        lambda x, k: random_crop_flip(x, k, 32, dtype=jnp.bfloat16)
+    )(batch, jax.random.PRNGKey(1))
+    assert out_bf16.dtype == jnp.bfloat16
+    # every output row must be a (possibly flipped) contiguous crop of
+    # its source image: un-normalize and search for it
+    from apex_tpu.data.image_folder import IMAGENET_MEAN, IMAGENET_STD
+
+    x = np.asarray(out)
+    restored = np.rint(
+        (x * np.asarray(IMAGENET_STD) + np.asarray(IMAGENET_MEAN)) * 255.0
+    ).astype(np.int32)
+    src = np.asarray(batch).astype(np.int32)
+    for b in range(8):
+        found = any(
+            np.array_equal(cand[oh:oh + 32, ow:ow + 32], restored[b])
+            for cand in (src[b], src[b][:, ::-1, :])
+            for oh in range(SIDE - 32 + 1)
+            for ow in range(SIDE - 32 + 1)
+        )
+        assert found, f"row {b} is not a crop/flip of its source"
+
+
+def test_center_crop_on_device_matches_host(packed):
+    _, ds = packed
+    batch = jnp.asarray(np.asarray(ds.images[:4]))
+    out = center_crop(batch, 32)
+    off = (SIDE - 32) // 2
+    host = np.asarray(ds.images[:4])[:, off:off + 32, off:off + 32, :]
+    x = np.asarray(out)
+    from apex_tpu.data.image_folder import IMAGENET_MEAN, IMAGENET_STD
+
+    restored = np.rint(
+        (x * np.asarray(IMAGENET_STD) + np.asarray(IMAGENET_MEAN)) * 255.0
+    ).astype(np.uint8)
+    np.testing.assert_array_equal(restored, host)
+
+
+def test_abandoned_iteration_rewinds_samplers(packed):
+    _, ds = packed
+    loader = PackedLoader(ds, local_batch=4, prefetch=2)
+    it = iter(loader)
+    next(it)
+    del it  # abandon mid-epoch with batches gathered ahead
+    loader.close()
+    # undelivered prefetched batches were rewound: consumed == yielded
+    assert loader.consumed_samples == 4
+    # and the next iteration replays exactly from batch 2 of this epoch
+    with PackedLoader(ds, local_batch=4) as ref:
+        rit = iter(ref)
+        next(rit)
+        expect = next(rit)
+    got = next(iter(loader))
+    np.testing.assert_array_equal(got[0], expect[0])
+    loader.close()
+
+
+def test_concurrent_iterators_do_not_deadlock(packed):
+    _, ds = packed
+    loader = PackedLoader(ds, local_batch=4, prefetch=1)
+    it1 = iter(loader)
+    next(it1)
+    it2 = iter(loader)
+    next(it2)
+    it1.close()  # abandoning one iteration must not stop the other
+    for _ in range(3):
+        next(it2)  # deadlocked here before per-iteration state
+    it2.close()
+    # all undelivered batches rewound across both iterations
+    assert loader.consumed_samples % 4 == 0
+    loader.close()
+
+
+def test_producer_error_propagates(packed):
+    _, ds = packed
+    loader = PackedLoader(ds, local_batch=4)
+    loader._gather = lambda idx: (_ for _ in ()).throw(
+        RuntimeError("boom"))  # simulate a gather failure
+    with pytest.raises(RuntimeError, match="boom"):
+        next(iter(loader))
+    loader.close()
+
+
+def test_pack_rejects_empty_and_bad_version(tmp_path):
+    import json
+
+    with pytest.raises(Exception):
+        pack_image_folder(str(tmp_path / "nope"), str(tmp_path / "out"))
+    # corrupt version must fail loudly, not misparse
+    prefix = str(tmp_path / "bad")
+    with open(prefix + ".json", "w") as f:
+        json.dump({"n": 1, "side": 8, "classes": [], "version": 99}, f)
+    with pytest.raises(ValueError, match="version"):
+        PackedImageDataset(prefix)
