@@ -22,7 +22,6 @@ import argparse
 import itertools
 import json
 import os
-import subprocess
 import sys
 import time
 
@@ -30,6 +29,8 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 OUT = os.path.join(REPO, "bench_results", "flash_block_sweep.jsonl")
 if REPO not in sys.path:  # runnable as `python examples/tune_flash_blocks.py`
     sys.path.insert(0, REPO)
+
+from examples._sweep import run_sweep  # noqa: E402
 
 # jax's reference TPU flash kernel defaults to 128/128 (BlockSizes.
 # get_default, with an open TODO for a real heuristic); cover that corner
@@ -95,37 +96,16 @@ def main():
     else:
         grid = list(itertools.product(GRID_Q, GRID_K))
 
-    os.makedirs(os.path.dirname(OUT), exist_ok=True)
-    best = None
-    for bq, bk in grid:
-        env = dict(os.environ)
-        env["APEX_TPU_FLASH_BLOCK_Q"] = str(bq)
-        env["APEX_TPU_FLASH_BLOCK_K"] = str(bk)
-        print(f"--- block_q={bq} block_k={bk} seq={args.seq}",
-              file=sys.stderr, flush=True)
-        try:
-            proc = subprocess.run(
-                [sys.executable, os.path.abspath(__file__), "--child",
-                 str(bq), str(bk), str(args.seq), str(args.steps)],
-                env=env, capture_output=True, timeout=args.timeout)
-        except subprocess.TimeoutExpired:
-            print(f"    timeout after {args.timeout:.0f}s",
-                  file=sys.stderr, flush=True)
-            continue
-        if proc.returncode != 0:
-            print("    rc=%d %s" % (
-                proc.returncode,
-                proc.stderr.decode(errors="replace")[-400:]),
-                file=sys.stderr, flush=True)
-            continue
-        line = proc.stdout.decode().strip().splitlines()[-1]
-        rec = json.loads(line)
-        with open(OUT, "a") as f:
-            f.write(line + "\n")
-        print(f"    {rec['tokens_per_sec']} tok/s  mfu={rec['mfu']}",
-              file=sys.stderr, flush=True)
-        if best is None or rec["tokens_per_sec"] > best["tokens_per_sec"]:
-            best = rec
+    best = run_sweep(
+        grid,
+        env_for=lambda p: {"APEX_TPU_FLASH_BLOCK_Q": str(p[0]),
+                           "APEX_TPU_FLASH_BLOCK_K": str(p[1])},
+        child_args_for=lambda p: [
+            os.path.abspath(__file__), "--child",
+            str(p[0]), str(p[1]), str(args.seq), str(args.steps)],
+        label_for=lambda p: (
+            f"block_q={p[0]} block_k={p[1]} seq={args.seq}"),
+        out_path=OUT, timeout=args.timeout)
     if best:
         print(json.dumps({"best": best}))
         # Land the winner automatically: a TPU sweep at the flagship seq
