@@ -143,7 +143,16 @@ def _timeit(jax, step, state, steps):
 # ResNet-50 benches
 # ---------------------------------------------------------------------------
 
-def _resnet_bench(jax, on_tpu, optimizer_name, sync_bn=False):
+def resnet_setup(jax, on_tpu, optimizer_name, sync_bn=False):
+    """Build the RN50 train step — the ONE definition of the resnet50_*
+    workloads, shared by the bench and ``examples/profile_resnet.py`` so
+    a profile explains exactly the numbers the bench records.
+
+    Returns ``(train_step, state0, meta)`` where ``state0 = (params,
+    batch_stats, opt_state, sharded_batch)`` is the step's carry (the
+    bench threads the batch through) and ``meta`` carries the record
+    fields.  Call ``meta["mesh_cleanup"]()`` when done.
+    """
     import jax.numpy as jnp
     import numpy as np
     from jax.sharding import PartitionSpec as P
@@ -241,26 +250,47 @@ def _resnet_bench(jax, on_tpu, optimizer_name, sync_bn=False):
                         jnp.float32)
         y = jnp.asarray(rng.randint(0, 1000, size=(batch,)))
         sharded = dp_shard_batch((x, y), mesh)
+    except BaseException:
+        mesh_lib.destroy_model_parallel()
+        raise
 
+    meta = {
+        "n_chips": n_chips,
+        "batch": batch,
+        "batch_per_chip": batch_per_chip,
+        "image_size": image_size,
+        "steps": steps,
+        "optimizer": optimizer_name,
+        "sync_bn": sync_bn,
+        "mesh_cleanup": mesh_lib.destroy_model_parallel,
+    }
+    return train_step, (params, batch_stats, opt_state, sharded), meta
+
+
+def _resnet_bench(jax, on_tpu, optimizer_name, sync_bn=False):
+    train_step, st0, meta = resnet_setup(jax, on_tpu, optimizer_name,
+                                         sync_bn=sync_bn)
+    try:
+        batch, steps = meta["batch"], meta["steps"]
         _log(f"resnet50({optimizer_name}): compile start")
         t0 = time.perf_counter()
-        state = train_step(params, batch_stats, opt_state, sharded)
+        state = train_step(*st0)
         jax.block_until_ready(state)
         _log(f"resnet50({optimizer_name}): compiled in "
              f"{time.perf_counter() - t0:.1f}s; timing {steps} steps")
         dt, _ = _timeit(jax, train_step, state, steps)
 
-        ips_per_chip = batch * steps / dt / n_chips
+        ips_per_chip = batch * steps / dt / meta["n_chips"]
         return {
             "value": round(ips_per_chip, 1),
             "unit": "images/sec/chip",
-            "n_chips": n_chips,
-            "batch_per_chip": batch_per_chip,
-            "image_size": image_size,
+            "n_chips": meta["n_chips"],
+            "batch_per_chip": meta["batch_per_chip"],
+            "image_size": meta["image_size"],
             "optimizer": optimizer_name,
         }
     finally:
-        mesh_lib.destroy_model_parallel()
+        meta["mesh_cleanup"]()
 
 
 def bench_resnet50_o2(jax, on_tpu):

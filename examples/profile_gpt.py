@@ -4,20 +4,19 @@ guess").
 
     python examples/profile_gpt.py [--seq 1024] [--steps 5]
 
-Writes a TensorBoard/XPlane trace directory under
-``bench_results/profiles/<stamp>/`` plus a one-line JSON summary of
-step time and MFU for the profiled configuration.
+Writes a TensorBoard/XPlane trace under ``bench_results/profiles/`` plus
+a one-line JSON summary (shared harness: ``examples/_profile.py``).
 """
 
 import argparse
-import json
 import os
 import sys
-import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if REPO not in sys.path:
     sys.path.insert(0, REPO)
+
+from examples._profile import init_bench_backend, profile_capture  # noqa: E402
 
 
 def main():
@@ -26,49 +25,25 @@ def main():
     p.add_argument("--steps", type=int, default=5)
     args = p.parse_args()
 
-    import jax
-
-    if os.environ.get("JAX_PLATFORMS", "").lower() == "cpu":
-        from apex_tpu.utils.platform import pin_cpu
-
-        pin_cpu()
-
-    import bench
-
-    bench.enable_compilation_cache(jax)
-    dev = jax.devices()[0]
-    on_tpu = dev.platform == "tpu"
+    jax, bench, dev, on_tpu = init_bench_backend()
 
     # exactly the bench/sweep workload (one shared definition, so the
     # trace explains the numbers those harnesses record)
-    cfg, step, st, batch, seq, n_params = bench.gpt_flash_setup(
+    cfg, step, st0, batch, seq, n_params = bench.gpt_flash_setup(
         jax, on_tpu, seq=args.seq)
 
-    st = step(*st)  # compile + warm
-    st = step(*st)
-    jax.block_until_ready(st)
-
-    stamp = time.strftime("%Y%m%d_%H%M%S")
-    trace_dir = os.path.join(REPO, "bench_results", "profiles", stamp)
-    os.makedirs(trace_dir, exist_ok=True)
-    with jax.profiler.trace(trace_dir):
-        dt, st = bench._timeit(jax, step, st, args.steps)
-
-    flops = bench._lm_train_flops(cfg, n_params, batch, seq) * args.steps / dt
-    rec = {
-        "trace_dir": os.path.relpath(trace_dir, REPO),
-        "platform": dev.platform,
-        "device_kind": getattr(dev, "device_kind", ""),
-        "batch": batch, "seq": seq, "steps": args.steps,
-        "step_ms": round(dt / args.steps * 1e3, 2),
-        "tokens_per_sec": round(batch * seq * args.steps / dt, 1),
-        "mfu": round(flops / bench._peak_flops(dev), 4) if on_tpu else None,
-        "ts": stamp,
-    }
-    out = os.path.join(REPO, "bench_results", "profiles", "summary.jsonl")
-    with open(out, "a") as f:
-        f.write(json.dumps(rec) + "\n")
-    print(json.dumps(rec))
+    profile_capture(
+        "gpt_flash", jax, bench, step, st0, args.steps,
+        {
+            "batch": batch,
+            "seq": seq,
+            "tokens_per_sec": lambda dt: round(
+                batch * seq * args.steps / dt, 1),
+            "mfu": (lambda dt: round(
+                bench._lm_train_flops(cfg, n_params, batch, seq)
+                * args.steps / dt / bench._peak_flops(dev), 4))
+            if on_tpu else None,
+        })
 
 
 if __name__ == "__main__":
