@@ -1,0 +1,62 @@
+#!/bin/bash
+# TPU evidence capture, v3 — capture2's wedge-aware structure plus the
+# harnesses built since it launched, ordered by evidentiary value:
+#
+#   1. bench.py full 9-row matrix   (internal poller + wedge-pause, 6 h window)
+#   2. lamb-vs-syncbn A/B           (--one diagnostics: which factor costs 3.4x)
+#   3. GPT batch sweep              (MFU 0.4155 @ batch 8 -> probe 16/32)
+#   4. flash block sweep seq 1024   (auto-lands tuned defaults on TPU)
+#   5. GPT step profile             (the MFU gap's trace)
+#   6. RN50 lamb+syncbn profile     (the slow row's trace)
+#   7. flash block sweep seq 8192
+#   8. remat_ticks memory           (virtual-mesh 4-10x claim -> XLA stats)
+#   9. pipeline tick anchor
+#  10. re-bench                     (picks up tuned blocks; never overwrites)
+#
+# Every non-bench stage gates on a live-chip probe: a wedge costs
+# probe-time, not stage budget.  Evidence lands incrementally.
+set -u
+cd "$(dirname "$0")/.."
+LOG=.tpu_watch/capture3.log
+mkdir -p .tpu_watch bench_results
+stamp() { date +%H:%M:%S; }
+log() { echo "== $(stamp) $*" >> "$LOG"; }
+probe() {
+  timeout 90 python -c \
+    "import jax; assert jax.devices()[0].platform == 'tpu'" \
+    >/dev/null 2>&1
+}
+wait_for_chip() {
+  until probe; do log "chip down; re-probing in 120s"; sleep 120; done
+  log "chip up"
+}
+run() {
+  log "start: $*"
+  timeout "${STAGE_TIMEOUT:-2400}" "$@" >> "$LOG" 2>&1
+  log "rc=$? ($1 $2)"
+}
+
+log "capture3 start"
+STAGE_TIMEOUT=22000 BENCH_DEADLINE_S=21600 run python bench.py
+
+wait_for_chip
+STAGE_TIMEOUT=600 run python bench.py --one resnet50_sgd_syncbn
+wait_for_chip
+STAGE_TIMEOUT=600 run python bench.py --one resnet50_lamb_nosync
+wait_for_chip
+run python examples/tune_gpt_batch.py
+wait_for_chip
+run python examples/tune_flash_blocks.py --seq 1024 --timeout 600
+wait_for_chip
+STAGE_TIMEOUT=1200 run python examples/profile_gpt.py
+wait_for_chip
+STAGE_TIMEOUT=1200 run python examples/profile_resnet.py --optimizer lamb --sync-bn
+wait_for_chip
+run python examples/tune_flash_blocks.py --seq 8192 --steps 5 --timeout 600
+wait_for_chip
+STAGE_TIMEOUT=1200 run python examples/measure_remat_memory.py
+wait_for_chip
+STAGE_TIMEOUT=1200 run python examples/measure_pipeline_tick.py
+wait_for_chip
+BENCH_DEADLINE_S=2100 run python bench.py
+log "capture3 done"
