@@ -117,25 +117,17 @@ def _tuned_blocks():
     must not leak onto another with a different VMEM budget."""
     global _TUNED_CACHE
     if _TUNED_CACHE is None:
-        q = k = None
-        try:
-            import json
+        from apex_tpu.utils.tuning import load_tuned_record
 
-            repo = _os.path.dirname(_os.path.dirname(_os.path.dirname(
-                _os.path.abspath(__file__))))
-            with open(_os.path.join(repo, "bench_results",
-                                    "flash_blocks_tuned.json")) as f:
-                rec = json.load(f)
-            dev = jax.devices()[0]
-            if (dev.platform == "tpu"
-                    and rec.get("device_kind")
-                    and rec["device_kind"] == getattr(
-                        dev, "device_kind", None)):
+        q = k = None
+        rec = load_tuned_record("flash_blocks_tuned.json", jax)
+        if rec is not None:
+            try:
                 q, k = int(rec["block_q"]), int(rec["block_k"])
                 if q <= 0 or k <= 0:
                     q = k = None
-        except Exception:
-            q = k = None
+            except (KeyError, TypeError, ValueError):
+                q = k = None
         _TUNED_CACHE = (q, k)
     return _TUNED_CACHE
 

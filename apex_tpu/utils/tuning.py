@@ -1,0 +1,35 @@
+"""Hardware-gated tuned-default records (the sweep auto-land protocol).
+
+A hardware sweep (``examples/tune_flash_blocks.py``,
+``examples/tune_gpt_batch.py``) writes its winner to a small json under
+``bench_results/``; consumers adopt it lazily at first use and ONLY when
+the record's ``device_kind`` matches the attached TPU — a winner swept
+on one TPU generation must not leak onto another with a different
+VMEM/HBM budget.  Env knobs always take precedence at the call sites.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def load_tuned_record(filename: str, jax) -> Optional[dict]:
+    """The parsed ``bench_results/<filename>`` record iff the attached
+    device is a TPU whose ``device_kind`` matches; else None.  Any read/
+    parse problem degrades to None (shipped defaults win)."""
+    try:
+        with open(os.path.join(_REPO, "bench_results", filename)) as f:
+            rec = json.load(f)
+        dev = jax.devices()[0]
+        if (dev.platform == "tpu"
+                and rec.get("device_kind")
+                and rec["device_kind"] == getattr(dev, "device_kind", None)):
+            return rec
+    except Exception:
+        pass
+    return None

@@ -383,6 +383,21 @@ def bench_bert_large(jax, on_tpu):
     }
 
 
+def _tuned_gpt_batch(jax):
+    """Per-chip batch from ``bench_results/gpt_batch_tuned.json`` (written
+    by a TPU sweep of ``examples/tune_gpt_batch.py`` at the flagship seq),
+    adopted only on a matching ``device_kind``."""
+    from apex_tpu.utils.tuning import load_tuned_record
+
+    rec = load_tuned_record("gpt_batch_tuned.json", jax)
+    try:
+        if rec and int(rec.get("base_batch", 0)) > 0:
+            return int(rec["base_batch"])
+    except (TypeError, ValueError):
+        pass
+    return None
+
+
 def gpt_flash_setup(jax, on_tpu, seq=None, fp8=False):
     """Build the flagship GPT-124M flash train step — the ONE definition
     of the ``gpt_flash`` workload, shared by this bench, the block-size
@@ -402,10 +417,15 @@ def gpt_flash_setup(jax, on_tpu, seq=None, fp8=False):
     if on_tpu:
         seq = seq or 1024
         # APEX_TPU_GPT_BATCH: per-chip batch sweep knob for hardware
-        # capture (shipped default 8 = the recorded configuration; a
-        # sweep that finds a better MFU point records it in
-        # bench_results/ before any default bump)
-        base_batch = _env_int("APEX_TPU_GPT_BATCH", 8)
+        # capture.  Precedence: env > hardware-matched tuned file
+        # (written by examples/tune_gpt_batch.py from a TPU sweep, the
+        # flash-blocks auto-land pattern) > shipped 8.  The tuned file is
+        # consulted only when the env knob is absent (sweep children set
+        # it, so a stale tuned record can't contaminate a sweep).  The
+        # record always carries the batch actually used.
+        base_batch = (_env_int("APEX_TPU_GPT_BATCH", 8)
+                      if "APEX_TPU_GPT_BATCH" in os.environ
+                      else (_tuned_gpt_batch(jax) or 8))
         batch = base_batch if seq <= 1024 else max(
             1, base_batch * 1024 // seq)
         cfg = TransformerConfig(

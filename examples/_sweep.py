@@ -16,14 +16,17 @@ import sys
 
 def run_sweep(points, *, env_for, child_args_for, label_for, out_path,
               timeout):
-    """Run each point; return the best record (or None if all failed).
+    """Run each point; return ``(best, records)`` — the top record by
+    ``tokens_per_sec`` (None if every point failed) and the list of all
+    successful records, so callers can gate decisions (e.g. auto-landing
+    a tuned default) on how many points actually survived.
 
     ``env_for(pt)``: extra env vars for the child;
     ``child_args_for(pt)``: argv after ``sys.executable``;
     ``label_for(pt)``: stderr progress label.
     """
     os.makedirs(os.path.dirname(out_path), exist_ok=True)
-    best = None
+    best, records = None, []
     for pt in points:
         env = dict(os.environ)
         env.update(env_for(pt))
@@ -57,7 +60,8 @@ def run_sweep(points, *, env_for, child_args_for, label_for, out_path,
             f.write(line + "\n")
         print(f"    {rec.get('tokens_per_sec')} tok/s  mfu={rec.get('mfu')}",
               file=sys.stderr, flush=True)
+        records.append(rec)
         if best is None or (rec.get("tokens_per_sec") or 0) > (
                 best.get("tokens_per_sec") or 0):
             best = rec
-    return best
+    return best, records

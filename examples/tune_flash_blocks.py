@@ -96,7 +96,7 @@ def main():
     else:
         grid = list(itertools.product(GRID_Q, GRID_K))
 
-    best = run_sweep(
+    best, records = run_sweep(
         grid,
         env_for=lambda p: {"APEX_TPU_FLASH_BLOCK_Q": str(p[0]),
                            "APEX_TPU_FLASH_BLOCK_K": str(p[1])},
@@ -114,7 +114,10 @@ def main():
         # call, gated on matching device_kind (env overrides still win) —
         # so an unattended chip-return capture upgrades the shipped
         # defaults without a source edit.
-        if best["platform"] == "tpu" and args.seq == 1024 and not args.one:
+        # >1 successful point required: a lone survivor (others
+        # wedged/OOMed) is no comparison.
+        if (best["platform"] == "tpu" and args.seq == 1024
+                and not args.one and len(records) > 1):
             tuned_path = os.path.join(REPO, "bench_results",
                                       "flash_blocks_tuned.json")
             with open(tuned_path, "w") as f:
