@@ -61,14 +61,19 @@ __all__ = [
 
 
 def pack_image_folder(root_or_dataset, out_prefix: str, side: int = 232,
-                      workers: int = 8) -> "PackedImageDataset":
+                      workers: int = 8, resize: Optional[int] = None
+                      ) -> "PackedImageDataset":
     """Decode an ImageFolder tree once into a packed array shard.
 
     Each image is center-crop-resized to ``side``x``side`` uint8 (the
     deterministic eval transform — augmentation happens on-device at
-    train time) and appended to ``<out_prefix>.data``.  Decode fans out
-    over ``workers`` PIL threads; packing is a one-time cost, so the
-    online loader's native JPEG fast path is not plumbed through here.
+    train time) and appended to ``<out_prefix>.data``.  ``resize``
+    forwards to :func:`center_crop_resize` (default: the reference's
+    256/224-proportional pre-resize for ``side``); an **eval** shard
+    packed at ``side == image_size`` is therefore pixel-identical to the
+    online JPEG eval transform.  Decode fans out over ``workers`` PIL
+    threads; packing is a one-time cost, so the online loader's native
+    JPEG fast path is not plumbed through here.
     """
     from concurrent.futures import ThreadPoolExecutor
 
@@ -87,7 +92,7 @@ def pack_image_folder(root_or_dataset, out_prefix: str, side: int = 232,
 
     def one(i: int) -> None:
         img, label = ds.load(i)
-        mm[i] = center_crop_resize(img, side)
+        mm[i] = center_crop_resize(img, side, resize)
         labels[i] = label
 
     with ThreadPoolExecutor(max_workers=workers) as pool:

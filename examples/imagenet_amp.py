@@ -104,10 +104,12 @@ def main(argv=None):
                         "training — the reference's validate() loop "
                         "(main_amp.py:284-342); requires --data")
     args = p.parse_args(argv)
-    if args.evaluate and args.data is None:
-        p.error("--evaluate requires --data")
-    if args.evaluate:
+    if args.evaluate and args.data is None and args.packed is None:
+        p.error("--evaluate requires --data or --packed")
+    if args.evaluate and args.data is not None:
         _split_dir(args.data, "val")  # fail fast on partial layouts
+    if args.evaluate and args.packed is not None:
+        _packed_val_shard(args)  # pack/validate now, not after training
 
     mesh = parallel.initialize_model_parallel()
     print(parallel.mesh.get_rank_info())
@@ -260,6 +262,43 @@ def main(argv=None):
     return ips
 
 
+def _packed_val_shard(args):
+    """Load (or pack, one-time) the eval shard at ``<packed>_val``.
+
+    Packed at side == --image-size with the reference's proportional
+    pre-resize, so the stored pixels are identical to the online JPEG
+    eval transform (the on-device center crop degenerates to identity).
+    Called from main() before training starts — a missing/mismatched
+    shard must not cost a whole training run — and again from
+    validate(), where the cached checks are instant.
+    """
+    import os
+
+    vprefix = args.packed + "_val"
+    if not os.path.exists(vprefix + ".json"):
+        if args.data is None:
+            raise SystemExit(
+                f"--evaluate with --packed: val shard {vprefix} not "
+                f"found and no --data folder to pack it from")
+        val_dir = _split_dir(args.data, "val")
+        if val_dir == args.data:
+            print("warning: flat --data layout (no val/ split); the "
+                  "packed 'val' shard will hold the training images "
+                  "(train accuracy, not validation) — and will be "
+                  "reused by later runs until deleted")
+        print(f"packing val split -> {vprefix} (one-time)")
+        pds_val = pack_image_folder(
+            val_dir, vprefix, side=args.image_size, workers=args.workers)
+    else:
+        pds_val = PackedImageDataset(vprefix)
+    if pds_val.side < args.image_size:
+        raise SystemExit(
+            f"val shard side={pds_val.side} < --image-size "
+            f"{args.image_size}; re-pack it")
+    _check_num_classes(pds_val.classes, args)
+    return pds_val
+
+
 def validate(model, params, batch_stats, policy, mesh, args):
     """One pass over the eval split: center-crop transform, running BN
     stats, top-1/top-5 accuracy — the reference's ``validate()`` +
@@ -270,23 +309,41 @@ def validate(model, params, batch_stats, policy, mesh, args):
     images are walked in order and the final partial batch is padded to
     the fixed batch shape with a validity mask, so no tail is dropped,
     shapes stay static for jit, and sets smaller than one batch work.
+
+    With ``--packed`` the val split is also packed (``PREFIX_val``,
+    one-time, at side == --image-size with the reference's proportional
+    pre-resize) and evaluated decode-free: sequential memmap slices,
+    pixel-identical to the JPEG path's transform (the on-device center
+    crop degenerates to identity at matching side).
     """
     import numpy as np
 
     from apex_tpu.data import center_crop_resize
+    from apex_tpu.data.packed import center_crop as packed_center_crop
     from apex_tpu.parallel import dp_shard_batch
 
-    val_dir = _split_dir(args.data, "val")
-    if val_dir == args.data:
-        print("warning: flat --data layout (no val/ split); evaluating "
-              "over the full folder (train accuracy, not validation)")
-    dataset = ImageFolder(val_dir)
     k = min(5, args.num_classes)
+    use_packed = args.packed is not None
+    if use_packed:
+        pds_val = _packed_val_shard(args)
+        n_total = len(pds_val)
+    else:
+        val_dir = _split_dir(args.data, "val")
+        if val_dir == args.data:
+            print("warning: flat --data layout (no val/ split); evaluating "
+                  "over the full folder (train accuracy, not validation)")
+        dataset = ImageFolder(val_dir)
+        n_total = len(dataset)
 
     @jax.jit
     def eval_step(params, batch_stats, batch):
         x_uint8, y, valid = batch
-        x = normalize_on_device(x_uint8, dtype=policy.compute_dtype)
+        if use_packed:
+            # stored at shard side; crop + normalize on device
+            x = packed_center_crop(x_uint8, args.image_size,
+                                   dtype=policy.compute_dtype)
+        else:
+            x = normalize_on_device(x_uint8, dtype=policy.compute_dtype)
         logits = model.apply(
             {"params": params, "batch_stats": batch_stats}, x, train=False)
         topk = jax.lax.top_k(logits.astype(jnp.float32), k)[1]
@@ -294,42 +351,57 @@ def validate(model, params, batch_stats, policy, mesh, args):
         hitk = (topk == y[:, None]).any(axis=1) & valid
         return jnp.sum(hit1), jnp.sum(hitk)
 
-    from concurrent.futures import ThreadPoolExecutor
-
-    def decode(i):
-        img, label = dataset.load(i)
-        return center_crop_resize(img, args.image_size), label
-
     batch = args.batch_size
     n = 0
     c1 = c5 = jnp.int32(0)  # device accumulators: no per-batch host sync
 
-    def assemble(futs):
-        decoded = [f.result() for f in futs]
-        pad = batch - len(decoded)
-        xs = np.stack([d[0] for d in decoded] + [decoded[-1][0]] * pad)
-        ys = np.asarray([d[1] for d in decoded]
-                        + [decoded[-1][1]] * pad, np.int32)
-        valid = np.arange(batch) < len(decoded)
-        return dp_shard_batch((xs, ys, valid), mesh), len(decoded)
+    def pad_batch(xs, ys):
+        real = len(ys)
+        pad = batch - real
+        if pad:
+            xs = np.concatenate([xs, np.repeat(xs[-1:], pad, axis=0)])
+            ys = np.concatenate([ys, np.repeat(ys[-1:], pad)])
+        valid = np.arange(batch) < real
+        return dp_shard_batch(
+            (xs, np.asarray(ys, np.int32), valid), mesh), real
 
-    with ThreadPoolExecutor(max_workers=args.workers) as pool:
-        starts = list(range(0, len(dataset), batch))
-        submit = lambda s: [  # noqa: E731
-            pool.submit(decode, i)
-            for i in range(s, min(s + batch, len(dataset)))]
-        pending = submit(starts[0])
-        for j, start in enumerate(starts):
-            futs = pending
-            if j + 1 < len(starts):
-                # submit j+1 BEFORE blocking on j's stragglers: freed
-                # workers roll straight into the next batch
-                pending = submit(starts[j + 1])
-            batch_dev, n_real = assemble(futs)
-            h1, h5 = eval_step(params, batch_stats, batch_dev)
-            c1 = c1 + h1
-            c5 = c5 + h5
-            n += n_real
+    def batches():
+        if use_packed:
+            # sequential full-coverage slices (no sampler: eval must not
+            # drop the tail, and order doesn't matter)
+            for start in range(0, n_total, batch):
+                stop = min(start + batch, n_total)
+                yield pad_batch(np.asarray(pds_val.images[start:stop]),
+                                np.asarray(pds_val.labels[start:stop]))
+            return
+
+        from concurrent.futures import ThreadPoolExecutor
+
+        def decode(i):
+            img, label = dataset.load(i)
+            return center_crop_resize(img, args.image_size), label
+
+        with ThreadPoolExecutor(max_workers=args.workers) as pool:
+            starts = list(range(0, n_total, batch))
+            submit = lambda s: [  # noqa: E731
+                pool.submit(decode, i)
+                for i in range(s, min(s + batch, n_total))]
+            pending = submit(starts[0])
+            for j in range(len(starts)):
+                futs = pending
+                if j + 1 < len(starts):
+                    # submit j+1 BEFORE blocking on j's stragglers: freed
+                    # workers roll straight into the next batch
+                    pending = submit(starts[j + 1])
+                decoded = [f.result() for f in futs]
+                yield pad_batch(np.stack([d[0] for d in decoded]),
+                                np.asarray([d[1] for d in decoded]))
+
+    for batch_dev, n_real in batches():
+        h1, h5 = eval_step(params, batch_stats, batch_dev)
+        c1 = c1 + h1
+        c5 = c5 + h5
+        n += n_real
     return (int(c1) / max(n, 1), int(c5) / max(n, 1), k)
 
 
