@@ -286,13 +286,17 @@ def _fwd_kernel(*refs, scale, causal, q_offset, kv_offset, has_segments,
         acc_sc[...] = jnp.zeros_like(acc_sc)
 
     def _body():
-        q = q_ref[0, 0].astype(jnp.float32) * scale
-        k = k_ref[0, 0].astype(jnp.float32)
-        v = v_ref[0, 0].astype(jnp.float32)
+        # Matmuls run in the INPUT dtype with fp32 accumulation: a
+        # bf16xbf16->f32 MXU pass is ~2x the fp32 rate, and upcasting
+        # the operands first forfeits that (r4 finding; the softmax/
+        # rescale math stays fp32 below).  fp32 inputs are unaffected.
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        )
+        ) * scale
         seg_q = seg_q_ref[0, :, 0] if has_segments else None
         seg_k = seg_k_ref[0, :, 0] if has_segments else None
         mask = _block_mask(iq, jk, bq, bk, causal, q_offset, kv_offset,
@@ -316,8 +320,10 @@ def _fwd_kernel(*refs, scale, causal, q_offset, kv_offset, has_segments,
             p_acc = jnp.where(keep, p * (1.0 / (1.0 - dropout_rate)), 0.0)
         else:
             p_acc = p
+        # p quantized to V's dtype for the PV matmul (the fmha/flash
+        # convention — the reference kernel holds P in fp16)
         acc_new = acc_sc[...] * alpha[:, None] + jax.lax.dot_general(
-            p_acc, v, (((1,), (0,)), ((), ())),
+            p_acc.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         m_sc[...] = jnp.broadcast_to(m_new[:, None], m_sc.shape)
@@ -382,10 +388,11 @@ def _dq_kernel(*refs, scale, causal, q_offset, kv_offset, has_segments,
         dq_sc[...] = jnp.zeros_like(dq_sc)
 
     def _body():
-        q = q_ref[0, 0].astype(jnp.float32)
-        k = k_ref[0, 0].astype(jnp.float32)
-        v = v_ref[0, 0].astype(jnp.float32)
-        do = do_ref[0, 0].astype(jnp.float32)
+        # input-dtype matmuls, fp32 accumulate (see _fwd_kernel note)
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
         lse = lse_ref[0, 0, :, 0]
         delta = delta_ref[0, 0, :, 0]
 
@@ -410,7 +417,7 @@ def _dq_kernel(*refs, scale, causal, q_offset, kv_offset, has_segments,
             dp = jnp.where(keep, dp * (1.0 / (1.0 - dropout_rate)), 0.0)
         ds = p * (dp - delta[:, None]) * scale
         dq_sc[...] += jax.lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())),
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
 
@@ -451,12 +458,13 @@ def _dkv_kernel(*refs, scale, causal, q_offset, kv_offset, has_segments,
         dv_sc[...] = jnp.zeros_like(dv_sc)
 
     def _body():
-        q = q_ref[0, 0].astype(jnp.float32)
-        do = do_ref[0, 0].astype(jnp.float32)
+        # input-dtype matmuls, fp32 accumulate (see _fwd_kernel note)
+        q = q_ref[0, 0]
+        do = do_ref[0, 0]
         lse = lse_ref[0, 0, :, 0]
         delta = delta_ref[0, 0, :, 0]
-        k = k_ref[0, 0].astype(jnp.float32)
-        v = v_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
 
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
@@ -482,12 +490,12 @@ def _dkv_kernel(*refs, scale, causal, q_offset, kv_offset, has_segments,
         else:
             p_drop = p
         dv_sc[...] += jax.lax.dot_general(
-            p_drop, do, (((0,), (0,)), ((), ())),
+            p_drop.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         ds = p * (dp - delta[:, None]) * scale
         dk_sc[...] += jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
 
