@@ -270,6 +270,11 @@ CONFIGS = {
     # GPT numerics axis
     "gpt_bf16": partial(_trace_gpt, jnp.bfloat16),
     "gpt_fp8": partial(_trace_gpt, None, True),
+    # flash-kernel numerics (Pallas interpret mode on CPU runs the same
+    # kernel code the chip compiles — pins the hot kernel's math,
+    # including the r4 input-dtype-matmul convention, to a stored trace)
+    "gpt_flash": partial(_trace_gpt, jnp.bfloat16, False,
+                         use_flash_attention=True),
     # modern-architecture axis (RoPE + GQA + SwiGLU — the LLaMA-shaped
     # stack of transformer/rope.py and standalone_transformer_lm.py)
     "gpt_modern": partial(_trace_gpt, None, False,
