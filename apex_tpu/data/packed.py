@@ -36,6 +36,7 @@ make when fed pre-resized shards).
 from __future__ import annotations
 
 import json
+import logging
 import os
 import queue
 import threading
@@ -263,6 +264,27 @@ class PackedLoader:
             except queue.Empty:
                 pass
             st.thread.join(timeout=5.0)
+            # wake a consumer still blocked in queue.get() (a preempted
+            # iterator whose producer exited without a sentinel): drain
+            # anything the producer managed to enqueue before stopping,
+            # then leave one end-of-epoch sentinel
+            try:
+                while True:
+                    st.queue.get_nowait()
+            except queue.Empty:
+                pass
+            try:
+                st.queue.put_nowait(None)
+            except queue.Full:
+                pass
+            if st.thread.is_alive():
+                # a producer stuck >5 s (cold memmap page-in on a slow
+                # disk) is left daemonized but must be visible, not a
+                # silently leaked thread holding the drained queue
+                logging.getLogger(__name__).warning(
+                    "PackedLoader: producer thread did not exit within "
+                    "5 s of stop; leaking it as a daemon (likely blocked "
+                    "in a memmap gather)")
             st.thread = None
         with self._lock:
             if st in self._active:
@@ -277,7 +299,17 @@ class PackedLoader:
         # one epoch per __iter__ call, mirroring ImageFolderLoader: the
         # samplers hold position, so re-iterating starts the next epoch.
         # All iteration state is per-call so overlapping/abandoned
-        # iterators never share a stop flag or queue.
+        # iterators never share a stop flag or queue — but the SAMPLERS
+        # are shared, so two *live* producers would interleave duplicate
+        # index streams while double-advancing consumed_samples.  Only
+        # one live iteration is supported (as with ImageFolderLoader):
+        # starting a new one first tears down any still-active prior
+        # iteration (covers abandoned, un-GC'd generators) and rewinds
+        # its undelivered batches.
+        with self._lock:
+            stale = list(self._active)
+        for old in stale:
+            self._finish(old)
         st = _Iteration(self.prefetch)
         with self._lock:
             self._active.append(st)
@@ -286,11 +318,25 @@ class PackedLoader:
         st.thread.start()
         try:
             while True:
-                batch = st.queue.get()
+                # poll-with-timeout rather than a bare blocking get: a
+                # preempted iteration (stop set by a newer __iter__) must
+                # terminate even if its wake-up sentinel was lost to a
+                # racing put from a slow-to-exit producer
+                try:
+                    batch = st.queue.get(timeout=0.5)
+                except queue.Empty:
+                    if st.stop.is_set():
+                        return
+                    continue
                 if batch is None:
                     return
                 if isinstance(batch, _ProducerError):
                     raise batch.exc
+                if st.stop.is_set():
+                    # this batch was already rewound by _finish's drain
+                    # accounting — yielding it would deliver duplicate
+                    # training data
+                    return
                 with self._lock:
                     st.mine -= 1
                 yield batch

@@ -6,8 +6,11 @@ from apex_tpu.utils.random import (  # noqa: F401
     fold_in_axis,
 )
 from apex_tpu.utils.tree import (  # noqa: F401
+    chunked_per_leaf_sumsq,
     flatten_to_buffer,
+    flatten_to_chunked,
     unflatten_from_buffer,
+    unflatten_from_chunked,
     tree_l2_norm,
     per_leaf_l2_norms,
     tree_size,

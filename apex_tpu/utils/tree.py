@@ -23,6 +23,9 @@ import numpy as np
 __all__ = [
     "flatten_to_buffer",
     "unflatten_from_buffer",
+    "flatten_to_chunked",
+    "unflatten_from_chunked",
+    "chunked_per_leaf_sumsq",
     "tree_l2_norm",
     "per_leaf_l2_norms",
     "tree_size",
@@ -89,6 +92,91 @@ def unflatten_from_buffer(buf: jnp.ndarray, meta: _FlatMeta):
         chunk = jax.lax.dynamic_slice_in_dim(buf, off, size)
         leaves.append(jnp.asarray(chunk.reshape(shape), dt))
     return jax.tree_util.tree_unflatten(meta.treedef, leaves)
+
+
+class _ChunkMeta(NamedTuple):
+    treedef: Any
+    shapes: Tuple[Tuple[int, ...], ...]
+    dtypes: Tuple[Any, ...]
+    row_offsets: Tuple[int, ...]   # first (T, chunk)-row of each leaf
+    n_rows: int
+    chunk: int
+    leaf_ids: Any                  # np.int32 (n_rows,): row -> leaf index
+
+
+def flatten_to_chunked(
+    tree, chunk: int = 256, dtype=jnp.float32
+) -> Tuple[jnp.ndarray, _ChunkMeta]:
+    """Pack all leaves into one 2-D ``(rows, chunk)`` buffer, each leaf
+    padded (with zeros) to a whole number of rows so **no row spans two
+    leaves** — the TPU-shaped ``multi_tensor_apply`` workspace
+    (``csrc/multi_tensor_apply.cuh``'s chunking, minus the 320-tensor
+    launch caps, which XLA has no analog of).
+
+    With leaf boundaries row-aligned, per-tensor reductions become a cheap
+    two-stage pass — a vectorized row reduction (VPU-friendly, lane
+    dimension = ``chunk``) followed by a ``segment_sum`` over ``rows``
+    scalars (see :func:`chunked_per_leaf_sumsq`) — and per-tensor scalars
+    broadcast back as a ``(rows, 1)`` column, never a gather over
+    elements.  ``meta.leaf_ids`` is a host-side ``np.int32`` constant of
+    one entry per row (~4 bytes per 1 KiB of fp32 state)."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    shapes = tuple(tuple(np.shape(x)) for x in leaves)
+    dtypes = tuple(jnp.asarray(x).dtype for x in leaves)
+    sizes = [int(np.prod(s)) for s in shapes]
+    rows_per_leaf = [(s + chunk - 1) // chunk for s in sizes]
+    row_offsets = tuple(int(x) for x in np.cumsum([0] + rows_per_leaf[:-1]))
+    n_rows = int(sum(rows_per_leaf))
+    leaf_ids = np.repeat(
+        np.arange(len(leaves), dtype=np.int32), rows_per_leaf)
+    if leaves:
+        parts = []
+        for x, size, rows in zip(leaves, sizes, rows_per_leaf):
+            flat = jnp.ravel(jnp.asarray(x, dtype))
+            pad = rows * chunk - size
+            if pad:
+                flat = jnp.pad(flat, (0, pad))
+            parts.append(flat)
+        buf = jnp.concatenate(parts).reshape(max(n_rows, 1), chunk) \
+            if n_rows else jnp.zeros((0, chunk), dtype)
+    else:
+        buf = jnp.zeros((0, chunk), dtype)
+    meta = _ChunkMeta(treedef=treedef, shapes=shapes, dtypes=dtypes,
+                      row_offsets=row_offsets, n_rows=n_rows, chunk=chunk,
+                      leaf_ids=leaf_ids)
+    return buf, meta
+
+
+def unflatten_from_chunked(buf: jnp.ndarray, meta: _ChunkMeta):
+    """Inverse of :func:`flatten_to_chunked`: slice each leaf's rows back
+    out, drop its padding tail, restore shape and dtype."""
+    flat = buf.reshape(-1)
+    leaves = []
+    for shape, dt, row_off in zip(meta.shapes, meta.dtypes,
+                                  meta.row_offsets):
+        size = int(np.prod(shape))
+        if size == 0:
+            # a zero-size leaf occupies no rows; slicing even one element
+            # would step past a buffer that may itself be empty
+            leaves.append(jnp.zeros(shape, dt))
+            continue
+        chunk = jax.lax.dynamic_slice_in_dim(flat, row_off * meta.chunk,
+                                             size)
+        leaves.append(jnp.asarray(chunk.reshape(shape), dt))
+    return jax.tree_util.tree_unflatten(meta.treedef, leaves)
+
+
+def chunked_per_leaf_sumsq(buf: jnp.ndarray, meta: _ChunkMeta) -> jnp.ndarray:
+    """Per-tensor sum-of-squares over a chunked buffer in two stages:
+    row-reduce ``(rows, chunk) -> (rows,)`` then ``segment_sum`` the row
+    partials by leaf — the ``multi_tensor_l2norm`` ``per_tensor=True``
+    output (``csrc/multi_tensor_l2norm_kernel.cu:480-560``) computed with
+    one large kernel instead of one small reduction per tensor.  Padding
+    rows contribute exactly zero.  Returns fp32 ``(n_leaves,)``."""
+    row_sq = jnp.sum(jnp.square(buf.astype(jnp.float32)), axis=1)
+    return jax.ops.segment_sum(
+        row_sq, jnp.asarray(meta.leaf_ids),
+        num_segments=len(meta.shapes))
 
 
 def per_leaf_l2_norms(tree) -> List[jnp.ndarray]:

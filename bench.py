@@ -712,6 +712,24 @@ def bench_tp_gpt(jax, on_tpu):
         parallel.mesh.destroy_model_parallel()
 
 
+def _make_synth_jpeg_tree(root, n_classes: int, per_class: int,
+                          side: int) -> None:
+    """Deterministic synthetic ImageFolder tree (RandomState(0), quality
+    90) — shared by bench_input_pipeline and bench_real_data_rn50 so the
+    two measurements stay apples-to-apples."""
+    import numpy as np
+    from PIL import Image
+
+    rng = np.random.RandomState(0)
+    for c in range(n_classes):
+        d = os.path.join(root, f"class_{c}")
+        os.makedirs(d, exist_ok=True)
+        for i in range(per_class):
+            arr = rng.randint(0, 256, (side, side, 3), dtype=np.uint8)
+            Image.fromarray(arr).save(
+                os.path.join(d, f"{i}.jpg"), quality=90)
+
+
 def bench_input_pipeline(jax, on_tpu):
     """Host input-pipeline throughput: images decoded+augmented per second
     by ``ImageFolderLoader`` over a synthetic JPEG ImageFolder tree — the
@@ -748,14 +766,7 @@ def bench_input_pipeline(jax, on_tpu):
         rate_src = "BASELINE.json adopted (no stamped TPU record)"
     root = tempfile.mkdtemp(prefix="bench_jpegs_")
     try:
-        rng = np.random.RandomState(0)
-        for c in range(n_classes):
-            d = os.path.join(root, f"class_{c}")
-            os.makedirs(d)
-            for i in range(per_class):
-                arr = rng.randint(0, 256, (side, side, 3), dtype=np.uint8)
-                Image.fromarray(arr).save(
-                    os.path.join(d, f"{i}.jpg"), quality=90)
+        _make_synth_jpeg_tree(root, n_classes, per_class, side)
 
         batch = 256 if on_tpu else 128  # >= 4 batches per epoch either way
         # effective quota, not raw core count (matches the host_cpus field)
@@ -856,6 +867,60 @@ def _native_decode_available() -> bool:
         return False
 
 
+def bench_real_data_rn50(jax, on_tpu):
+    """End-to-end REAL-DATA training throughput (VERDICT r4 missing #2):
+    real JPEG files -> one-time pack -> ``PackedLoader`` host gather ->
+    H2D prefetch -> jitted O2 train step with on-device crop/flip — the
+    composition of the input_pipeline row (host side) with the
+    resnet50_o2 row (device side), which had only ever been measured
+    separately.  The reference capability is the flagship recipe's
+    worker/prefetch loop feeding main_amp's step
+    (``examples/imagenet/main_amp.py:207-232``).
+
+    Drives ``examples/imagenet_amp.py`` itself (the user-facing recipe,
+    not a bench-only path).  The JPEG tree and packed shard are cached
+    under /tmp across runs, so only the first run pays dataset setup."""
+    import sys as _sys
+
+    examples_dir = os.path.join(_REPO, "examples")
+    if examples_dir not in _sys.path:
+        _sys.path.insert(0, examples_dir)
+    import imagenet_amp
+
+    n_classes, per_class = (8, 256) if on_tpu else (4, 16)
+    batch, steps = (128, 200) if on_tpu else (16, 4)
+    side = 300
+    cache = os.path.join("/tmp", "apex_tpu_bench_data",
+                         f"synth_{n_classes}x{per_class}_{side}")
+    done_marker = os.path.join(cache, ".complete")
+    if not os.path.exists(done_marker):
+        _make_synth_jpeg_tree(os.path.join(cache, "train"),
+                              n_classes, per_class, side)
+        with open(done_marker, "w") as f:
+            f.write("ok")
+    eff_cpus = (len(os.sched_getaffinity(0))
+                if hasattr(os, "sched_getaffinity")
+                else (os.cpu_count() or 8))
+    ips = imagenet_amp.main([
+        "--data", cache,
+        "--packed", os.path.join(cache, "pack"),
+        "--batch-size", str(batch),
+        "--num-classes", str(n_classes),
+        "--steps", str(steps),
+        "--workers", str(min(32, eff_cpus)),
+    ])
+    return {
+        "value": round(ips, 1),
+        "unit": "images/sec/chip",
+        "batch_per_chip": batch,
+        "steps": steps,
+        "image_size": 224,
+        "n_images": n_classes * per_class,
+        "data_path": "jpeg->packed-shard->PackedLoader->H2D prefetch",
+        "host_cpus": eff_cpus,
+    }
+
+
 def bench_fused_adam_step(jax, on_tpu):
     """Optimizer step-time microbench: FusedAdam over a resnet-sized tree
     vs the native-JAX baseline (optax.adamw) — the BASELINE
@@ -941,6 +1006,7 @@ BENCHES = {
     "tp_gpt": bench_tp_gpt,
     "fused_adam_step": bench_fused_adam_step,
     "input_pipeline": bench_input_pipeline,
+    "real_data_rn50": bench_real_data_rn50,
     # Diagnostic-only combos (run via ``--one``, not in BENCH_ORDER):
     # isolate which factor of the lamb+syncbn row costs what — the r4
     # first window measured resnet50_o2 (sgd, plain BN, pjit) 3.4x faster
@@ -951,14 +1017,16 @@ BENCHES = {
         jax, on_tpu, "lamb"),
 }
 # headline first: if the deadline hits, the most important number exists.
+# Then the r4-VERDICT capture priorities: fused_adam_step (North-Star #2,
+# never yet measured on hardware) ahead of the fp8/long-context rows.
 # tp_gpt deliberately LAST: its r2/r3 mode of failure was a 900 s setup
 # hang, and running it mid-suite starved every config behind it of TPU
 # window (observed r4 first pass: fp8/long-context/input-pipeline all fell
 # back to CPU because tp_gpt ate 900 s + the retry).
 BENCH_ORDER = ["resnet50_o2", "gpt_flash", "bert_large",
-               "resnet50_lamb_syncbn", "gpt_flash_fp8",
-               "gpt_long_context", "input_pipeline", "fused_adam_step",
-               "tp_gpt"]
+               "resnet50_lamb_syncbn", "fused_adam_step",
+               "gpt_flash_fp8", "gpt_long_context", "input_pipeline",
+               "real_data_rn50", "tp_gpt"]
 
 
 def run_one(name: str) -> None:
@@ -1160,6 +1228,15 @@ def build_record(results, platform) -> dict:
             and bf16.get("value")):
         record["extras"]["gpt_flash_fp8"] = dict(
             fp8, vs_bf16=round(fp8["value"] / bf16["value"], 3))
+    # Real-data vs synthetic RN50: how much of the device rate survives
+    # feeding the step from actual files (1.0 = the input path costs
+    # nothing; VERDICT r4 missing #2 asks for this composition).
+    real = results.get("real_data_rn50", {})
+    if ("error" not in real and ok and real.get("value")
+            and headline.get("platform") == real.get("platform")
+            and headline.get("value")):
+        record["extras"]["real_data_rn50"] = dict(
+            real, vs_synthetic=round(real["value"] / headline["value"], 3))
     if not headline_on_tpu:
         prior = _newest_prior_tpu_record()
         if prior is not None:
@@ -1170,18 +1247,79 @@ def build_record(results, platform) -> dict:
     return record
 
 
+def compact_record(record, max_bytes: int = 1500) -> dict:
+    """Distill a full record into a line guaranteed to fit the driver's
+    2000-byte stdout tail (round-4 postmortem: the full record line grew to
+    ~2.9 KB once the prior TPU evidence was embedded, so the tail's last
+    line started mid-JSON and BENCH_r0{1..4} were all ``parsed: null``).
+
+    Keeps the driver-contract header plus per-row {value, unit, mfu,
+    platform} — provenance prose stays in the full line and in
+    ``bench_results/``.  Degrades further (drop units, then rows) if a
+    future record still exceeds ``max_bytes``; never returns an oversized
+    payload."""
+    row_keys = ("value", "unit", "mfu", "platform", "vs_native", "vs_bf16",
+                "vs_synthetic")
+    rows = {}
+    for name, row in list(record.get("extras", {}).items()):
+        if not isinstance(row, dict):
+            continue
+        slim = {k: row[k] for k in row_keys if row.get(k) is not None}
+        if "error" in row:
+            slim["error"] = str(row["error"])[:48]
+        rows[name] = slim
+    compact = {
+        "metric": record["metric"],
+        "value": record["value"],
+        "unit": record["unit"],
+        "vs_baseline": record.get("vs_baseline"),
+        "platform": record.get("platform"),
+        "rows": rows,
+    }
+    if "vs_baseline_source" in record:
+        compact["vs_baseline_source"] = record["vs_baseline_source"]
+    prior = record.get("prior_tpu_record")
+    if isinstance(prior, dict) and "path" in prior:
+        compact["prior_tpu_record_path"] = prior["path"]
+    size = lambda: len(json.dumps(compact, separators=(",", ":")))
+    if size() > max_bytes:
+        for slim in rows.values():
+            slim.pop("unit", None)
+    if size() > max_bytes:
+        compact["rows"] = {n: s.get("value") for n, s in rows.items()}
+    if size() > max_bytes:
+        compact.pop("rows", None)
+    return compact
+
+
 def emit_record(results, platform) -> dict:
-    """Print the current record as one stdout JSON line (the driver keeps
-    the tail and parses the *last* JSON line, so each emission supersedes
-    the previous one — a kill at any instant leaves the newest evidence
-    behind), and stamp it to bench_results/ when the headline is TPU."""
+    """Print the current record as a full stdout JSON line followed by a
+    compact (<=1500-byte) one.  The driver keeps only the last 2000 bytes
+    of stdout and parses the *last* JSON line, so the compact line — always
+    printed last, always under the tail size — is what it sees; the full
+    line and the bench_results/ stamp carry the provenance detail.  Each
+    emission supersedes the previous one, so a kill at any instant leaves
+    the newest evidence behind.  Stamps to bench_results/ when the
+    headline is TPU."""
     record = build_record(results, platform)
     if record["headline"].get("platform") == "tpu":
         # Only a record whose *headline* ran on TPU is worth embedding in a
         # later round as TPU evidence — a CPU headline with one stray TPU
         # extra must not masquerade as a TPU run.
         _save_tpu_record(record)
+    try:
+        # Full record always lands on disk too (not only on TPU days), so
+        # a truncated stdout tail never loses provenance.
+        path = os.path.join(_REPO, "bench_results", "latest_record.json")
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path + ".tmp", "w") as f:
+            json.dump(record, f)
+        os.replace(path + ".tmp", path)
+    except Exception as e:
+        _log(f"could not save latest record: {e!r}")
     print(json.dumps(record), flush=True)
+    print(json.dumps(compact_record(record), separators=(",", ":")),
+          flush=True)
     return record
 
 

@@ -86,6 +86,11 @@ def main():
     p.add_argument("--seq", type=int, default=1024)
     p.add_argument("--steps", type=int, default=10)
     p.add_argument("--timeout", type=float, default=600.0)
+    p.add_argument("--no-land", action="store_true",
+                   help="exploratory sweep: never write "
+                        "bench_results/gpt_batch_tuned.json (by default a "
+                        "TPU sweep at seq 1024 with >1 surviving point "
+                        "auto-lands its winner as the bench default)")
     args = p.parse_args()
 
     if os.environ.get("JAX_PLATFORMS", "").lower() == "cpu":
@@ -129,7 +134,7 @@ def main():
         # itself as recorded provenance.  Gated on >1 *successful* point:
         # a lone survivor (others wedged/OOMed) is no comparison.
         if (best["platform"] == "tpu" and args.seq == 1024
-                and len(records) > 1):
+                and len(records) > 1 and not args.no_land):
             tuned = os.path.join(REPO, "bench_results",
                                  "gpt_batch_tuned.json")
             with open(tuned, "w") as f:

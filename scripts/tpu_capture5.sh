@@ -1,0 +1,68 @@
+#!/bin/bash
+# TPU evidence capture, round 5 — the VERDICT r4 "Next round" queue:
+#
+#   1. bench.py full 10-row matrix  (8 h internal poller + wedge-pause;
+#      re-benches the flagship 4 post-dtype-fix, captures the 5 CPU-only
+#      rows, runs the new real_data_rn50 end-to-end row, refreshes the
+#      stale input_pipeline row with packed fields; fused_adam_step now
+#      runs 5th, tp_gpt still last; every emission ends with the compact
+#      <=1500-byte record line the driver tail can parse)
+#   2. lamb-vs-syncbn A/B           (--one diagnostics; FusedLAMB now
+#      runs the chunked flat-buffer update — the A/B shows what remains)
+#   3. GPT batch sweep              (auto-lands gpt_batch_tuned.json)
+#   4. flash block sweep seq 1024   (auto-lands tuned blocks)
+#   5. GPT step profile             (if MFU still < 0.5, the trace)
+#   6. RN50 lamb+syncbn profile
+#   7. remat_ticks memory on chip   (overwrite the CPU-platform record)
+#   8. pipeline tick anchor
+#   9. flash block sweep seq 8192   (stretch: biggest dtype-fix lift)
+#  10. re-bench                     (picks up tuned configs = second
+#      stamped window for variance)
+#
+# Every non-bench stage gates on a live-chip probe: a wedge costs
+# probe-time, not stage budget.  Evidence lands incrementally.
+set -u
+cd "$(dirname "$0")/.."
+LOG=.tpu_watch/capture5.log
+mkdir -p .tpu_watch bench_results
+stamp() { date +%H:%M:%S; }
+log() { echo "== $(stamp) $*" >> "$LOG"; }
+probe() {
+  timeout 90 python -c \
+    "import jax; assert jax.devices()[0].platform == 'tpu'" \
+    >/dev/null 2>&1
+}
+wait_for_chip() {
+  until probe; do log "chip down; re-probing in 120s"; sleep 120; done
+  log "chip up"
+}
+run() {
+  log "start: $*"
+  timeout "${STAGE_TIMEOUT:-2400}" "$@" >> "$LOG" 2>&1
+  log "rc=$? ($1 $2)"
+}
+
+log "capture5 start"
+STAGE_TIMEOUT=29200 BENCH_DEADLINE_S=28800 run python bench.py
+
+wait_for_chip
+STAGE_TIMEOUT=600 run python bench.py --one resnet50_sgd_syncbn
+wait_for_chip
+STAGE_TIMEOUT=600 run python bench.py --one resnet50_lamb_nosync
+wait_for_chip
+run python examples/tune_gpt_batch.py
+wait_for_chip
+run python examples/tune_flash_blocks.py --seq 1024 --timeout 600
+wait_for_chip
+STAGE_TIMEOUT=1200 run python examples/profile_gpt.py
+wait_for_chip
+STAGE_TIMEOUT=1200 run python examples/profile_resnet.py --optimizer lamb --sync-bn
+wait_for_chip
+STAGE_TIMEOUT=1200 run python examples/measure_remat_memory.py
+wait_for_chip
+STAGE_TIMEOUT=1200 run python examples/measure_pipeline_tick.py
+wait_for_chip
+run python examples/tune_flash_blocks.py --seq 8192 --steps 5 --timeout 600
+wait_for_chip
+BENCH_DEADLINE_S=2100 run python bench.py
+log "capture5 done"

@@ -1,0 +1,108 @@
+"""The driver-record contract (VERDICT r4 item 1).
+
+The driver keeps only the last 2000 bytes of bench stdout and parses the
+last JSON line.  Rounds 1-4 all delivered ``parsed: null`` because the
+full record line grew past the tail size.  These tests pin the fix: every
+emission ends with a compact line that (a) is <= 1500 bytes, (b) parses,
+(c) carries the driver contract fields, and (d) survives a simulated
+2000-byte tail even in the worst case (all nine rows verbose + embedded
+prior TPU evidence).
+"""
+
+import io
+import json
+import sys
+
+import pytest
+
+sys.path.insert(0, __file__.rsplit("/tests/", 1)[0])
+import bench  # noqa: E402
+
+
+def _worst_case_results():
+    """Nine rows, each fattened with prose fields, like a CPU-fallback day."""
+    rows = {
+        "resnet50_o2": {"value": 8824.6, "unit": "images/sec/chip"},
+        "gpt_flash": {"value": 95167.3, "unit": "tokens/sec/chip",
+                      "mfu": 0.4155},
+        "bert_large": {"value": 45956.4, "unit": "tokens/sec/chip",
+                       "mfu": 0.5059},
+        "resnet50_lamb_syncbn": {"value": 2566.8,
+                                 "unit": "images/sec/chip"},
+        "tp_gpt": {"value": 761.9, "unit": "tokens/sec"},
+        "fused_adam_step": {"value": 4777.5, "unit": "us/step",
+                            "vs_native": 0.706},
+        "gpt_flash_fp8": {"value": 4112.3, "unit": "tokens/sec/chip"},
+        "gpt_long_context": {"value": 2580.7, "unit": "tokens/sec/chip"},
+        "input_pipeline": {"value": 9685.0, "unit": "images/sec"},
+    }
+    for r in rows.values():
+        r["platform"] = "cpu"
+        r["measured"] = "provenance prose " * 12   # ~200 bytes each
+    return rows
+
+
+def _tail_parse(stdout_text, tail_bytes=2000):
+    """The driver's behavior: last JSON line of the last N bytes."""
+    tail = stdout_text[-tail_bytes:]
+    for line in reversed(tail.splitlines()):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            return json.loads(line)
+        except json.JSONDecodeError:
+            return None   # last line decapitated -> the r1-r4 failure mode
+    return None
+
+
+def test_compact_record_under_1500_bytes():
+    record = bench.build_record(_worst_case_results(), "cpu")
+    compact = bench.compact_record(record)
+    encoded = json.dumps(compact, separators=(",", ":"))
+    assert len(encoded) <= 1500, len(encoded)
+    for key in ("metric", "value", "unit", "vs_baseline", "platform"):
+        assert key in compact
+    assert compact["metric"] == "resnet50_o2_train_throughput"
+    # Per-row essentials survive the distillation.
+    assert compact["rows"]["gpt_flash"]["mfu"] == 0.4155
+    assert compact["rows"]["fused_adam_step"]["vs_native"] == 0.706
+
+
+def test_compact_record_degrades_instead_of_overflowing():
+    results = _worst_case_results()
+    # Pathological: 40 extra rows with long names.
+    for i in range(40):
+        results[f"synthetic_extra_row_with_a_long_name_{i:02d}"] = {
+            "value": float(i), "unit": "widgets/sec", "platform": "cpu"}
+    record = bench.build_record(results, "cpu")
+    compact = bench.compact_record(record)
+    assert len(json.dumps(compact, separators=(",", ":"))) <= 1500
+    assert compact["metric"] == "resnet50_o2_train_throughput"
+
+
+def test_emission_survives_driver_tail(monkeypatch, tmp_path):
+    monkeypatch.setattr(bench, "_REPO", str(tmp_path))  # sandbox the stamps
+    buf = io.StringIO()
+    monkeypatch.setattr(sys, "stdout", buf)
+    bench.emit_record(_worst_case_results(), "cpu")
+    parsed = _tail_parse(buf.getvalue())
+    assert parsed is not None, "last JSON line of the 2000-byte tail " \
+                               "must parse (BENCH parsed:null regression)"
+    assert parsed["metric"] == "resnet50_o2_train_throughput"
+    assert parsed["value"] == 8824.6
+    # Full provenance landed on disk even though the stdout tail is short.
+    latest = json.load(open(tmp_path / "bench_results" /
+                            "latest_record.json"))
+    assert "measured" in latest["headline"]
+
+
+def test_unrun_rows_still_emit_parseable_record(monkeypatch, tmp_path):
+    """Day-zero emission (empty results) must already satisfy the tail."""
+    monkeypatch.setattr(bench, "_REPO", str(tmp_path))
+    buf = io.StringIO()
+    monkeypatch.setattr(sys, "stdout", buf)
+    bench.emit_record({}, "cpu")
+    parsed = _tail_parse(buf.getvalue())
+    assert parsed is not None
+    assert parsed["value"] == 0.0

@@ -226,3 +226,30 @@ def test_pack_rejects_empty_and_bad_version(tmp_path):
         json.dump({"n": 1, "side": 8, "classes": [], "version": 99}, f)
     with pytest.raises(ValueError, match="version"):
         PackedImageDataset(prefix)
+
+
+def test_second_live_iterator_preempts_first(packed):
+    """Shared samplers support ONE live iteration: starting a second
+    tears down the first (rewinding its undelivered prefetch) instead of
+    letting two producers double-advance consumed_samples with duplicate
+    index streams (r4 ADVICE packed.py:283)."""
+    _, ds = packed
+    loader = PackedLoader(ds, local_batch=4, prefetch=2)
+    it1 = iter(loader)
+    next(it1)
+    it2 = iter(loader)  # preempts it1
+    b2 = next(it2)
+    # b2 is exactly the batch after it1's, same epoch — nothing was
+    # skipped or duplicated by the abandoned prefetch
+    with PackedLoader(ds, local_batch=4) as ref:
+        rit = iter(ref)
+        next(rit)
+        expect = next(rit)
+    np.testing.assert_array_equal(b2[0], expect[0])
+    # the preempted iterator terminates instead of blocking forever
+    assert list(it1) == []
+    for _ in it2:
+        pass
+    it2.close()
+    assert loader.consumed_samples % 4 == 0
+    loader.close()
