@@ -140,6 +140,33 @@ def tree_map_multi(fn: Callable, n_out: int, *trees) -> Tuple[Pytree, ...]:
     )
 
 
+def tree_map_flat(fn: Callable, n_out: int, *trees) -> Tuple[Pytree, ...]:
+    """Like :func:`tree_map_multi` for a purely **elementwise** ``fn``,
+    but applied once over one chunked ``(rows, 256)`` buffer per tree —
+    the ``multi_tensor_apply`` list-kernel shape (one wide kernel per op
+    instead of one small kernel per tensor; ``csrc/multi_tensor_apply.cuh``).
+    Elementwise means no reductions, so the result matches the per-leaf
+    map to compiler instruction-fusion (fma) noise, ~1 ulp; outputs take
+    the FIRST tree's structure/dtypes (inputs are cast to its fp32
+    workspace).  For updates that also need
+    per-tensor reductions, see ``FusedLAMB._flat_update``."""
+    from apex_tpu.utils.tree import (
+        flatten_to_chunked,
+        unflatten_from_chunked,
+    )
+
+    bufs, meta = [], None
+    for t in trees:
+        b, m = flatten_to_chunked(t)
+        if meta is None:
+            meta = m
+        bufs.append(b)
+    outs = fn(*bufs)
+    if n_out == 1:
+        outs = (outs,)
+    return tuple(unflatten_from_chunked(o, meta) for o in outs)
+
+
 class OptState(NamedTuple):
     """Generic optimizer state: a step counter, named slot trees, and the
     optional fp32 master params."""

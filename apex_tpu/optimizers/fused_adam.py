@@ -37,6 +37,7 @@ from apex_tpu.optimizers._common import (
     resolve_master,
     scale_grads,
     tree_f32,
+    tree_map_flat,
     tree_map_multi,
     tree_zeros_f32,
 )
@@ -58,6 +59,7 @@ class FusedAdam:
         weight_decay: float = 0.0,
         amsgrad: bool = False,
         master_weights: bool = False,
+        flat: bool = False,
     ):
         if amsgrad:
             raise RuntimeError(
@@ -71,6 +73,12 @@ class FusedAdam:
         self.adam_w_mode = adam_w_mode
         self.weight_decay = weight_decay
         self.master_weights = master_weights
+        # flat=True applies the purely elementwise update over one chunked
+        # buffer instead of per-leaf (equal to ~1 ulp of fma contraction) — one wide
+        # kernel per op vs one small kernel per tensor, at the cost of a
+        # pack/unpack copy.  Which side wins depends on how fragmented
+        # the tree is; bench_fused_adam_step measures both.
+        self.flat = flat
 
     def init(self, params) -> OptState:
         return OptState(
@@ -115,7 +123,8 @@ class FusedAdam:
                 update = update + wd * p  # ADAM_MODE_1: decoupled decay
             return p - lr * update, m, v
 
-        new_p32, new_m, new_v = tree_map_multi(
+        tmap = tree_map_flat if self.flat else tree_map_multi
+        new_p32, new_m, new_v = tmap(
             leaf, 3, p32, g, state.slots["exp_avg"], state.slots["exp_avg_sq"]
         )
 

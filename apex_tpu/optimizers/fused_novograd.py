@@ -37,6 +37,12 @@ from apex_tpu.optimizers._common import (
     tree_map_multi,
     tree_zeros_f32,
 )
+from apex_tpu.utils.tree import (
+    chunked_per_leaf_max_abs,
+    chunked_per_leaf_sumsq,
+    flatten_to_chunked,
+    unflatten_from_chunked,
+)
 
 __all__ = ["FusedNovoGrad"]
 
@@ -55,6 +61,7 @@ class FusedNovoGrad:
         norm_type: int = 2,
         init_zero: bool = False,
         master_weights: bool = False,
+        flat: bool = True,
     ):
         if amsgrad:
             raise RuntimeError(
@@ -76,6 +83,11 @@ class FusedNovoGrad:
         self.norm_type = norm_type
         self.init_zero = init_zero
         self.master_weights = master_weights
+        # flat=True: one chunked-buffer pass with segmented per-tensor
+        # grad norms (multi_tensor_novograd's list-kernel shape) instead
+        # of one small norm reduction per tensor; flat=False keeps the
+        # per-leaf form for A/B.
+        self.flat = flat
 
     def _leaf_norm(self, g):
         if self.norm_type == 0:
@@ -143,9 +155,53 @@ class FusedNovoGrad:
                     update = update + wd * p
             return p - lr * update, m, gn
 
-        new_p32, new_m, new_gn = tree_map_multi(
-            leaf, 3, p32, g, state.slots["exp_avg"], state.slots["exp_avg_sq"]
-        )
+        def flat():
+            # Same math with the norm pass vectorized: per-tensor grad
+            # norms land as an (n_leaves,) vector via one segmented
+            # reduction (multi_tensor_novograd's norm launch), the norm
+            # state stays a scalar-leaf tree, and the elementwise work
+            # runs over one chunked buffer.
+            gn_leaves = jax.tree_util.tree_leaves(state.slots["exp_avg_sq"])
+            gn_vec = (jnp.stack([f32(x) for x in gn_leaves])
+                      if gn_leaves else jnp.zeros((0,), jnp.float32))
+            pb, meta = flatten_to_chunked(p32)
+            gb, _ = flatten_to_chunked(g)
+            mb, _ = flatten_to_chunked(m_tree)
+            if self.norm_type == 0:
+                n = chunked_per_leaf_max_abs(gb, meta)
+                gn_new = jnp.where(gn_vec < 0, n, gn_vec)
+                gn_new = b2 * gn_new + (1.0 - b2) * n
+            else:
+                n = jnp.sqrt(chunked_per_leaf_sumsq(gb, meta))
+                gn_new = jnp.where(gn_vec < 0, n, gn_vec)
+                gn_new = jnp.sqrt(b2 * gn_new * gn_new
+                                  + (1.0 - b2) * n * n)
+            denom = (gn_new / bc2 + eps)[jnp.asarray(meta.leaf_ids)][:, None]
+            if self.moment_mode == 0:
+                g2 = gb / denom
+                if wd != 0.0:
+                    g2 = g2 + wd * pb
+                mb_new = b1 * mb + beta3 * g2
+                update = mb_new / bc1
+            else:
+                mb_new = b1 * mb + beta3 * gb
+                update = (mb_new / bc1) / denom
+                if wd != 0.0:
+                    update = update + wd * pb
+            pb_new = pb - lr * update
+            gn_tree = jax.tree_util.tree_unflatten(
+                meta.treedef, [gn_new[i] for i in range(len(gn_leaves))])
+            return (unflatten_from_chunked(pb_new, meta),
+                    unflatten_from_chunked(mb_new, meta),
+                    gn_tree)
+
+        m_tree = state.slots["exp_avg"]
+        if self.flat:
+            new_p32, new_m, new_gn = flat()
+        else:
+            new_p32, new_m, new_gn = tree_map_multi(
+                leaf, 3, p32, g, m_tree, state.slots["exp_avg_sq"]
+            )
         new_p32 = apply_skip(skip_update, new_p32, p32)
         new_m = apply_skip(skip_update, new_m, state.slots["exp_avg"])
         new_gn = apply_skip(skip_update, new_gn, state.slots["exp_avg_sq"])

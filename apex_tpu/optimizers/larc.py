@@ -26,6 +26,11 @@ import jax
 import jax.numpy as jnp
 
 from apex_tpu.optimizers._common import f32, tree_map_multi
+from apex_tpu.utils.tree import (
+    chunked_per_leaf_sumsq,
+    flatten_to_chunked,
+    unflatten_from_chunked,
+)
 
 __all__ = ["LARC"]
 
@@ -38,11 +43,17 @@ class LARC:
         clip: bool = True,
         eps: float = 1e-8,
         weight_decay: float = 0.0,
+        flat: bool = True,
     ):
         self.optim = optimizer
         self.trust_coefficient = trust_coefficient
         self.clip = clip
         self.eps = eps
+        # flat=True computes all per-tensor ||p||/||g|| pairs with one
+        # segmented reduction over a chunked buffer instead of two small
+        # reductions per tensor (the multi_tensor_l2norm shape);
+        # flat=False keeps the per-leaf form for A/B.
+        self.flat = flat
         # reference absorbs wd from the wrapped optimizer (LARC.py:81-85);
         # here the inner optimizer must be built with weight_decay=0 and the
         # decay given to LARC directly.
@@ -55,6 +66,26 @@ class LARC:
         """Scale each grad leaf by its LARC adaptive rate (LARC.py:92-102)."""
         lr = f32(lr)
         wd, eps, tc = self.weight_decay, self.eps, self.trust_coefficient
+
+        if self.flat:
+            pb, meta = flatten_to_chunked(params)
+            gb, _ = flatten_to_chunked(grads)
+            p_norm = jnp.sqrt(chunked_per_leaf_sumsq(pb, meta))
+            g_norm = jnp.sqrt(chunked_per_leaf_sumsq(gb, meta))
+            adaptive = tc * p_norm / (g_norm + p_norm * wd + eps)
+            if self.clip:
+                adaptive = jnp.minimum(adaptive / lr, 1.0)
+            # when either norm is zero the reference leaves the grad
+            # untouched (no wd either), LARC.py:92
+            keep = (p_norm != 0) & (g_norm != 0)
+            ids = jnp.asarray(meta.leaf_ids)
+            out = jnp.where(keep[ids][:, None],
+                            (gb + wd * pb) * adaptive[ids][:, None], gb)
+            # the per-leaf form returns fp32 grads whatever the input
+            # dtype (the math runs in the fp32 workspace); match it
+            f32_meta = meta._replace(
+                dtypes=tuple(jnp.float32 for _ in meta.dtypes))
+            return unflatten_from_chunked(out, f32_meta)
 
         def leaf(g, p):
             g0 = jnp.asarray(g, jnp.float32)

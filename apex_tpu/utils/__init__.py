@@ -6,6 +6,7 @@ from apex_tpu.utils.random import (  # noqa: F401
     fold_in_axis,
 )
 from apex_tpu.utils.tree import (  # noqa: F401
+    chunked_per_leaf_max_abs,
     chunked_per_leaf_sumsq,
     flatten_to_buffer,
     flatten_to_chunked,

@@ -25,6 +25,7 @@ __all__ = [
     "unflatten_from_buffer",
     "flatten_to_chunked",
     "unflatten_from_chunked",
+    "chunked_per_leaf_max_abs",
     "chunked_per_leaf_sumsq",
     "tree_l2_norm",
     "per_leaf_l2_norms",
@@ -177,6 +178,22 @@ def chunked_per_leaf_sumsq(buf: jnp.ndarray, meta: _ChunkMeta) -> jnp.ndarray:
     return jax.ops.segment_sum(
         row_sq, jnp.asarray(meta.leaf_ids),
         num_segments=len(meta.shapes))
+
+
+def chunked_per_leaf_max_abs(buf: jnp.ndarray, meta: _ChunkMeta
+                             ) -> jnp.ndarray:
+    """Per-tensor Linf norm over a chunked buffer (row-reduce max|x| then
+    ``segment_max`` — the ``multi_tensor_l2norm_kernel`` Linf mode).
+    Padding zeros can only lower nothing: max|x| >= 0 exactly like the
+    unpadded leaf (and a zero-size leaf reports 0).  Returns fp32
+    ``(n_leaves,)``."""
+    row_max = jnp.max(jnp.abs(buf.astype(jnp.float32)), axis=1)
+    out = jax.ops.segment_max(
+        row_max, jnp.asarray(meta.leaf_ids),
+        num_segments=len(meta.shapes))
+    # segment_max fills empty segments with -inf; zero-size leaves have no
+    # rows, and |x| >= 0 everywhere, so clamp to 0
+    return jnp.maximum(out, 0.0)
 
 
 def per_leaf_l2_norms(tree) -> List[jnp.ndarray]:

@@ -71,6 +71,22 @@ class TestFusedAdam:
         for k in params:
             np.testing.assert_allclose(ours[k], ref[k], rtol=1e-5, atol=1e-6)
 
+    def test_flat_matches_per_leaf(self):
+        """flat=True routes the same elementwise update through one
+        chunked buffer (tree_map_flat) — no reductions, so the two can
+        differ only by compiler instruction fusion (fma contraction),
+        i.e. ~1 ulp."""
+        params, grads = _make_problem(3)
+        for wd, mode in [(0.1, True), (0.1, False), (0.0, True)]:
+            a = _run_ours(FusedAdam(lr=1e-2, weight_decay=wd,
+                                    adam_w_mode=mode, flat=False),
+                          params, grads)
+            b = _run_ours(FusedAdam(lr=1e-2, weight_decay=wd,
+                                    adam_w_mode=mode, flat=True),
+                          params, grads)
+            for k in params:
+                np.testing.assert_allclose(a[k], b[k], rtol=1e-6, atol=1e-7)
+
     @pytest.mark.parametrize("wd", [0.0, 0.1])
     def test_adam_l2_vs_torch(self, wd):
         params, grads = _make_problem(1)
@@ -308,6 +324,22 @@ class TestFusedLion:
 
 
 class TestFusedNovoGrad:
+    @pytest.mark.parametrize("norm_type", [0, 2])
+    @pytest.mark.parametrize("reg_inside", [False, True])
+    @pytest.mark.parametrize("init_zero", [False, True])
+    def test_flat_matches_per_leaf(self, norm_type, reg_inside, init_zero):
+        """The chunked-buffer form (segmented per-tensor grad norms)
+        matches the per-leaf form across both moment modes, both norm
+        types, and both norm-state inits."""
+        params, grads = _make_problem(13)
+        kw = dict(lr=1e-2, betas=(0.95, 0.98), eps=1e-8, weight_decay=0.01,
+                  reg_inside_moment=reg_inside, norm_type=norm_type,
+                  init_zero=init_zero)
+        a = _run_ours(FusedNovoGrad(flat=False, **kw), params, grads)
+        b = _run_ours(FusedNovoGrad(flat=True, **kw), params, grads)
+        for k in params:
+            np.testing.assert_allclose(a[k], b[k], rtol=1e-5, atol=1e-7)
+
     def test_vs_reference_impl(self):
         params, grads = _make_problem(12)
         lr, b1, b2, eps, wd = 1e-2, 0.95, 0.98, 1e-8, 0.01
@@ -344,6 +376,24 @@ class TestFusedNovoGrad:
 
 
 class TestLARC:
+    def test_flat_matches_per_leaf(self):
+        """One segmented-reduction pass == two small reductions per
+        tensor, including the zero-norm leave-alone branch."""
+        params, grads = _make_problem(15)
+        params["zero"] = np.zeros((4, 4), np.float32)  # keep branch
+        g0 = dict(grads[0])
+        g0["zero"] = np.ones((4, 4), np.float32)
+        jp = {k: jnp.asarray(v) for k, v in params.items()}
+        jg = {k: jnp.asarray(v) for k, v in g0.items()}
+        kw = dict(trust_coefficient=0.02, clip=True, eps=1e-8,
+                  weight_decay=0.01)
+        a = LARC(flat=False, **kw).transform_grads(jg, jp, lr=0.1)
+        b = LARC(flat=True, **kw).transform_grads(jg, jp, lr=0.1)
+        for k in jp:
+            assert jnp.asarray(b[k]).dtype == jnp.asarray(a[k]).dtype
+            np.testing.assert_allclose(np.asarray(a[k]), np.asarray(b[k]),
+                                       rtol=1e-6, atol=1e-8)
+
     def test_transform_matches_reference_formula(self):
         params, grads = _make_problem(14)
         lr, tc, wd, eps = 0.1, 0.02, 0.01, 1e-8
