@@ -35,6 +35,7 @@ from apex_tpu.optimizers._common import (
     tree_map_multi,
 )
 from apex_tpu.parallel.mesh import DATA_AXIS
+from apex_tpu.optimizers.fused_lamb import lamb_flat_update
 
 __all__ = ["DistributedFusedLAMB"]
 
@@ -55,6 +56,7 @@ class DistributedFusedLAMB:
         max_grad_norm: float = 1.0,
         use_nvlamb: bool = False,
         axis: str = DATA_AXIS,
+        flat: bool = True,
     ):
         self.lr = lr
         self.bias_correction = bias_correction
@@ -66,6 +68,11 @@ class DistributedFusedLAMB:
         self.max_grad_norm = max_grad_norm
         self.use_nvlamb = use_nvlamb
         self.axis = axis
+        # flat=True: the shard-local work runs over one chunked buffer
+        # (FusedLAMB's r5 rebuild) — wide elementwise kernels, segmented
+        # per-tensor norm partials, and still exactly ONE psum for all
+        # 2*n_leaves norm partials.  flat=False keeps the per-leaf form.
+        self.flat = flat
 
     def init(self, params) -> OptState:
         def shard_zero(p):
@@ -98,18 +105,6 @@ class DistributedFusedLAMB:
         )
         p32 = state.master
 
-        # Global grad norm: shard-local sum of squares + one psum
-        # (the reference's two-phase multi_tensor_l2norm + all_reduce,
-        # distributed_fused_lamb.py:728-811).
-        local_sq = sum(
-            jnp.sum(jnp.square(g)) for g in jax.tree_util.tree_leaves(g_shards)
-        )
-        global_norm = jnp.sqrt(cc.all_reduce(local_sq, axis))
-        if self.max_grad_norm and self.max_grad_norm > 0:
-            clip = jnp.maximum(global_norm / self.max_grad_norm, 1.0)
-        else:
-            clip = jnp.float32(1.0)
-
         beta3 = 1.0 - b1 if self.grad_averaging else 1.0
         if self.bias_correction:
             bc1 = 1.0 - b1 ** f32(t)
@@ -117,48 +112,19 @@ class DistributedFusedLAMB:
         else:
             bc1 = bc2 = jnp.float32(1.0)
 
-        # Stage 1 (multi_tensor_lamb.cu stage 1): moments + raw update.
-        def stage1(p, g, m, v):
-            g = g / clip
-            if wd != 0.0 and not self.adam_w_mode:
-                g = g + wd * p
-            m = b1 * m + beta3 * g
-            v = b2 * v + (1.0 - b2) * g * g
-            update = (m / bc1) / (jnp.sqrt(v / bc2) + eps)
-            if wd != 0.0 and self.adam_w_mode:
-                update = update + wd * p
-            return update, m, v
+        def clip_ratio(global_norm):
+            if self.max_grad_norm and self.max_grad_norm > 0:
+                return jnp.maximum(global_norm / self.max_grad_norm, 1.0)
+            return jnp.float32(1.0)
 
-        updates, new_m, new_v = tree_map_multi(
-            stage1, 3, p32, g_shards,
-            state.slots["exp_avg"], state.slots["exp_avg_sq"],
-        )
-
-        # Per-tensor norms: all leaves' shard partials stacked into ONE psum
-        # (the reference's single fused l2norm launch + one all-reduce,
-        # not 2*n_leaves scalar collectives).
-        p_leaves = jax.tree_util.tree_leaves(p32)
-        u_leaves, u_def = jax.tree_util.tree_flatten(updates)
-        partial = jnp.stack(
-            [jnp.sum(jnp.square(l)) for l in p_leaves]
-            + [jnp.sum(jnp.square(l)) for l in u_leaves]
-        )
-        norms = jnp.sqrt(cc.all_reduce(partial, axis))
-        w_norms = norms[: len(p_leaves)]
-        u_norms = norms[len(p_leaves):]
-
-        # Stage 2: trust-ratio application per leaf.
-        new_p_leaves = []
-        for i, (p, u) in enumerate(zip(p_leaves, u_leaves)):
-            if wd != 0.0 or self.use_nvlamb:
-                ratio = jnp.where(
-                    (w_norms[i] > 0) & (u_norms[i] > 0),
-                    w_norms[i] / u_norms[i], jnp.float32(1.0),
-                )
-            else:
-                ratio = jnp.float32(1.0)
-            new_p_leaves.append(p - lr * ratio * u)
-        new_p32 = jax.tree_util.tree_unflatten(u_def, new_p_leaves)
+        if self.flat:
+            new_p32, new_m, new_v = self._flat_update(
+                p32, g_shards, state.slots["exp_avg"],
+                state.slots["exp_avg_sq"], lr, clip_ratio, beta3, bc1, bc2)
+        else:
+            new_p32, new_m, new_v = self._per_leaf_update(
+                p32, g_shards, state.slots["exp_avg"],
+                state.slots["exp_avg_sq"], lr, clip_ratio, beta3, bc1, bc2)
         new_p32 = apply_skip(skip_update, new_p32, p32)
         new_m = apply_skip(skip_update, new_m, state.slots["exp_avg"])
         new_v = apply_skip(skip_update, new_v, state.slots["exp_avg_sq"])
@@ -174,3 +140,78 @@ class DistributedFusedLAMB:
             master=new_p32,
         )
         return new_params, new_state
+
+    def _flat_update(self, p32, g_shards, m, v, lr, clip_ratio, beta3,
+                     bc1, bc2):
+        """Shard-local LAMB over one chunked buffer — THE shared
+        :func:`lamb_flat_update` math with ``reduce=psum(dp)``: wide
+        elementwise kernels, the global-norm partial as one row-reduce,
+        ALL 2*n_leaves per-tensor norm partials via two segmented
+        reductions, and still exactly one norm psum per step (the
+        reference's one fused l2norm launch + one all-reduce,
+        ``distributed_fused_lamb.py:728-811``)."""
+        return lamb_flat_update(
+            p32, g_shards, m, v, lr=lr, b1=self.beta1, b2=self.beta2,
+            eps=self.eps, wd=self.weight_decay, beta3=beta3, bc1=bc1,
+            bc2=bc2, adam_w_mode=self.adam_w_mode,
+            use_nvlamb=self.use_nvlamb, clip_ratio=clip_ratio,
+            reduce=lambda x: cc.all_reduce(x, self.axis))
+
+    def _per_leaf_update(self, p32, g_shards, m, v, lr, clip_ratio, beta3,
+                         bc1, bc2):
+        axis = self.axis
+        b1, b2, eps, wd = self.beta1, self.beta2, self.eps, self.weight_decay
+
+        # Global grad norm: shard-local sum of squares + one psum
+        # (the reference's two-phase multi_tensor_l2norm + all_reduce,
+        # distributed_fused_lamb.py:728-811).
+        local_sq = sum(
+            jnp.sum(jnp.square(g))
+            for g in jax.tree_util.tree_leaves(g_shards)
+        )
+        clip = clip_ratio(jnp.sqrt(cc.all_reduce(local_sq, axis)))
+
+        # Stage 1 (multi_tensor_lamb.cu stage 1): moments + raw update.
+        def stage1(p, g, m, v):
+            g = g / clip
+            if wd != 0.0 and not self.adam_w_mode:
+                g = g + wd * p
+            m = b1 * m + beta3 * g
+            v = b2 * v + (1.0 - b2) * g * g
+            update = (m / bc1) / (jnp.sqrt(v / bc2) + eps)
+            if wd != 0.0 and self.adam_w_mode:
+                update = update + wd * p
+            return update, m, v
+
+        updates, new_m, new_v = tree_map_multi(stage1, 3, p32, g_shards,
+                                               m, v)
+
+        p_leaves = jax.tree_util.tree_leaves(p32)
+        u_leaves, u_def = jax.tree_util.tree_flatten(updates)
+        if wd != 0.0 or self.use_nvlamb:
+            # Per-tensor norms: all leaves' shard partials stacked into
+            # ONE psum (the reference's single fused l2norm launch + one
+            # all-reduce, not 2*n_leaves scalar collectives).  Statically
+            # skipped when every trust ratio is 1.0 (wd=0, no nvlamb).
+            partial = jnp.stack(
+                [jnp.sum(jnp.square(l)) for l in p_leaves]
+                + [jnp.sum(jnp.square(l)) for l in u_leaves]
+            )
+            norms = jnp.sqrt(cc.all_reduce(partial, axis))
+            w_norms = norms[: len(p_leaves)]
+            u_norms = norms[len(p_leaves):]
+
+            def ratio(i):
+                return jnp.where(
+                    (w_norms[i] > 0) & (u_norms[i] > 0),
+                    w_norms[i] / u_norms[i], jnp.float32(1.0),
+                )
+        else:
+            def ratio(i):
+                return jnp.float32(1.0)
+
+        # Stage 2: trust-ratio application per leaf.
+        new_p_leaves = [p - lr * ratio(i) * u
+                        for i, (p, u) in enumerate(zip(p_leaves, u_leaves))]
+        return (jax.tree_util.tree_unflatten(u_def, new_p_leaves),
+                new_m, new_v)

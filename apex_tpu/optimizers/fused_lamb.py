@@ -49,7 +49,65 @@ from apex_tpu.utils.tree import (
     unflatten_from_chunked,
 )
 
-__all__ = ["FusedLAMB", "FusedMixedPrecisionLamb"]
+__all__ = ["FusedLAMB", "FusedMixedPrecisionLamb", "lamb_flat_update"]
+
+
+def lamb_flat_update(p32, g, m, v, *, lr, b1, b2, eps, wd, beta3, bc1, bc2,
+                     adam_w_mode, use_nvlamb, clip_ratio, reduce=None):
+    """Both LAMB stages over one chunked buffer — THE flat LAMB math,
+    shared by :class:`FusedLAMB` (``reduce=None``) and the ZeRO-sharded
+    ``DistributedFusedLAMB`` (``reduce=psum`` over the dp axis, applied to
+    the shard-local global-norm partial and to the single stacked vector
+    of per-tensor norm partials, so the distributed form still issues
+    exactly one norm collective per step).
+
+    The elementwise pass is a handful of (rows, 256) kernels, and the
+    global grad norm and per-tensor trust-ratio norms are each ONE
+    row-reduce (+ a segment_sum over row partials for the per-tensor
+    ones) — the shape ``multi_tensor_lamb.cu:41,234`` gives the GPU (two
+    list-kernels), re-expressed as XLA-friendly wide ops (r4 VERDICT
+    weak #3: the per-leaf form was hundreds of small reductions).
+    Padding rows hold zeros, so every norm is exact; results round-trip
+    back to the original tree/dtypes, leaving state and checkpoint
+    layouts unchanged.  ``clip_ratio`` maps the (already cross-replica)
+    global grad norm to the clip divisor."""
+    pb, meta = flatten_to_chunked(p32)
+    gb, _ = flatten_to_chunked(g)
+    mb, _ = flatten_to_chunked(m)
+    vb, _ = flatten_to_chunked(v)
+
+    g_sq = jnp.sum(jnp.square(gb))
+    if reduce is not None:
+        g_sq = reduce(g_sq)
+    gb = gb / clip_ratio(jnp.sqrt(g_sq))
+    if wd != 0.0 and not adam_w_mode:
+        gb = gb + wd * pb  # MODE_0: L2 into the clipped grad
+    mb = b1 * mb + beta3 * gb
+    vb = b2 * vb + (1.0 - b2) * gb * gb
+    ub = (mb / bc1) / (jnp.sqrt(vb / bc2) + eps)
+    if wd != 0.0 and adam_w_mode:
+        ub = ub + wd * pb  # MODE_1: decoupled decay
+    if wd != 0.0 or use_nvlamb:
+        # stage 2: per-tensor trust ratios (multi_tensor_lamb.cu:245-270)
+        partial = jnp.concatenate([chunked_per_leaf_sumsq(pb, meta),
+                                   chunked_per_leaf_sumsq(ub, meta)])
+        if reduce is not None:
+            partial = reduce(partial)
+        n_leaves = len(meta.shapes)
+        w_sq, u_sq = partial[:n_leaves], partial[n_leaves:]
+        ratio_leaf = jnp.where(
+            (w_sq > 0) & (u_sq > 0),
+            jnp.sqrt(w_sq) / jnp.sqrt(jnp.where(u_sq > 0, u_sq, 1.0)),
+            1.0,
+        )
+        # per-tensor scalar -> per-row column: broadcast, not gather
+        ratio = ratio_leaf[jnp.asarray(meta.leaf_ids)][:, None]
+    else:
+        ratio = jnp.float32(1.0)
+    pb = pb - lr * ratio * ub
+    return (unflatten_from_chunked(pb, meta),
+            unflatten_from_chunked(mb, meta),
+            unflatten_from_chunked(vb, meta))
 
 
 class FusedLAMB:
@@ -145,47 +203,11 @@ class FusedLAMB:
         return jnp.float32(1.0)
 
     def _flat_update(self, p32, g, m, v, lr, beta3, bc1, bc2):
-        """Both LAMB stages over one chunked buffer: the elementwise pass
-        is a handful of (rows, 256) kernels, and the global grad norm and
-        per-tensor trust-ratio norms are each ONE row-reduce (+ a
-        segment_sum over row partials for the per-tensor ones) — the
-        shape ``multi_tensor_lamb.cu:41,234`` gives the GPU (two
-        list-kernels), re-expressed as XLA-friendly wide ops (r4 VERDICT
-        weak #3: the per-leaf form was hundreds of small reductions).
-        Padding rows hold zeros, so every norm is exact; results
-        round-trip back to the original tree/dtypes, leaving state and
-        checkpoint layouts unchanged."""
-        b1, b2, eps, wd = self.beta1, self.beta2, self.eps, self.weight_decay
-        pb, meta = flatten_to_chunked(p32)
-        gb, _ = flatten_to_chunked(g)
-        mb, _ = flatten_to_chunked(m)
-        vb, _ = flatten_to_chunked(v)
-
-        global_norm = jnp.sqrt(jnp.sum(jnp.square(gb)))
-        gb = gb / self._clip_ratio(global_norm)
-        if wd != 0.0 and not self.adam_w_mode:
-            gb = gb + wd * pb  # MODE_0: L2 into the clipped grad
-        mb = b1 * mb + beta3 * gb
-        vb = b2 * vb + (1.0 - b2) * gb * gb
-        ub = (mb / bc1) / (jnp.sqrt(vb / bc2) + eps)
-        if wd != 0.0 and self.adam_w_mode:
-            ub = ub + wd * pb  # MODE_1: decoupled decay
-        if wd != 0.0 or self.use_nvlamb:
-            w_sq = chunked_per_leaf_sumsq(pb, meta)   # (n_leaves,)
-            u_sq = chunked_per_leaf_sumsq(ub, meta)
-            ratio_leaf = jnp.where(
-                (w_sq > 0) & (u_sq > 0),
-                jnp.sqrt(w_sq) / jnp.sqrt(jnp.where(u_sq > 0, u_sq, 1.0)),
-                1.0,
-            )
-            # per-tensor scalar -> per-row column: broadcast, not gather
-            ratio = ratio_leaf[jnp.asarray(meta.leaf_ids)][:, None]
-        else:
-            ratio = jnp.float32(1.0)
-        pb = pb - lr * ratio * ub
-        return (unflatten_from_chunked(pb, meta),
-                unflatten_from_chunked(mb, meta),
-                unflatten_from_chunked(vb, meta))
+        return lamb_flat_update(
+            p32, g, m, v, lr=lr, b1=self.beta1, b2=self.beta2, eps=self.eps,
+            wd=self.weight_decay, beta3=beta3, bc1=bc1, bc2=bc2,
+            adam_w_mode=self.adam_w_mode, use_nvlamb=self.use_nvlamb,
+            clip_ratio=self._clip_ratio)
 
     def _per_leaf_update(self, p32, g, m, v, lr, beta3, bc1, bc2):
         b1, b2, eps, wd = self.beta1, self.beta2, self.eps, self.weight_decay

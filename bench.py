@@ -192,8 +192,13 @@ def resnet_setup(jax, on_tpu, optimizer_name, sync_bn=False):
         params = policy.cast_to_param(variables["params"])
         batch_stats = variables["batch_stats"]
         if optimizer_name == "lamb":
+            # APEX_TPU_LAMB_FLAT=0 falls back to the per-leaf update for a
+            # live A/B of the chunked flat-buffer path (the r4 weak-#3
+            # diagnosis lever); the record carries which path ran
             opt = FusedLAMB(lr=1e-3, weight_decay=1e-2,
-                            master_weights=policy.master_weights)
+                            master_weights=policy.master_weights,
+                            flat=os.environ.get(
+                                "APEX_TPU_LAMB_FLAT", "1") != "0")
         else:
             opt = FusedSGD(lr=0.1, momentum=0.9, weight_decay=1e-4,
                            master_weights=policy.master_weights)
@@ -264,6 +269,8 @@ def resnet_setup(jax, on_tpu, optimizer_name, sync_bn=False):
         "sync_bn": sync_bn,
         "mesh_cleanup": mesh_lib.destroy_model_parallel,
     }
+    if optimizer_name == "lamb":
+        meta["lamb_flat"] = opt.flat
     return train_step, (params, batch_stats, opt_state, sharded), meta
 
 
@@ -281,7 +288,7 @@ def _resnet_bench(jax, on_tpu, optimizer_name, sync_bn=False):
         dt, _ = _timeit(jax, train_step, state, steps)
 
         ips_per_chip = batch * steps / dt / meta["n_chips"]
-        return {
+        rec = {
             "value": round(ips_per_chip, 1),
             "unit": "images/sec/chip",
             "n_chips": meta["n_chips"],
@@ -289,6 +296,9 @@ def _resnet_bench(jax, on_tpu, optimizer_name, sync_bn=False):
             "image_size": meta["image_size"],
             "optimizer": optimizer_name,
         }
+        if "lamb_flat" in meta:
+            rec["lamb_flat"] = meta["lamb_flat"]
+        return rec
     finally:
         meta["mesh_cleanup"]()
 
@@ -888,7 +898,9 @@ def bench_real_data_rn50(jax, on_tpu):
     import imagenet_amp
 
     n_classes, per_class = (8, 256) if on_tpu else (4, 16)
-    batch, steps = (128, 200) if on_tpu else (16, 4)
+    # cpu-fallback shapes sized for the 300 s per-bench budget on a 1-CPU
+    # host (batch-16 RN50 steps measured ~31 s each there)
+    batch, steps = (128, 200) if on_tpu else (8, 3)
     side = 300
     cache = os.path.join("/tmp", "apex_tpu_bench_data",
                          f"synth_{n_classes}x{per_class}_{side}")

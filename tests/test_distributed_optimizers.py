@@ -151,6 +151,20 @@ def test_dist_lamb_matches_fused_lamb(mesh):
                                    rtol=1e-5, atol=1e-6)
 
 
+def test_dist_lamb_flat_matches_per_leaf(mesh):
+    """The chunked shard-local form (flat=True default) matches the
+    per-leaf form — same math, same single psum of norm partials."""
+    params = make_params(jax.random.PRNGKey(16))
+    grads = per_rank_grads(params, jax.random.PRNGKey(17))
+    a = run_dist(DistributedFusedLAMB(lr=1e-2, weight_decay=0.01,
+                                      flat=True), params, grads)
+    b = run_dist(DistributedFusedLAMB(lr=1e-2, weight_decay=0.01,
+                                      flat=False), params, grads)
+    for k in params:
+        np.testing.assert_allclose(np.asarray(a[k]), np.asarray(b[k]),
+                                   rtol=1e-6, atol=1e-7)
+
+
 def test_distributed_lamb_global_norm_clip(mesh):
     """max_grad_norm clipping (reference _pipeline_block_reductions:728):
     with a tiny max_grad_norm the effective grads shrink by
