@@ -24,6 +24,16 @@
 set -u
 cd "$(dirname "$0")/.."
 LOG=.tpu_watch/capture5.log
+# Hard wall-clock stop (epoch seconds): the driver runs its own round-end
+# bench on this 1-CPU host ~12 h after round start; this watcher must be
+# silent by then (default: just a very large number = no deadline).
+END_EPOCH=${CAPTURE5_END_EPOCH:-9999999999}
+check_deadline() {
+  if [ "$(date +%s)" -ge "$END_EPOCH" ]; then
+    log "wall-clock deadline reached; exiting to leave the host quiet"
+    exit 0
+  fi
+}
 mkdir -p .tpu_watch bench_results
 stamp() { date +%H:%M:%S; }
 log() { echo "== $(stamp) $*" >> "$LOG"; }
@@ -33,10 +43,16 @@ probe() {
     >/dev/null 2>&1
 }
 wait_for_chip() {
-  until probe; do log "chip down; re-probing in 120s"; sleep 120; done
+  check_deadline
+  until probe; do
+    check_deadline
+    log "chip down; re-probing in 120s"
+    sleep 120
+  done
   log "chip up"
 }
 run() {
+  check_deadline
   log "start: $*"
   timeout "${STAGE_TIMEOUT:-2400}" "$@" >> "$LOG" 2>&1
   log "rc=$? ($1 $2)"
