@@ -80,20 +80,32 @@ def gpt_loss(logits, labels, config: TransformerConfig):
     Vocab-parallel CE under tensor parallelism
     (``tensor_parallel/cross_entropy.py:23-131``), fused max+logsumexp CE
     (``apex/contrib/xentropy``) otherwise.
-    """
-    logits_bs = logits.transpose(1, 0, 2)  # [b, s, v]
+
+    HBM-bandwidth note (the loss head is ~27 % of GPT-124M step FLOPs and
+    its logits tensor is ~0.8 GB at the bench shapes): the big ``[s, b,
+    v]`` tensor is flattened **in its native s-major order** — only the
+    int32 labels and the fp32 per-token losses (both [b, s], KBs) get
+    transposed — and half logits enter the CE kernel in their storage
+    dtype (``half_to_float=True``; the kernel upcasts row-wise in fp32
+    and keeps original-dtype residuals, ``ops/xentropy.py``).  Both are
+    value-identical to transposing/upcasting first: the upcast point
+    commutes with the row reductions, and row order commutes with a
+    per-row loss."""
+    v = logits.shape[-1]
+    flat = logits.reshape(-1, v)            # [s*b, v] — no big transpose
+    labels_sb = labels.T.reshape(-1)        # [b,s] -> [s*b] row order
     world = bound_axis_size(config.tensor_axis)
     if world > 1:
-        flat = logits_bs.reshape(-1, logits_bs.shape[-1])
-        loss = vocab_parallel_cross_entropy(flat, labels.reshape(-1),
+        loss = vocab_parallel_cross_entropy(flat, labels_sb,
                                             axis=config.tensor_axis)
     else:
         loss = softmax_cross_entropy_loss(
-            logits_bs.reshape(-1, logits_bs.shape[-1]).astype(jnp.float32),
-            labels.reshape(-1),
+            flat,
+            labels_sb,
             padding_idx=-1,  # no padding label in LM loss
+            half_to_float=True,  # fp32 losses, half logits stay half
         )
-    return loss.reshape(labels.shape)
+    return loss.reshape(logits.shape[0], labels.shape[0]).T  # -> [b, s]
 
 
 def init_gpt_layer_stack(key, config: TransformerConfig, sample_hidden,

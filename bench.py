@@ -361,8 +361,13 @@ def bench_bert_large(jax, on_tpu):
 
     def loss_fn(p):
         lm_logits, bin_logits = model.apply({"params": p}, tokens, mask)
+        # flatten the [s, b, v] logits in native order (transposing only
+        # the tiny labels) and keep half logits half through the CE kernel
+        # — the loss is a mean, so row order is irrelevant (the gpt_loss
+        # bandwidth note, standalone_gpt.py)
         lm = softmax_cross_entropy_loss(
-            jnp.transpose(lm_logits, (1, 0, 2)), tokens, padding_idx=-1)
+            lm_logits.reshape(-1, lm_logits.shape[-1]),
+            tokens.T.reshape(-1), padding_idx=-1, half_to_float=True)
         sop = -jax.nn.log_softmax(bin_logits)[:, 0]
         return jnp.mean(lm) + jnp.mean(sop)
 

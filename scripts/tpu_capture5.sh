@@ -53,8 +53,13 @@ wait_for_chip() {
 }
 run() {
   check_deadline
-  log "start: $*"
-  timeout "${STAGE_TIMEOUT:-2400}" "$@" >> "$LOG" 2>&1
+  # clamp the stage budget to the wall-clock deadline: an in-flight stage
+  # must not outlive END_EPOCH either
+  local budget="${STAGE_TIMEOUT:-2400}"
+  local rem=$((END_EPOCH - $(date +%s)))
+  if [ "$rem" -lt "$budget" ]; then budget="$rem"; fi
+  log "start (budget ${budget}s): $*"
+  timeout "$budget" "$@" >> "$LOG" 2>&1
   log "rc=$? ($1 $2)"
 }
 
