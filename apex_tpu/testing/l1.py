@@ -65,21 +65,25 @@ def _make_scaler(kind):
 
 
 def _trace_rn50(policy_name: str = "O2", loss_scale=None,
-                sync_bn: bool = False) -> Dict[str, List[float]]:
+                sync_bn: bool = False,
+                optimizer: str = "sgd") -> Dict[str, List[float]]:
     """One RN50 cross-product cell.
 
     ``loss_scale``: ``None`` (no scaling), ``"dynamic"`` or a float
     (static).  ``sync_bn=True`` binds the dp axis over all attached
     devices via shard_map (8 virtual CPU devices under the test/record
     environment) with the batch sharded across it, so cross-replica
-    Welford psums are part of the traced numerics.
+    Welford psums are part of the traced numerics.  ``optimizer="lamb"``
+    swaps in FusedLAMB — pinning the chunked flat-buffer update's
+    numerics (global-norm clip, segmented trust-ratio norms) to a stored
+    trace.
     """
     from jax.sharding import PartitionSpec as P
 
     from apex_tpu import amp
     from apex_tpu.amp.scaler import all_finite
     from apex_tpu.models import ResNet50
-    from apex_tpu.optimizers import FusedSGD
+    from apex_tpu.optimizers import FusedLAMB, FusedSGD
     from apex_tpu.parallel import collectives as cc, mesh as mesh_lib
 
     policy = amp.policy(policy_name)
@@ -92,8 +96,16 @@ def _trace_rn50(policy_name: str = "O2", loss_scale=None,
     variables = model.init(jax.random.PRNGKey(0), x[:2], train=True)
     params = policy.cast_to_param(variables["params"])
     stats = variables["batch_stats"]
-    opt = FusedSGD(lr=0.005, momentum=0.9, weight_decay=1e-4,
-                   master_weights=policy.master_weights)
+    if optimizer == "lamb":
+        opt = FusedLAMB(lr=1e-3, weight_decay=1e-2,
+                        master_weights=policy.master_weights)
+    elif optimizer == "sgd":
+        opt = FusedSGD(lr=0.005, momentum=0.9, weight_decay=1e-4,
+                       master_weights=policy.master_weights)
+    else:
+        # fail loudly: a typo here would silently pin the wrong
+        # optimizer's numerics under the mislabeled baseline name
+        raise ValueError(f"unknown optimizer {optimizer!r}")
     state = opt.init(params)
     sstate = scaler.init() if scaler else None
 
@@ -267,6 +279,10 @@ CONFIGS = {
     "rn50_O3": partial(_trace_rn50, "O3", None, False),
     "rn50_O2_syncbn": partial(_trace_rn50, "O2", None, True),
     "rn50_O2_dynamic_syncbn": partial(_trace_rn50, "O2", "dynamic", True),
+    # optimizer axis: the r5 chunked flat-buffer LAMB (global-norm clip +
+    # segmented trust-ratio norms) pinned end-to-end through a model
+    "rn50_O2_lamb": partial(_trace_rn50, "O2", None, False,
+                            optimizer="lamb"),
     # GPT numerics axis
     "gpt_bf16": partial(_trace_gpt, jnp.bfloat16),
     "gpt_fp8": partial(_trace_gpt, None, True),
