@@ -15,10 +15,20 @@ individually guarded so one failure cannot empty the record:
                               LN/dense/Adam), tokens/sec
 - ``gpt_flash``             — flagship GPT with Pallas flash attention,
                               tokens/sec and **MFU**
+- ``gpt_flash_fp8``         — same with delayed-scaling fp8 GEMMs
+                              (``vs_bf16`` stated when both rows share a
+                              platform)
+- ``gpt_long_context``      — the seq-8192 flash config
 - ``tp_gpt``                — tensor-parallel GPT train step (shard_map over
                               the tp axis; tp=#devices)
 - ``fused_adam_step``       — optimizer step-time microbench (the
-                              "fused-optimizer step time" BASELINE metric)
+                              "fused-optimizer step time" BASELINE metric);
+                              measures per-leaf AND chunked-flat configs
+- ``input_pipeline``        — host decode + packed decode-free loader rates
+                              vs the chip's consumption rate
+- ``real_data_rn50``        — end-to-end real-JPEG training through the
+                              packed loader (``vs_synthetic`` vs the
+                              same-run headline)
 
 Backend hardening (round-1 postmortem: BENCH_r01 rc=1 at ``jax.devices()``,
 "Unable to initialize backend 'axon'"; round-2 observation: backend init can
