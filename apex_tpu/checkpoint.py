@@ -557,10 +557,15 @@ def _assemble_slice(shards, leaf_i, index, shape):
 
 
 def _unshard_host(chunked, param):
-    """Global rank-major chunked leaf -> full leaf shaped like ``param``."""
-    flat = jnp.asarray(chunked).ravel()
+    """Global rank-major chunked leaf -> full leaf shaped like ``param``.
+
+    Goes through numpy: eager jnp ops on committed partially-replicated
+    arrays (a dp-sharded shard_map output on a mesh with dcn > 1)
+    mis-lower in older jax (the replicated dim gets summed);
+    ``np.asarray`` reads one replica correctly."""
+    flat = np.asarray(chunked).ravel()
     n = int(np.prod(np.shape(param))) if np.ndim(param) else 1
-    return flat[:n].reshape(np.shape(param))
+    return jnp.asarray(flat[:n].reshape(np.shape(param)))
 
 
 def _shard_host(full, chunked_like):
@@ -572,15 +577,121 @@ def _shard_host(full, chunked_like):
     return jnp.pad(flat, (0, pad)).reshape(target)
 
 
+def _host_group_meta(opt, leaves, idx, out_dtype):
+    """Chunk metadata of one flat-bucket dtype-group with every output
+    leaf forced to ``out_dtype`` (slot/master buffers are fp32 regardless
+    of the model dtype)."""
+    from apex_tpu.utils.tree import chunked_meta
+
+    sub = [leaves[i] for i in idx]
+    return chunked_meta(
+        jax.tree_util.tree_structure(list(sub)),
+        [np.shape(x) for x in sub], [out_dtype] * len(sub),
+        chunk=opt.chunk)
+
+
+def _gather_zero_flat(opt, state, params):
+    """Flat-bucket layout gather: bucket k's global array *is* rows
+    ``[k*rpb, (k+1)*rpb)`` of the logical group buffer (the tiled
+    reduce-scatter order equals the rank-major out-spec order), so the
+    full buffer is a concat over buckets and gathering is pure
+    reshaping — same portable output as the per-leaf layout."""
+    from apex_tpu.contrib.optimizers import _flat_bucket as fbk
+    from apex_tpu.contrib.optimizers.distributed_fused_adam import join_fp32
+    from apex_tpu.utils.tree import flatten_to_chunked, unflatten_from_chunked
+
+    treedef, leaves, raw_groups = fbk.host_groups(params)
+
+    # Buffers are materialized on host FIRST (np.asarray): eager jnp ops
+    # on committed partially-replicated arrays (shard_map P("dp") outputs
+    # on a mesh with dcn > 1) mis-lower in older jax — the partitioner
+    # treats the replicated dim as unreduced and a concatenate SUMS it.
+    # np.asarray reads one replica correctly; everything below is pure
+    # host math.  Param leaves stay on device: only their shapes are
+    # read (the remainders join below materializes its group itself).
+    def unpack(groups_bufs, transform=None):
+        out = list(leaves)
+        for (_, idx), bufs in zip(raw_groups, groups_bufs):
+            buf = jnp.asarray(
+                np.concatenate([np.asarray(b) for b in bufs], axis=0))
+            if transform is not None:
+                buf = transform(buf, idx)
+            meta = _host_group_meta(opt, leaves, idx, jnp.float32)
+            for i, leaf in zip(idx, unflatten_from_chunked(buf, meta)):
+                out[i] = leaf
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+    slots = {name: unpack(tree) for name, tree in state.slots.items()}
+    master = None
+    if state.master is not None:
+        if getattr(opt, "store_param_remainders", False):
+            def join(lo_buf, idx):
+                hi_buf, _ = flatten_to_chunked(
+                    [np.asarray(leaves[i]) for i in idx], chunk=opt.chunk,
+                    dtype=jnp.bfloat16, pad_rows_to=int(lo_buf.shape[0]))
+                return join_fp32(hi_buf, lo_buf)
+            master = unpack(state.master, transform=join)
+        else:
+            master = unpack(state.master)
+    return {"step": state.step, "slots": slots, "master": master}
+
+
+def _scatter_zero_flat(opt, portable, state_like, params):
+    """Inverse of :func:`_gather_zero_flat`, re-bucketing into
+    ``state_like``'s layout (whose bucket shapes encode the — possibly
+    different — target dp world size)."""
+    from apex_tpu.contrib.optimizers import _flat_bucket as fbk
+    from apex_tpu.contrib.optimizers.distributed_fused_adam import split_fp32
+    from apex_tpu.utils.tree import flatten_to_chunked
+
+    treedef, leaves, raw_groups = fbk.host_groups(params)
+
+    def pack(full_tree, groups_like, transform=None):
+        full_leaves = treedef.flatten_up_to(full_tree)
+        out = []
+        for (_, idx), like in zip(raw_groups, groups_like):
+            rows_total = sum(int(np.shape(b)[0]) for b in like)
+            buf, _ = flatten_to_chunked(
+                [full_leaves[i] for i in idx], chunk=opt.chunk,
+                dtype=jnp.float32, pad_rows_to=max(rows_total, 1))
+            if transform is not None:
+                buf = transform(buf)
+            pieces, off = [], 0
+            for b in like:
+                r = int(np.shape(b)[0])
+                pieces.append(
+                    jnp.asarray(buf[off:off + r], jnp.asarray(b).dtype))
+                off += r
+            out.append(pieces)
+        return out
+
+    slots = {name: pack(portable["slots"][name], state_like.slots[name])
+             for name in state_like.slots}
+    master = None
+    if state_like.master is not None:
+        transform = (lambda buf: split_fp32(buf)[1]) \
+            if getattr(opt, "store_param_remainders", False) else None
+        master = pack(portable["master"], state_like.master, transform)
+    return type(state_like)(step=jnp.asarray(portable["step"]),
+                            slots=slots, master=master)
+
+
 def gather_zero_state(opt, state, params):
     """Portable (unsharded, fp32-master) state dict for a ZeRO-sharded
     optimizer — the ``state_dict(gather_on_root=True)`` analog.
 
     ``state`` holds *global* arrays whose leaves are the rank-major
     concatenation of per-rank chunks (the shape they have outside the
-    training ``shard_map``), so gathering is pure reshaping.
+    training ``shard_map``), so gathering is pure reshaping — for both
+    the per-leaf layout (one chunked array per param) and the
+    flat-bucket layout (one buffer per dtype-group bucket).  The
+    portable format is layout-independent, so a flat-bucket checkpoint
+    restores into a per-leaf optimizer and vice versa.
     """
     from apex_tpu.contrib.optimizers.distributed_fused_adam import join_fp32
+
+    if getattr(opt, "flat_bucket", False):
+        return _gather_zero_flat(opt, state, params)
 
     slots = {
         name: jax.tree_util.tree_map(_unshard_host, tree, params)
@@ -606,6 +717,9 @@ def scatter_zero_state(opt, portable, state_like, params):
     dict into the layout of ``state_like`` (possibly under a different
     dp world size — the point of portable ZeRO checkpoints)."""
     from apex_tpu.contrib.optimizers.distributed_fused_adam import split_fp32
+
+    if getattr(opt, "flat_bucket", False):
+        return _scatter_zero_flat(opt, portable, state_like, params)
 
     slots = {
         name: jax.tree_util.tree_map(

@@ -12,20 +12,37 @@ TPU-first mapping
 -----------------
 The reference hand-manages fixed-size flat buckets (``StateBucket:397``),
 overlapped NCCL reduce-scatter during backward and param all-gathers in
-forward.  Under SPMD inside ``shard_map``:
+forward.  Under SPMD inside ``shard_map`` there are two shapes:
+
+**Flat-bucket (default, ``flat_bucket=True``)** — the bucketed shape of
+the reference, rebuilt over chunked buffers (see
+:mod:`._flat_bucket`): the whole grad tree is packed into one padded
+``(rows, 256)`` buffer per dtype-group, reduce-scattered in
+``n_buckets`` large collectives (not one per tensor), the local shard
+stepped with the shared Adam math
+(:func:`apex_tpu.optimizers._common.adam_apply`), and all-gathered back
+in the model dtype.  The reduction is hierarchy-aware: reduce-scatter
+rides the intra-slice ICI ``dp`` axis and the 1/dp shard is all-reduced
+across the ``outer_axis`` (DCN) tier — optionally in bf16
+(``dcn_reduce_dtype``) — instead of flattening ``(dcn, dp)`` into one
+group (Xu et al., "Automatic Cross-Replica Sharding of Weight Update").
+
+**Per-leaf (``flat_bucket=False``)** — the original port, kept for A/B
+diagnosis and odd trees:
 
 - each parameter leaf is raveled, padded to a multiple of the ``dp`` world
   and **reduce-scattered** (``lax.psum_scatter``) — the per-rank chunk *is*
   the bucket shard, contiguity for free, overlap scheduled by XLA;
-- Adam state (``exp_avg``/``exp_avg_sq``) and the fp32 master copy exist
-  only for the local chunk — the 1/dp state-memory footprint that is
-  ZeRO's point;
-- the stepped chunk is **all-gathered** back and reshaped into the
-  replicated parameter leaves (same total bytes on the wire as a plain
-  all-reduce: RS(g) + AG(p));
-- per-leaf (not whole-tree) chunking keeps per-tensor quantities computable
-  (the LAMB variant needs per-tensor norms) at a cost of ≤ ``dp-1`` pad
-  elements per leaf.
+- per-leaf chunking costs one collective pair per tensor — hundreds of
+  small collectives on a real transformer, which is exactly what the
+  reference's buckets exist to avoid and why flat-bucket is the default
+  (bench row ``zero_adam_step``).
+
+In both shapes Adam state (``exp_avg``/``exp_avg_sq``) and the fp32
+master copy exist only for the local shard — the 1/dp state-memory
+footprint that is ZeRO's point — and the stepped shard is all-gathered
+back into the replicated parameter leaves (same total bytes on the wire
+as a plain all-reduce: RS(g) + AG(p)).
 
 ``store_param_remainders`` reproduces the bf16+remainder trick exactly: the
 fp32 master bits are split into the high 16 (the *truncated* bf16 the model
@@ -51,14 +68,16 @@ import jax.numpy as jnp
 from jax import lax
 
 from apex_tpu.parallel import collectives as cc
+from apex_tpu.contrib.optimizers import _flat_bucket as fb
 from apex_tpu.optimizers._common import (
     OptState,
+    adam_apply,
     advance_step,
     apply_skip,
     f32,
     tree_map_multi,
 )
-from apex_tpu.parallel.mesh import DATA_AXIS
+from apex_tpu.parallel.mesh import DATA_AXIS, DCN_AXIS
 
 __all__ = ["DistributedFusedAdam", "shard_leaf", "unshard_leaf",
            "split_fp32", "join_fp32"]
@@ -125,7 +144,7 @@ def join_fp32(hi_bf16, lo_u16):
     return jax.lax.bitcast_convert_type(bits, jnp.float32)
 
 
-class DistributedFusedAdam:
+class DistributedFusedAdam(fb.FlatBucketMixin):
     """ZeRO-2 Adam over the ``dp`` mesh axis (see module docstring)."""
 
     def __init__(
@@ -136,9 +155,14 @@ class DistributedFusedAdam:
         eps: float = 1e-8,
         adam_w_mode: bool = True,
         weight_decay: float = 0.0,
-        axis: str = DATA_AXIS,
+        axis=DATA_AXIS,
         grad_predivide_factor: Optional[float] = None,
         store_param_remainders: bool = False,
+        flat_bucket: bool = True,
+        n_buckets: int = 1,
+        chunk: int = 256,
+        outer_axis: Optional[str] = DCN_AXIS,
+        dcn_reduce_dtype=None,
     ):
         self.lr = lr
         self.bias_correction = bias_correction
@@ -151,8 +175,24 @@ class DistributedFusedAdam:
         # None = divide by world size.
         self.grad_predivide_factor = grad_predivide_factor
         self.store_param_remainders = store_param_remainders
+        # flat_bucket=True: one padded chunked buffer per dtype-group,
+        # split into n_buckets row-ranges — ONE reduce-scatter and ONE
+        # all-gather per bucket (StateBucket:397's shape; n_buckets>1
+        # lets XLA overlap bucket k's gather with bucket k+1's update
+        # tail).  False keeps the per-leaf port (one collective pair per
+        # tensor) for A/B diagnosis.  outer_axis is the hierarchical
+        # tier: reduce-scatter over `axis` (ICI), all-reduce the shard
+        # over `outer_axis` (DCN), optionally in `dcn_reduce_dtype`
+        # (e.g. bf16 to halve cross-slice bytes); ignored when unbound
+        # or size 1, so the default is correct at any scale.
+        self._init_bucket_config(
+            flat_bucket=flat_bucket, n_buckets=n_buckets, chunk=chunk,
+            outer_axis=outer_axis, dcn_reduce_dtype=dcn_reduce_dtype)
 
     def init(self, params) -> OptState:
+        if self.flat_bucket:
+            return self._init_flat_bucket(params)
+
         def shard_zero(p):
             return jnp.zeros_like(shard_leaf(f32(p), self.axis))
 
@@ -171,6 +211,14 @@ class DistributedFusedAdam:
             )
         return OptState(step=jnp.int32(0), slots=slots, master=master)
 
+    def _init_flat_bucket(self, params) -> OptState:
+        cfg = self._cfg()
+        layout = self._layout(params, cfg.world_scatter)
+        return fb.init_flat_state(
+            params, cfg, layout,
+            remainder_split=split_fp32 if self.store_param_remainders
+            else None)
+
     def _master_shard(self, params, master):
         if self.store_param_remainders:
             # High bits live in the (replicated) bf16 params themselves.
@@ -184,6 +232,10 @@ class DistributedFusedAdam:
 
     def step(self, grads, state: OptState, params, *, lr=None,
              grad_scale=None, skip_update=None):
+        if self.flat_bucket:
+            return self._step_flat_bucket(grads, state, params, lr=lr,
+                                          grad_scale=grad_scale,
+                                          skip_update=skip_update)
         axis = self.axis
         world = cc.axis_size(axis)
         lr = f32(self.lr if lr is None else lr)
@@ -250,6 +302,92 @@ class DistributedFusedAdam:
                                           jnp.asarray(p).dtype, axis),
             gather_src, params,
         )
+        new_state = OptState(
+            step=advance_step(state.step, skip_update),
+            slots={"exp_avg": new_m, "exp_avg_sq": new_v},
+            master=new_master,
+        )
+        return new_params, new_state
+
+    def _step_flat_bucket(self, grads, state: OptState, params, *, lr,
+                          grad_scale, skip_update):
+        """The bucketed ZeRO step: per dtype-group, ONE reduce-scatter per
+        bucket in, shared Adam math on the local shard, ONE all-gather
+        per bucket out (``StateBucket:397`` +
+        ``_pipeline_step``-shaped exchange, expressed as chunked-buffer
+        collectives XLA can overlap)."""
+        cfg = self._cfg()
+        layout = self._layout(params, cfg.world_scatter)
+        rank = fb.flat_rank(cfg)
+        lr = f32(self.lr if lr is None else lr)
+        b1, b2, eps, wd = self.beta1, self.beta2, self.eps, self.weight_decay
+        t = state.step + 1
+
+        # predivide/postdivide split exactly as the per-leaf path; the
+        # averaging divisor is the TOTAL replica count (inner dp x outer
+        # dcn tier).
+        f = (f32(cfg.world_total) if self.grad_predivide_factor is None
+             else f32(self.grad_predivide_factor))
+        pre = 1.0 / f
+        post = f / f32(cfg.world_total)
+        if grad_scale is not None:
+            pre = pre / f32(grad_scale)
+
+        if self.bias_correction:
+            bc1 = 1.0 - b1 ** f32(t)
+            bc2 = 1.0 - b2 ** f32(t)
+        else:
+            bc1 = bc2 = jnp.float32(1.0)
+
+        g_leaves = layout.treedef.flatten_up_to(grads)
+        p_leaves = layout.treedef.flatten_up_to(params)
+
+        old_p32, new_p, new_m, new_v = [], [], [], []
+        for gi, group in enumerate(layout.groups):
+            g32 = fb.flatten_group(layout, group, g_leaves,
+                                   dtype=jnp.float32)
+            g_loc = fb.bucket_reduce_scatter(
+                g32 * pre, group, cfg, layout.n_buckets,
+                outer_reduce_dtype=self.dcn_reduce_dtype)
+            g_loc = [g * post for g in g_loc]
+            if self.store_param_remainders:
+                # High bits live in the (replicated) bf16 params.
+                hi = fb.flatten_group(layout, group, p_leaves,
+                                      dtype=jnp.bfloat16)
+                hi_loc = fb.local_slices(hi, group, layout.n_buckets, rank)
+                p32 = [join_fp32(h, lo)
+                       for h, lo in zip(hi_loc, state.master[gi])]
+            else:
+                p32 = state.master[gi]
+            stepped = [
+                adam_apply(p, g, m, v, lr=lr, b1=b1, b2=b2, eps=eps, wd=wd,
+                           bc1=bc1, bc2=bc2, adam_w_mode=self.adam_w_mode)
+                for p, g, m, v in zip(p32, g_loc,
+                                      state.slots["exp_avg"][gi],
+                                      state.slots["exp_avg_sq"][gi])
+            ]
+            old_p32.append(p32)
+            new_p.append([s[0] for s in stepped])
+            new_m.append([s[1] for s in stepped])
+            new_v.append([s[2] for s in stepped])
+
+        new_p = apply_skip(skip_update, new_p, old_p32)
+        new_m = apply_skip(skip_update, new_m, state.slots["exp_avg"])
+        new_v = apply_skip(skip_update, new_v, state.slots["exp_avg_sq"])
+
+        full_bufs, new_master = [], []
+        for gi, group in enumerate(layout.groups):
+            if self.store_param_remainders:
+                hi_lo = [split_fp32(p) for p in new_p[gi]]
+                new_master.append([hl[1] for hl in hi_lo])
+                gather_src = [hl[0] for hl in hi_lo]
+            else:
+                new_master.append(new_p[gi])
+                gather_src = new_p[gi]
+            full_bufs.append(fb.bucket_all_gather(
+                gather_src, group, cfg, dtype=group.dtype))
+        new_params = fb.unflatten_groups(layout, full_bufs, p_leaves)
+
         new_state = OptState(
             step=advance_step(state.step, skip_update),
             slots={"exp_avg": new_m, "exp_avg_sq": new_v},

@@ -6,22 +6,29 @@ global-grad-norm clipping (``_pipeline_block_reductions:728``), per-tensor
 trust ratios, and the stepped shards all-gathered back
 (``_pipeline_step:812``).
 
-SPMD mapping follows :mod:`.distributed_fused_adam` (per-leaf chunks via
-``psum_scatter`` / ``all_gather``); the LAMB-specific parts are the two norm
-reductions the reference launches as ``multi_tensor_l2norm`` + NCCL
-all-reduce (``fused_lamb.py:116-147``): here each is a shard-local sum of
-squares followed by one ``lax.psum`` over the dp axis.
+SPMD mapping follows :mod:`.distributed_fused_adam`: the default
+``flat_bucket=True`` packs the whole tree into chunked dtype-group buffers
+— ONE (optionally ICI/DCN-hierarchical) reduce-scatter and ONE all-gather
+per bucket, the bucketed exchange of the reference's flat
+``_flat_grads``/``_new_params`` buffers (``distributed_fused_lamb.py:424``)
+— with ``flat_bucket=False`` keeping the per-leaf ``psum_scatter`` /
+``all_gather`` port.  The LAMB-specific parts are the two norm reductions
+the reference launches as ``multi_tensor_l2norm`` + NCCL all-reduce
+(``fused_lamb.py:116-147``): here each is a shard-local (segmented, for
+the per-tensor set) sum of squares followed by one ``lax.psum`` over the
+scatter axes.
 """
 
 from __future__ import annotations
 
-from typing import Tuple
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 
 from apex_tpu.parallel import collectives as cc
 
+from apex_tpu.contrib.optimizers import _flat_bucket as fb
 from apex_tpu.contrib.optimizers.distributed_fused_adam import (
     reduce_scatter_leaf,
     shard_leaf,
@@ -34,13 +41,30 @@ from apex_tpu.optimizers._common import (
     f32,
     tree_map_multi,
 )
-from apex_tpu.parallel.mesh import DATA_AXIS
+from apex_tpu.parallel.mesh import DATA_AXIS, DCN_AXIS
 from apex_tpu.optimizers.fused_lamb import lamb_flat_update
 
 __all__ = ["DistributedFusedLAMB"]
 
 
-class DistributedFusedLAMB:
+def _lamb_stage1(p, g, m, v, *, clip, b1, b2, beta3, bc1, bc2, eps, wd,
+                 adam_w_mode):
+    """LAMB stage 1 (``multi_tensor_lamb.cu:41``) on fp32 values: clipped
+    grad, moments, bc-corrected raw update — the LAMB analog of
+    ``adam_apply``, shared by the per-leaf and flat-bucket paths so the
+    math cannot diverge between them.  Returns ``(update, m, v)``."""
+    g = g / clip
+    if wd != 0.0 and not adam_w_mode:
+        g = g + wd * p  # MODE_0: L2 into the clipped grad
+    m = b1 * m + beta3 * g
+    v = b2 * v + (1.0 - b2) * g * g
+    update = (m / bc1) / (jnp.sqrt(v / bc2) + eps)
+    if wd != 0.0 and adam_w_mode:
+        update = update + wd * p  # MODE_1: decoupled decay
+    return update, m, v
+
+
+class DistributedFusedLAMB(fb.FlatBucketMixin):
     """ZeRO LAMB over the ``dp`` mesh axis; call inside ``shard_map`` with
     pre-reduction local grads (see ``DistributedFusedAdam``)."""
 
@@ -55,8 +79,13 @@ class DistributedFusedLAMB:
         grad_averaging: bool = True,
         max_grad_norm: float = 1.0,
         use_nvlamb: bool = False,
-        axis: str = DATA_AXIS,
+        axis=DATA_AXIS,
         flat: bool = True,
+        flat_bucket: bool = True,
+        n_buckets: int = 1,
+        chunk: int = 256,
+        outer_axis: Optional[str] = DCN_AXIS,
+        dcn_reduce_dtype=None,
     ):
         self.lr = lr
         self.bias_correction = bias_correction
@@ -72,9 +101,22 @@ class DistributedFusedLAMB:
         # (FusedLAMB's r5 rebuild) — wide elementwise kernels, segmented
         # per-tensor norm partials, and still exactly ONE psum for all
         # 2*n_leaves norm partials.  flat=False keeps the per-leaf form.
+        # Only consulted when flat_bucket=False.
         self.flat = flat
+        # flat_bucket=True: the COMMUNICATION is bucketed too — one
+        # reduce-scatter / all-gather per dtype-group bucket instead of
+        # one pair per tensor (see distributed_fused_adam.py docstring);
+        # outer_axis enables the hierarchical ICI/DCN reduction.
+        self._init_bucket_config(
+            flat_bucket=flat_bucket, n_buckets=n_buckets, chunk=chunk,
+            outer_axis=outer_axis, dcn_reduce_dtype=dcn_reduce_dtype)
 
     def init(self, params) -> OptState:
+        if self.flat_bucket:
+            cfg = self._cfg()
+            return fb.init_flat_state(
+                params, cfg, self._layout(params, cfg.world_scatter))
+
         def shard_zero(p):
             return jnp.zeros_like(shard_leaf(f32(p), self.axis))
 
@@ -91,6 +133,10 @@ class DistributedFusedLAMB:
 
     def step(self, grads, state: OptState, params, *, lr=None,
              grad_scale=None, skip_update=None):
+        if self.flat_bucket:
+            return self._step_flat_bucket(grads, state, params, lr=lr,
+                                          grad_scale=grad_scale,
+                                          skip_update=skip_update)
         axis = self.axis
         world = cc.axis_size(axis)
         lr = f32(self.lr if lr is None else lr)
@@ -173,15 +219,9 @@ class DistributedFusedLAMB:
 
         # Stage 1 (multi_tensor_lamb.cu stage 1): moments + raw update.
         def stage1(p, g, m, v):
-            g = g / clip
-            if wd != 0.0 and not self.adam_w_mode:
-                g = g + wd * p
-            m = b1 * m + beta3 * g
-            v = b2 * v + (1.0 - b2) * g * g
-            update = (m / bc1) / (jnp.sqrt(v / bc2) + eps)
-            if wd != 0.0 and self.adam_w_mode:
-                update = update + wd * p
-            return update, m, v
+            return _lamb_stage1(p, g, m, v, clip=clip, b1=b1, b2=b2,
+                                beta3=beta3, bc1=bc1, bc2=bc2, eps=eps,
+                                wd=wd, adam_w_mode=self.adam_w_mode)
 
         updates, new_m, new_v = tree_map_multi(stage1, 3, p32, g_shards,
                                                m, v)
@@ -215,3 +255,140 @@ class DistributedFusedLAMB:
                         for i, (p, u) in enumerate(zip(p_leaves, u_leaves))]
         return (jax.tree_util.tree_unflatten(u_def, new_p_leaves),
                 new_m, new_v)
+
+    def _step_flat_bucket(self, grads, state: OptState, params, *, lr,
+                          grad_scale, skip_update):
+        """Bucketed ZeRO LAMB: one (hierarchical) reduce-scatter per
+        dtype-group bucket, both LAMB stages on the local shard, one
+        all-gather per bucket back.  The per-tensor trust-ratio norms are
+        recovered from the shard by segmented row reductions (leaf
+        boundaries are row-aligned, ``flatten_to_chunked``) + ONE psum of
+        the stacked partial vector — the reference's single fused
+        ``multi_tensor_l2norm`` launch + one all-reduce
+        (``distributed_fused_lamb.py:728-811``), bucket-sharded."""
+        cfg = self._cfg()
+        layout = self._layout(params, cfg.world_scatter)
+        rank = fb.flat_rank(cfg)
+        lr = f32(self.lr if lr is None else lr)
+        b1, b2, eps, wd = self.beta1, self.beta2, self.eps, self.weight_decay
+        t = state.step + 1
+
+        inv_scale = 1.0 / f32(cfg.world_total)
+        if grad_scale is not None:
+            inv_scale = inv_scale / f32(grad_scale)
+
+        beta3 = 1.0 - b1 if self.grad_averaging else 1.0
+        if self.bias_correction:
+            bc1 = 1.0 - b1 ** f32(t)
+            bc2 = 1.0 - b2 ** f32(t)
+        else:
+            bc1 = bc2 = jnp.float32(1.0)
+
+        g_leaves = layout.treedef.flatten_up_to(grads)
+        p_leaves = layout.treedef.flatten_up_to(params)
+
+        # Gradient reduce-scatter (all buckets), then the global grad norm
+        # from the shards: shards are distinct over the scatter axes only
+        # (a hierarchical outer tier holds replicas), so ONE psum there.
+        g_loc_groups, ids_groups = [], []
+        for group in layout.groups:
+            g32 = fb.flatten_group(layout, group, g_leaves,
+                                   dtype=jnp.float32)
+            g_loc_groups.append([
+                g * inv_scale for g in fb.bucket_reduce_scatter(
+                    g32, group, cfg, layout.n_buckets,
+                    outer_reduce_dtype=self.dcn_reduce_dtype)])
+            ids_groups.append(
+                fb.local_leaf_ids(group, layout.n_buckets, rank))
+
+        local_sq = sum(
+            jnp.sum(jnp.square(g))
+            for bufs in g_loc_groups for g in bufs
+        ) if layout.groups else jnp.float32(0)
+        global_sq = cc.all_reduce(local_sq, cfg.scatter_axes)
+        if self.max_grad_norm and self.max_grad_norm > 0:
+            clip = jnp.maximum(jnp.sqrt(global_sq) / self.max_grad_norm,
+                               1.0)
+        else:
+            clip = jnp.float32(1.0)
+
+        # Stage 1 (multi_tensor_lamb.cu:41): moments + raw update on the
+        # local shard buffers — same _lamb_stage1 as the per-leaf path.
+        updates, new_m, new_v = [], [], []
+        for gi, group in enumerate(layout.groups):
+            us, ms, vs = [], [], []
+            for g, m, v, p in zip(g_loc_groups[gi],
+                                  state.slots["exp_avg"][gi],
+                                  state.slots["exp_avg_sq"][gi],
+                                  state.master[gi]):
+                u, m, v = _lamb_stage1(
+                    p, g, m, v, clip=clip, b1=b1, b2=b2, beta3=beta3,
+                    bc1=bc1, bc2=bc2, eps=eps, wd=wd,
+                    adam_w_mode=self.adam_w_mode)
+                us.append(u)
+                ms.append(m)
+                vs.append(v)
+            updates.append(us)
+            new_m.append(ms)
+            new_v.append(vs)
+
+        # Stage 2 (multi_tensor_lamb.cu:234): per-tensor trust ratios.
+        # Shard-local segmented partials for EVERY leaf (params and
+        # updates), stacked into one vector -> exactly one norm psum.
+        if (wd != 0.0 or self.use_nvlamb) and layout.groups:
+            def group_partials(bufs, gi, group):
+                acc = jnp.zeros((len(group.indices),), jnp.float32)
+                for buf, ids in zip(bufs, ids_groups[gi]):
+                    row_sq = jnp.sum(jnp.square(buf), axis=1)
+                    acc = acc + jax.ops.segment_sum(
+                        row_sq, ids, num_segments=len(group.indices),
+                        indices_are_sorted=True)
+                return acc
+
+            partial = jnp.concatenate(
+                [group_partials(state.master[gi], gi, group)
+                 for gi, group in enumerate(layout.groups)]
+                + [group_partials(updates[gi], gi, group)
+                   for gi, group in enumerate(layout.groups)])
+            norms_sq = cc.all_reduce(partial, cfg.scatter_axes)
+            half = partial.shape[0] // 2
+            w_sq, u_sq = norms_sq[:half], norms_sq[half:]
+            ratio_all = jnp.where(
+                (w_sq > 0) & (u_sq > 0),
+                jnp.sqrt(w_sq) / jnp.sqrt(jnp.where(u_sq > 0, u_sq, 1.0)),
+                1.0,
+            )
+
+            def bucket_ratio(gi, offset, k):
+                ids = ids_groups[gi][k]
+                return ratio_all[offset + ids][:, None]
+        else:
+            def bucket_ratio(gi, offset, k):
+                return jnp.float32(1.0)
+
+        old_p32, new_p = [], []
+        offset = 0
+        for gi, group in enumerate(layout.groups):
+            p32 = state.master[gi]
+            new_p.append([
+                p - lr * bucket_ratio(gi, offset, k) * u
+                for k, (p, u) in enumerate(zip(p32, updates[gi]))
+            ])
+            old_p32.append(p32)
+            offset += len(group.indices)
+
+        new_p = apply_skip(skip_update, new_p, old_p32)
+        new_m = apply_skip(skip_update, new_m, state.slots["exp_avg"])
+        new_v = apply_skip(skip_update, new_v, state.slots["exp_avg_sq"])
+
+        full_bufs = [
+            fb.bucket_all_gather(new_p[gi], group, cfg, dtype=group.dtype)
+            for gi, group in enumerate(layout.groups)
+        ]
+        new_params = fb.unflatten_groups(layout, full_bufs, p_leaves)
+        new_state = OptState(
+            step=advance_step(state.step, skip_update),
+            slots={"exp_avg": new_m, "exp_avg_sq": new_v},
+            master=new_p,
+        )
+        return new_params, new_state

@@ -24,6 +24,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from apex_tpu.parallel import collectives as cc
+
 __all__ = ["halo_exchange_1d", "SpatialBottleneck", "spatial_conv_nhwc"]
 
 
@@ -37,7 +39,7 @@ def halo_exchange_1d(x, axis: str, half_halo: int, dim: int = 1):
     """
     if half_halo == 0:
         return x
-    world = lax.axis_size(axis)
+    world = cc.axis_size(axis)
     n = x.shape[dim]
     if n < half_halo:
         raise ValueError(f"shard dim {n} smaller than halo {half_halo}")

@@ -332,12 +332,16 @@ class PackedLoader:
                     return
                 if isinstance(batch, _ProducerError):
                     raise batch.exc
-                if st.stop.is_set():
-                    # this batch was already rewound by _finish's drain
-                    # accounting — yielding it would deliver duplicate
-                    # training data
-                    return
                 with self._lock:
+                    # check-and-decrement must be one atomic section:
+                    # _finish (a competing __iter__ or close()) sets stop,
+                    # rewinds the samplers and zeroes st.mine under this
+                    # same lock — a stop check outside it could pass just
+                    # before the teardown, and the decrement after it
+                    # would both deliver an already-rewound batch twice
+                    # and drive st.mine to -1
+                    if st.stop.is_set():
+                        return
                     st.mine -= 1
                 yield batch
         finally:

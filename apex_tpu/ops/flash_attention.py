@@ -162,7 +162,10 @@ def _flash_compiler_params():
     independent, so tell Mosaic: it may split them across cores (megacore
     on v4/v5p) and reorder for pipelining; the innermost stays sequential
     (init-at-0 / finalize-at-last scratch carry)."""
-    return pltpu.CompilerParams(
+    # jax >= 0.7 spells it CompilerParams; earlier releases TPUCompilerParams
+    params_cls = getattr(pltpu, "CompilerParams", None) \
+        or pltpu.TPUCompilerParams
+    return params_cls(
         dimension_semantics=("parallel", "parallel", "arbitrary"))
 
 

@@ -39,6 +39,7 @@ import jax.numpy as jnp
 
 __all__ = [
     "f32",
+    "adam_apply",
     "tree_f32",
     "tree_zeros_f32",
     "advance_step",
@@ -55,6 +56,23 @@ Pytree = Any
 
 def f32(x):
     return jnp.asarray(x, jnp.float32)
+
+
+def adam_apply(p, g, m, v, *, lr, b1, b2, eps, wd, bc1, bc2, adam_w_mode):
+    """One Adam/AdamW update on fp32 values — the elementwise core of
+    ``csrc/multi_tensor_adam.cu:64-87`` (``ADAM_MODE_0`` folds ``wd*p``
+    into the grad, ``ADAM_MODE_1`` decouples the decay into the update).
+    Shape-agnostic: the fused optimizer maps it over leaves or chunked
+    buffers, the ZeRO-sharded ones over per-leaf chunks or flat-bucket
+    shards — one definition of the math, four call shapes."""
+    if not adam_w_mode and wd != 0.0:
+        g = g + wd * p
+    m = b1 * m + (1.0 - b1) * g
+    v = b2 * v + (1.0 - b2) * g * g
+    update = (m / bc1) / (jnp.sqrt(v / bc2) + eps)
+    if adam_w_mode and wd != 0.0:
+        update = update + wd * p
+    return p - lr * update, m, v
 
 
 def tree_f32(tree):

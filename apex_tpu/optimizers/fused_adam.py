@@ -30,6 +30,7 @@ import jax.numpy as jnp
 
 from apex_tpu.optimizers._common import (
     OptState,
+    adam_apply,
     advance_step,
     apply_skip,
     f32,
@@ -114,14 +115,9 @@ class FusedAdam:
             bc1 = bc2 = jnp.float32(1.0)
 
         def leaf(p, g, m, v):
-            if not self.adam_w_mode and wd != 0.0:
-                g = g + wd * p  # ADAM_MODE_0: L2 into gradient
-            m = b1 * m + (1.0 - b1) * g
-            v = b2 * v + (1.0 - b2) * g * g
-            update = (m / bc1) / (jnp.sqrt(v / bc2) + eps)
-            if self.adam_w_mode and wd != 0.0:
-                update = update + wd * p  # ADAM_MODE_1: decoupled decay
-            return p - lr * update, m, v
+            return adam_apply(p, g, m, v, lr=lr, b1=b1, b2=b2, eps=eps,
+                              wd=wd, bc1=bc1, bc2=bc2,
+                              adam_w_mode=self.adam_w_mode)
 
         tmap = tree_map_flat if self.flat else tree_map_multi
         new_p32, new_m, new_v = tmap(

@@ -34,6 +34,9 @@ from apex_tpu.parallel.distributed import (  # noqa: F401
     DistributedDataParallel,
     all_reduce_gradients,
     data_parallel_train_step,
+    grad_accumulation,
+    zero_data_parallel_train_step,
+    zero_init,
     dp_shard_batch,
     replicate,
 )

@@ -29,6 +29,8 @@ __all__ = [
     "all_reduce",
     "all_gather",
     "reduce_scatter",
+    "hierarchical_reduce_scatter",
+    "hierarchical_all_gather",
     "ppermute",
     "all_to_all",
     "broadcast",
@@ -50,6 +52,19 @@ def axis_index(axis: AxisName):
     return lax.axis_index(axis)
 
 
+def _axis_size(axis: AxisName) -> int:
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis)
+    # jax < 0.4.38 has no lax.axis_size; psum of a unit constant folds to
+    # the static size (the documented psum(1, axis) idiom)
+    if isinstance(axis, (tuple, list)):
+        size = 1
+        for a in axis:
+            size *= int(lax.psum(1, a))
+        return size
+    return int(lax.psum(1, axis))
+
+
 def bound_axis_size(axis: Optional[AxisName]) -> int:
     """Size of ``axis`` if it is bound by an enclosing ``shard_map``/``pmap``,
     else 1.  Lets axis-parameterized modules degrade to their single-rank
@@ -57,14 +72,14 @@ def bound_axis_size(axis: Optional[AxisName]) -> int:
     if axis is None:
         return 1
     try:
-        return lax.axis_size(axis)
+        return _axis_size(axis)
     except NameError:
         return 1
 
 
 def axis_size(axis: AxisName) -> int:
     """World size along a mesh axis (inside shard_map)."""
-    return lax.axis_size(axis)
+    return _axis_size(axis)
 
 
 def all_reduce(x, axis: AxisName, op: str = "sum"):
@@ -104,6 +119,58 @@ def reduce_scatter(x, axis: AxisName, *, scatter_axis: int = 0):
     return lax.psum_scatter(x, axis, scatter_dimension=scatter_axis, tiled=True)
 
 
+def hierarchical_reduce_scatter(
+    x,
+    inner_axis: AxisName,
+    outer_axis: Optional[str] = None,
+    *,
+    scatter_axis: int = 0,
+    outer_reduce_dtype=None,
+):
+    """Two-tier reduce-scatter for the ICI/DCN fabric.
+
+    Instead of treating ``(dcn, dp)`` as one flat reduction group (which
+    interleaves 1/(dcn*dp)-sized exchanges over the slow cross-slice
+    network), reduce-scatter over the intra-slice ``inner_axis`` (ICI)
+    first, then all-reduce the 1/dp-sized shard across ``outer_axis``
+    (DCN) — the hierarchical schedule of "Automatic Cross-Replica Sharding
+    of Weight Update in Data-Parallel Training" (Xu et al.; the analog of
+    the reference's IB-block vs socket NCCL group split,
+    ``apex/transformer/parallel_state.py:83-153``).  The result is the
+    fully-summed shard, *replicated* over ``outer_axis``.
+
+    ``outer_reduce_dtype`` optionally casts the shard for the DCN hop
+    (e.g. ``jnp.bfloat16`` halves cross-slice bytes) and casts back.
+    The outer hop is skipped when ``outer_axis`` is ``None``, unbound, or
+    size 1, so call sites are correct at any scale.
+    """
+    shard = lax.psum_scatter(
+        x, inner_axis, scatter_dimension=scatter_axis, tiled=True
+    )
+    if outer_axis is not None and bound_axis_size(outer_axis) > 1:
+        if outer_reduce_dtype is not None:
+            orig = shard.dtype
+            shard = lax.psum(
+                jnp.asarray(shard, outer_reduce_dtype), outer_axis
+            )
+            shard = jnp.asarray(shard, orig)
+        else:
+            shard = lax.psum(shard, outer_axis)
+    return shard
+
+
+def hierarchical_all_gather(x, inner_axis: AxisName, *, concat_axis: int = 0,
+                            tiled: bool = True):
+    """Gather back shards produced by :func:`hierarchical_reduce_scatter`.
+
+    Because the outer (DCN) tier all-*reduces* — every slice ends up with
+    identical shards — the gather only ever runs over the intra-slice
+    ``inner_axis``: zero DCN bytes on the parameter path.  Provided as a
+    named pair so call sites state the intent (and stay correct if the
+    outer tier ever becomes a scatter)."""
+    return lax.all_gather(x, inner_axis, axis=concat_axis, tiled=tiled)
+
+
 def ppermute(x, axis: AxisName, perm):
     """Point-to-point permutation — the p2p send/recv analog
     (``apex/transformer/pipeline_parallel/p2p_communication.py:48-166``)."""
@@ -118,14 +185,14 @@ def send_recv_next(x, axis: AxisName):
     edge (last→first) carries data the consumer must mask/ignore, matching the
     reference where first stage never reads a recv'd activation.
     """
-    n = lax.axis_size(axis)
+    n = _axis_size(axis)
     return lax.ppermute(x, axis, [(i, (i + 1) % n) for i in range(n)])
 
 
 def send_recv_prev(x, axis: AxisName):
     """Send to rank-1, receive from rank+1 (pipeline backward direction,
     ``p2p_communication.send_backward`` ``:469``)."""
-    n = lax.axis_size(axis)
+    n = _axis_size(axis)
     return lax.ppermute(x, axis, [(i, (i - 1) % n) for i in range(n)])
 
 
@@ -166,8 +233,18 @@ def shard_over(
     """
     if mesh is None:
         mesh = mesh_lib.get_mesh()
-    return jax.shard_map(
-        fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=check_vma
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check_vma,
+        )
+    # jax < 0.5: shard_map lives in jax.experimental and the replication
+    # check is spelled check_rep
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(
+        fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=check_vma,
     )
 
 

@@ -42,6 +42,9 @@ __all__ = [
     "all_reduce_gradients",
     "DistributedDataParallel",
     "data_parallel_train_step",
+    "grad_accumulation",
+    "zero_data_parallel_train_step",
+    "zero_init",
     "dp_shard_batch",
     "replicate",
 ]
@@ -166,12 +169,70 @@ class DistributedDataParallel:
         return jax.jit(wrapped)
 
 
+def grad_accumulation(grad_fn: Callable, microbatches: int) -> Callable:
+    """Wrap ``grad_fn(params, batch) -> (loss, grads)`` to accumulate over
+    ``microbatches`` sequential microbatches — the
+    ``delay_allreduce``/``no_sync()`` capability of apex DDP
+    (``apex/parallel/distributed.py:198`` ``delay_allreduce``; Megatron's
+    interval accumulation) as a pure function transform.
+
+    The batch's leading dim is split into ``microbatches`` equal slices
+    and scanned; losses and grads are accumulated in fp32 and divided by
+    ``microbatches`` once at the end, so the wrapper is a drop-in for
+    ``grad_fn`` on the whole batch (gradient of the mean loss), with peak
+    activation memory of ONE microbatch.
+
+    Crucially the accumulation is *local arithmetic only* — no collective
+    per microbatch.  Feeding the result to a ZeRO optimizer
+    (``DistributedFusedAdam.step``, which reduce-scatters internally)
+    folds the entire gradient reduction into the last microbatch — one
+    reduce-scatter per N microbatches, the overlap structure of the
+    reference's ``_pipeline_block_reductions``.
+    """
+    if microbatches == 1:
+        return grad_fn
+
+    def accum(params, batch):
+        def split(x):
+            n = jnp.shape(x)[0]
+            if n % microbatches:
+                raise ValueError(
+                    f"batch dim {n} not divisible by microbatches="
+                    f"{microbatches}")
+            return x.reshape((microbatches, n // microbatches)
+                             + tuple(jnp.shape(x)[1:]))
+
+        micro = jax.tree_util.tree_map(split, batch)
+        mb0 = jax.tree_util.tree_map(lambda x: x[0], micro)
+        g_shape = jax.eval_shape(lambda p, b: grad_fn(p, b)[1], params, mb0)
+        init = (
+            jnp.float32(0),
+            jax.tree_util.tree_map(
+                lambda s: jnp.zeros(s.shape, jnp.float32), g_shape),
+        )
+
+        def body(carry, mb):
+            loss, grads = grad_fn(params, mb)
+            loss_acc, g_acc = carry
+            g_acc = jax.tree_util.tree_map(
+                lambda a, g: a + jnp.asarray(g, jnp.float32), g_acc, grads)
+            return (loss_acc + jnp.asarray(loss, jnp.float32), g_acc), None
+
+        (loss_sum, g_sum), _ = jax.lax.scan(body, init, micro)
+        inv = 1.0 / microbatches
+        return loss_sum * inv, jax.tree_util.tree_map(
+            lambda g: g * inv, g_sum)
+
+    return accum
+
+
 def data_parallel_train_step(
     loss_fn: Callable,
     optimizer,
     *,
     mesh=None,
     donate: bool = True,
+    microbatches: int = 1,
 ):
     """The pjit path: build a jitted DP train step with implicit reduction.
 
@@ -181,14 +242,91 @@ def data_parallel_train_step(
     XLA inserts the gradient psum itself — this is the whole DDP feature set
     expressed as shardings.  Returns ``step(params, opt_state, batch) ->
     (params, opt_state, loss)``.
+
+    ``microbatches > 1`` scans :func:`grad_accumulation` over the batch's
+    leading dim — one-microbatch activation memory; reduction scheduling
+    stays with the partitioner here (for the guaranteed
+    single-reduce-scatter form, use :func:`zero_data_parallel_train_step`).
     """
     if mesh is None:
         mesh = mesh_lib.get_mesh()
 
+    grad_fn = grad_accumulation(
+        lambda p, b: jax.value_and_grad(loss_fn)(p, b), microbatches)
+
     def step(params, opt_state, batch, lr=None):
-        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        loss, grads = grad_fn(params, batch)
         params, opt_state = optimizer.step(grads, opt_state, params, lr=lr)
         return params, opt_state, loss
+
+    donate_argnums = (0, 1) if donate else ()
+    return jax.jit(step, donate_argnums=donate_argnums)
+
+
+def zero_init(optimizer, params, mesh=None):
+    """Build the sharded (ZeRO) optimizer state as *global* arrays: runs
+    ``optimizer.init`` inside a ``shard_map`` so each device holds only
+    its 1/dp shard, laid out by ``optimizer.state_partition_specs``."""
+    if mesh is None:
+        mesh = mesh_lib.get_mesh()
+    specs = optimizer.state_partition_specs(params)
+    init = cc.shard_over(
+        optimizer.init, mesh=mesh,
+        in_specs=(jax.tree_util.tree_map(lambda _: P(), params),),
+        out_specs=specs,
+    )
+    return jax.jit(init)(params)
+
+
+def zero_data_parallel_train_step(
+    loss_fn: Callable,
+    optimizer,
+    *,
+    mesh=None,
+    donate: bool = True,
+    microbatches: int = 1,
+):
+    """The shard_map ZeRO path: per-replica local grads feed a
+    ZeRO-sharded optimizer (``DistributedFusedAdam``/``LAMB``) whose
+    ``step`` reduce-scatters, steps the local shard, and all-gathers —
+    with ``microbatches > 1`` the local grads accumulate with **no
+    per-microbatch collective** and the single reduce-scatter folds into
+    the last microbatch (the reference's overlapped
+    ``_pipeline_block_reductions`` schedule, as program structure).
+
+    ``loss_fn(params, batch) -> scalar loss`` over one replica's batch
+    slice; batch enters sharded on the data axes (:func:`dp_shard_batch`),
+    params replicated, optimizer state sharded (:func:`zero_init`).
+    Returns ``step(params, opt_state, batch, lr=None) ->
+    (params, opt_state, loss)`` on global arrays.
+    """
+    if mesh is None:
+        mesh = mesh_lib.get_mesh()
+    dp_axes = tuple(a for a in (mesh_lib.DCN_AXIS, mesh_lib.DATA_AXIS)
+                    if a in mesh.shape)
+
+    grad_fn = grad_accumulation(
+        lambda p, b: jax.value_and_grad(loss_fn)(p, b), microbatches)
+
+    def per_shard(params, opt_state, batch, lr):
+        loss, grads = grad_fn(params, batch)
+        params, opt_state = optimizer.step(grads, opt_state, params, lr=lr)
+        loss = cc.all_reduce(loss, dp_axes, op="mean")
+        return params, opt_state, loss
+
+    def batch_spec(x):
+        return P(dp_axes, *([None] * (jnp.ndim(x) - 1)))
+
+    def step(params, opt_state, batch, lr=None):
+        param_specs = jax.tree_util.tree_map(lambda _: P(), params)
+        state_specs = optimizer.state_partition_specs(params)
+        in_specs = (param_specs, state_specs,
+                    jax.tree_util.tree_map(batch_spec, batch), P())
+        out_specs = (param_specs, state_specs, P())
+        lr_in = jnp.float32(optimizer.lr if lr is None else lr)
+        return cc.shard_over(
+            per_shard, mesh=mesh, in_specs=in_specs, out_specs=out_specs
+        )(params, opt_state, batch, lr_in)
 
     donate_argnums = (0, 1) if donate else ()
     return jax.jit(step, donate_argnums=donate_argnums)

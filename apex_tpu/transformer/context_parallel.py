@@ -40,6 +40,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from apex_tpu.parallel import collectives as cc
+
 from apex_tpu.ops.flash_attention import (
     dkv_chunk,
     dq_chunk,
@@ -63,7 +65,7 @@ def _merge(o, lse, o_new, lse_new):
 
 
 def _rotate(tree, axis):
-    n = lax.axis_size(axis)
+    n = cc.axis_size(axis)
     perm = [(i, (i + 1) % n) for i in range(n)]
     return jax.tree_util.tree_map(lambda l: lax.ppermute(l, axis, perm), tree)
 
@@ -109,7 +111,7 @@ def ring_attention(q, k, v, axis: str = CONTEXT_AXIS, causal: bool = True,
 
 
 def _ring_fwd_math(q, k, v, axis, causal, scale):
-    cp = lax.axis_size(axis)
+    cp = cc.axis_size(axis)
     r = lax.axis_index(axis)
     b, h, s_local, d = q.shape
     rep = _gqa_rep(q, k)
@@ -169,7 +171,7 @@ def _ring_vjp_fwd(q, k, v, axis, causal, scale):
 
 def _ring_vjp_bwd(axis, causal, scale, res, do):
     q, k, v, out, lse = res
-    cp = lax.axis_size(axis)
+    cp = cc.axis_size(axis)
     r = lax.axis_index(axis)
     rep = _gqa_rep(q, k)
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
@@ -237,7 +239,7 @@ def ulysses_attention(q, k, v, axis: str = CONTEXT_AXIS,
     the rank index is folded into the seed so different head groups draw
     different masks.
     """
-    cp = lax.axis_size(axis)
+    cp = cc.axis_size(axis)
     if q.shape[1] % cp != 0:
         raise ValueError(
             f"heads ({q.shape[1]}) must be divisible by cp ({cp})"

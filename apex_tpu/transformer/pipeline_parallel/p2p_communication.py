@@ -36,6 +36,8 @@ from typing import Any
 import jax
 from jax import lax
 
+from apex_tpu.parallel import collectives as cc
+
 from apex_tpu.parallel.mesh import PIPELINE_AXIS
 
 __all__ = [
@@ -66,7 +68,7 @@ def _perm_prev(n: int, ring: bool):
 
 
 def _shift(tree: Any, axis: str, forward: bool, ring: bool):
-    n = lax.axis_size(axis)
+    n = cc.axis_size(axis)
     perm = _perm_next(n, ring) if forward else _perm_prev(n, ring)
     return jax.tree_util.tree_map(
         lambda l: lax.ppermute(l, axis, perm), tree)

@@ -81,6 +81,8 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from apex_tpu.parallel import collectives as cc
+
 from apex_tpu.parallel.mesh import PIPELINE_AXIS, get_mesh
 
 __all__ = [
@@ -274,7 +276,7 @@ def pipeline_apply(
     """
     if mesh is None and not params_already_local:
         mesh = get_mesh()
-    pp = (lax.axis_size(axis) if params_already_local else mesh.shape[axis])
+    pp = (cc.axis_size(axis) if params_already_local else mesh.shape[axis])
     vpp = num_chunks
     period = pp * vpp
 

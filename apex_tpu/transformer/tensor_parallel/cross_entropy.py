@@ -34,6 +34,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from apex_tpu.parallel import collectives as cc
+
 from apex_tpu.parallel.mesh import TENSOR_AXIS
 from apex_tpu.transformer.tensor_parallel.utils import VocabUtility
 
@@ -63,7 +65,7 @@ def vocab_parallel_cross_entropy(
     """
     logits = jnp.asarray(logits, jnp.float32)
     vocab_local = logits.shape[-1]
-    world = 1 if axis is None else lax.axis_size(axis)
+    world = 1 if axis is None else cc.axis_size(axis)
     vocab_global = vocab_local * world
 
     # (1) numerically-stable shift by the global max (cross_entropy.py:37-41).

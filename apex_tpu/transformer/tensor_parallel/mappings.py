@@ -45,6 +45,8 @@ from __future__ import annotations
 import jax.numpy as jnp
 from jax import lax
 
+from apex_tpu.parallel import collectives as cc
+
 from apex_tpu.parallel.mesh import TENSOR_AXIS
 
 __all__ = [
@@ -61,7 +63,7 @@ __all__ = [
 def _split_local(x, axis_name: str, dim: int):
     """Keep this rank's chunk of ``x`` along ``dim`` —
     ``_split_along_{last,first}_dim`` (``mappings.py:45,63``)."""
-    n = lax.axis_size(axis_name)
+    n = cc.axis_size(axis_name)
     if n == 1:
         return x
     chunk = x.shape[dim] // n
@@ -95,7 +97,7 @@ def reduce_from_tensor_model_parallel_region(x, axis: str = TENSOR_AXIS):
     Reference ``reduce_from_tensor_model_parallel_region`` (``mappings.py:280``)
     — row-linear partial outputs summed to the full activation.
     """
-    if lax.axis_size(axis) == 1:
+    if cc.axis_size(axis) == 1:
         return x
     return lax.psum(x, axis)
 
@@ -114,7 +116,7 @@ def gather_from_tensor_model_parallel_region(x, axis: str = TENSOR_AXIS):
     Reference ``gather_from_tensor_model_parallel_region`` (``mappings.py:288``)
     — the ``gather_output=True`` path of column-parallel linear.
     """
-    if lax.axis_size(axis) == 1:
+    if cc.axis_size(axis) == 1:
         return x
     return lax.all_gather(x, axis, axis=x.ndim - 1, tiled=True)
 
@@ -141,7 +143,7 @@ def gather_from_sequence_parallel_region(
     accepted for parity and ignored.
     """
     del tensor_parallel_output_grad
-    if lax.axis_size(axis) == 1:
+    if cc.axis_size(axis) == 1:
         return x
     return lax.all_gather(x, axis, axis=0, tiled=True)
 
@@ -153,6 +155,6 @@ def reduce_scatter_to_sequence_parallel_region(x, axis: str = TENSOR_AXIS):
     (``mappings.py:300``) — the SP exit of row-parallel linear, replacing the
     all-reduce.
     """
-    if lax.axis_size(axis) == 1:
+    if cc.axis_size(axis) == 1:
         return x
     return lax.psum_scatter(x, axis, scatter_dimension=0, tiled=True)

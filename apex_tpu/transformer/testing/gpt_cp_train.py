@@ -102,7 +102,7 @@ def build_gpt_cp(
             h, params["embedding"]["word_embeddings"]["embedding"], cfg)
 
     def _local_loss(params, tokens_local):
-        cp = lax.axis_size(cp_axis)
+        cp = cc.axis_size(cp_axis)
         r = lax.axis_index(cp_axis)
         logits = _local_forward(params, tokens_local)  # [s_local, b, v]
 

@@ -23,6 +23,7 @@ import numpy as np
 __all__ = [
     "flatten_to_buffer",
     "unflatten_from_buffer",
+    "chunked_meta",
     "flatten_to_chunked",
     "unflatten_from_chunked",
     "chunked_per_leaf_max_abs",
@@ -106,7 +107,7 @@ class _ChunkMeta(NamedTuple):
 
 
 def flatten_to_chunked(
-    tree, chunk: int = 256, dtype=jnp.float32
+    tree, chunk: int = 256, dtype=jnp.float32, pad_rows_to: int = 1
 ) -> Tuple[jnp.ndarray, _ChunkMeta]:
     """Pack all leaves into one 2-D ``(rows, chunk)`` buffer, each leaf
     padded (with zeros) to a whole number of rows so **no row spans two
@@ -120,17 +121,22 @@ def flatten_to_chunked(
     scalars (see :func:`chunked_per_leaf_sumsq`) — and per-tensor scalars
     broadcast back as a ``(rows, 1)`` column, never a gather over
     elements.  ``meta.leaf_ids`` is a host-side ``np.int32`` constant of
-    one entry per row (~4 bytes per 1 KiB of fp32 state)."""
+    one entry per row (~4 bytes per 1 KiB of fp32 state).
+
+    ``pad_rows_to`` rounds the row count up to a multiple (ZeRO flat
+    buckets want shard- and bucket-divisible row counts, the TPU shape of
+    ``distributed_fused_adam.py:397``'s fixed-size StateBuckets).  Pad
+    rows hold zeros and carry the last leaf's id, so the segmented
+    reductions stay exact (zero contributes nothing to a sum, and
+    ``max|x|`` is already clamped at 0)."""
     leaves, treedef = jax.tree_util.tree_flatten(tree)
     shapes = tuple(tuple(np.shape(x)) for x in leaves)
     dtypes = tuple(jnp.asarray(x).dtype for x in leaves)
-    sizes = [int(np.prod(s)) for s in shapes]
-    rows_per_leaf = [(s + chunk - 1) // chunk for s in sizes]
-    row_offsets = tuple(int(x) for x in np.cumsum([0] + rows_per_leaf[:-1]))
-    n_rows = int(sum(rows_per_leaf))
-    leaf_ids = np.repeat(
-        np.arange(len(leaves), dtype=np.int32), rows_per_leaf)
+    meta = chunked_meta(treedef, shapes, dtypes, chunk=chunk,
+                        pad_rows_to=pad_rows_to)
     if leaves:
+        sizes = [int(np.prod(s)) for s in shapes]
+        rows_per_leaf = [(s + chunk - 1) // chunk for s in sizes]
         parts = []
         for x, size, rows in zip(leaves, sizes, rows_per_leaf):
             flat = jnp.ravel(jnp.asarray(x, dtype))
@@ -138,14 +144,39 @@ def flatten_to_chunked(
             if pad:
                 flat = jnp.pad(flat, (0, pad))
             parts.append(flat)
-        buf = jnp.concatenate(parts).reshape(max(n_rows, 1), chunk) \
-            if n_rows else jnp.zeros((0, chunk), dtype)
+        pad_rows = meta.n_rows - int(sum(rows_per_leaf))
+        if pad_rows:
+            parts.append(jnp.zeros((pad_rows * chunk,), dtype))
+        buf = jnp.concatenate(parts).reshape(max(meta.n_rows, 1), chunk) \
+            if meta.n_rows else jnp.zeros((0, chunk), dtype)
     else:
         buf = jnp.zeros((0, chunk), dtype)
-    meta = _ChunkMeta(treedef=treedef, shapes=shapes, dtypes=dtypes,
-                      row_offsets=row_offsets, n_rows=n_rows, chunk=chunk,
-                      leaf_ids=leaf_ids)
     return buf, meta
+
+
+def chunked_meta(treedef, shapes, dtypes, chunk: int = 256,
+                 pad_rows_to: int = 1) -> _ChunkMeta:
+    """Metadata-only half of :func:`flatten_to_chunked`: pure host math
+    from static shapes/dtypes, no arrays touched.  Lets layout planners
+    (ZeRO bucketing, checkpoint re-sharding) size buffers and build
+    segment ids without tracing a flatten they would throw away."""
+    sizes = [int(np.prod(s)) for s in shapes]
+    rows_per_leaf = [(s + chunk - 1) // chunk for s in sizes]
+    row_offsets = tuple(int(x) for x in np.cumsum([0] + rows_per_leaf[:-1]))
+    n_rows = int(sum(rows_per_leaf))
+    pad_rows = 0
+    if pad_rows_to > 1 and shapes:
+        pad_rows = -(-max(n_rows, 1) // pad_rows_to) * pad_rows_to - n_rows
+    leaf_ids = np.repeat(
+        np.arange(len(shapes), dtype=np.int32), rows_per_leaf)
+    if pad_rows:
+        leaf_ids = np.concatenate(
+            [leaf_ids,
+             np.full(pad_rows, max(len(shapes) - 1, 0), np.int32)])
+    return _ChunkMeta(treedef=treedef, shapes=tuple(shapes),
+                      dtypes=tuple(dtypes), row_offsets=row_offsets,
+                      n_rows=n_rows + pad_rows, chunk=chunk,
+                      leaf_ids=leaf_ids)
 
 
 def unflatten_from_chunked(buf: jnp.ndarray, meta: _ChunkMeta):
@@ -175,9 +206,12 @@ def chunked_per_leaf_sumsq(buf: jnp.ndarray, meta: _ChunkMeta) -> jnp.ndarray:
     one large kernel instead of one small reduction per tensor.  Padding
     rows contribute exactly zero.  Returns fp32 ``(n_leaves,)``."""
     row_sq = jnp.sum(jnp.square(buf.astype(jnp.float32)), axis=1)
+    # leaf_ids is non-decreasing by construction (rows are emitted leaf by
+    # leaf), so the segment reduction lowers to contiguous slices instead
+    # of a scatter — this is the optimizer hot path.
     return jax.ops.segment_sum(
         row_sq, jnp.asarray(meta.leaf_ids),
-        num_segments=len(meta.shapes))
+        num_segments=len(meta.shapes), indices_are_sorted=True)
 
 
 def chunked_per_leaf_max_abs(buf: jnp.ndarray, meta: _ChunkMeta
@@ -190,7 +224,7 @@ def chunked_per_leaf_max_abs(buf: jnp.ndarray, meta: _ChunkMeta
     row_max = jnp.max(jnp.abs(buf.astype(jnp.float32)), axis=1)
     out = jax.ops.segment_max(
         row_max, jnp.asarray(meta.leaf_ids),
-        num_segments=len(meta.shapes))
+        num_segments=len(meta.shapes), indices_are_sorted=True)
     # segment_max fills empty segments with -inf; zero-size leaves have no
     # rows, and |x| >= 0 everywhere, so clamp to 0
     return jnp.maximum(out, 0.0)

@@ -5,7 +5,9 @@ last JSON line.  Rounds 1-4 all delivered ``parsed: null`` because the
 full record line grew past the tail size.  These tests pin the fix: every
 emission ends with a compact line that (a) is <= 1500 bytes, (b) parses,
 (c) carries the driver contract fields, and (d) survives a simulated
-2000-byte tail even in the worst case (all nine rows verbose + embedded
+2000-byte tail even in the worst case (all eleven BENCH_ORDER rows
+verbose — including ``real_data_rn50`` with its ``vs_synthetic``
+composition and ``zero_adam_step`` with ``vs_per_leaf`` — + embedded
 prior TPU evidence).
 """
 
@@ -20,7 +22,10 @@ import bench  # noqa: E402
 
 
 def _worst_case_results():
-    """Nine rows, each fattened with prose fields, like a CPU-fallback day."""
+    """All eleven BENCH_ORDER rows, each fattened with prose fields, like
+    a CPU-fallback day — the REAL worst case (the pre-fix nine-row set
+    under-tested the <=1500-byte guarantee once ``real_data_rn50`` and
+    ``zero_adam_step`` landed)."""
     rows = {
         "resnet50_o2": {"value": 8824.6, "unit": "images/sec/chip"},
         "gpt_flash": {"value": 95167.3, "unit": "tokens/sec/chip",
@@ -32,9 +37,13 @@ def _worst_case_results():
         "tp_gpt": {"value": 761.9, "unit": "tokens/sec"},
         "fused_adam_step": {"value": 4777.5, "unit": "us/step",
                             "vs_native": 0.706},
+        "zero_adam_step": {"value": 359273.7, "unit": "us/step",
+                           "vs_per_leaf": 0.655},
         "gpt_flash_fp8": {"value": 4112.3, "unit": "tokens/sec/chip"},
         "gpt_long_context": {"value": 2580.7, "unit": "tokens/sec/chip"},
         "input_pipeline": {"value": 9685.0, "unit": "images/sec"},
+        "real_data_rn50": {"value": 6113.9, "unit": "images/sec/chip",
+                           "vs_synthetic": 0.693},
     }
     for r in rows.values():
         r["platform"] = "cpu"
@@ -67,6 +76,8 @@ def test_compact_record_under_1500_bytes():
     # Per-row essentials survive the distillation.
     assert compact["rows"]["gpt_flash"]["mfu"] == 0.4155
     assert compact["rows"]["fused_adam_step"]["vs_native"] == 0.706
+    assert compact["rows"]["real_data_rn50"]["vs_synthetic"] == 0.693
+    assert compact["rows"]["zero_adam_step"]["vs_per_leaf"] == 0.655
 
 
 def test_compact_record_degrades_instead_of_overflowing():

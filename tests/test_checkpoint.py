@@ -118,11 +118,14 @@ def test_restore_mismatch_raises(tmp_path):
         restore_checkpoint(str(tmp_path / "c.npz"), {"a": jnp.ones((3,))})
 
 
+@pytest.mark.parametrize("flat_bucket", [True, False])
 @pytest.mark.parametrize("remainders", [False, True])
-def test_zero_state_gather_scatter(remainders):
+def test_zero_state_gather_scatter(remainders, flat_bucket):
     """Portable ZeRO state: gather -> full fp32 per-param state; scatter
     back -> bitwise-identical sharded state; resumed sharded training
-    matches uninterrupted training exactly."""
+    matches uninterrupted training exactly.  Runs for both state layouts
+    (flat-bucket buffers and per-leaf chunks) — the portable format is
+    layout-independent."""
     mesh = parallel.initialize_model_parallel()  # dp=8
     try:
         dtype = jnp.bfloat16 if remainders else jnp.float32
@@ -135,7 +138,8 @@ def test_zero_state_gather_scatter(remainders):
             "b": jax.random.normal(jax.random.PRNGKey(3), (8,)),
         }
         opt = DistributedFusedAdam(lr=1e-2,
-                                   store_param_remainders=remainders)
+                                   store_param_remainders=remainders,
+                                   flat_bucket=flat_bucket, n_buckets=2)
 
         def train(params, grads, steps):
             def local(p, g):
@@ -145,14 +149,7 @@ def test_zero_state_gather_scatter(remainders):
                 return p, state
             return local
 
-        from apex_tpu.optimizers._common import OptState
-
-        chunk_spec = jax.tree_util.tree_map(lambda _: P("dp"), params)
-        state_specs = OptState(
-            step=P(),
-            slots={"exp_avg": chunk_spec, "exp_avg_sq": chunk_spec},
-            master=chunk_spec,
-        )
+        state_specs = opt.state_partition_specs(params)
 
         p1, s1 = cc.shard_over(
             train(params, grads, 2), in_specs=(P(), P()),

@@ -1,0 +1,362 @@
+"""Flat-bucket ZeRO numerics: the bucketed exchange must be a pure
+re-plumbing of the per-leaf port.
+
+Parity chain (each link within fp32 fusion noise):
+
+    flat-bucket ZeRO step  ==  per-leaf ZeRO step  ==  replicated
+    FusedAdam/FusedLAMB on the mean gradients
+
+exercised on the virtual 8-device host mesh, on a 2x2 ``(dcn, dp)`` mesh
+through the hierarchical ICI/DCN reduction, and through
+``zero_data_parallel_train_step`` with gradient accumulation N > 1
+(reduce-scatter folded into the last microbatch).  Mirrors
+``apex/contrib/test/optimizers/test_dist_adam.py`` and the bucketed
+``StateBucket`` layout of ``distributed_fused_adam.py:397``.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu import parallel
+from apex_tpu.contrib.optimizers import (
+    DistributedFusedAdam,
+    DistributedFusedLAMB,
+)
+from apex_tpu.optimizers import FusedAdam, FusedLAMB
+from apex_tpu.parallel import (
+    collectives as cc,
+    dp_shard_batch,
+    grad_accumulation,
+    replicate,
+    zero_data_parallel_train_step,
+    zero_init,
+)
+
+
+def make_params(key, dtype=jnp.float32):
+    ks = jax.random.split(key, 3)
+    return {
+        "w": jax.random.normal(ks[0], (13, 7), dtype),   # 91 elems: pad path
+        "b": jax.random.normal(ks[1], (8,), dtype),
+        "e": jax.random.normal(ks[2], (4, 4, 2), dtype),
+    }
+
+
+def per_rank_grads(params, key, n):
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    return [jax.tree_util.tree_unflatten(treedef, [
+        jax.random.normal(jax.random.fold_in(key, r * 1000 + i), leaf.shape)
+        for i, leaf in enumerate(leaves)
+    ]) for r in range(n)]
+
+
+def run_sharded(opt, params, grads_by_rank, steps=3, rank_fn=None,
+                **step_kw):
+    """Each replica steps with its own grads; returns final params."""
+    grads_stacked = jax.tree_util.tree_map(
+        lambda *ls: jnp.stack(ls), *grads_by_rank)
+
+    def local(params, gs):
+        r = rank_fn() if rank_fn is not None else cc.axis_index("dp")
+        g = jax.tree_util.tree_map(
+            lambda l: jax.lax.dynamic_index_in_dim(l, r, 0, keepdims=False),
+            gs)
+        state = opt.init(params)
+        p = params
+        for _ in range(steps):
+            p, state = opt.step(g, state, p, **step_kw)
+        return p
+
+    return cc.shard_over(
+        local, in_specs=(P(), P()), out_specs=P())(params, grads_stacked)
+
+
+def run_replicated(opt, params, grads_by_rank, steps=3):
+    mean_g = jax.tree_util.tree_map(
+        lambda *ls: sum(ls) / len(grads_by_rank), *grads_by_rank)
+    state = opt.init(params)
+    p = params
+    for _ in range(steps):
+        p, state = opt.step(mean_g, state, p)
+    return p
+
+
+def assert_tree_close(a, b, rtol=1e-5, atol=1e-6):
+    for x, y in zip(jax.tree_util.tree_leaves(a),
+                    jax.tree_util.tree_leaves(b)):
+        np.testing.assert_allclose(np.asarray(x, np.float32),
+                                   np.asarray(y, np.float32),
+                                   rtol=rtol, atol=atol)
+
+
+# ---------------------------------------------------------------------------
+# Fast tier: collective primitives + the accumulation transform (no
+# multi-step shard_map compiles)
+# ---------------------------------------------------------------------------
+
+
+def test_hierarchical_reduce_scatter_matches_flat():
+    """RS(ICI dp) + shard all-reduce(DCN) == one flat RS over (dcn, dp),
+    after gathering back: both are the full cross-replica sum."""
+    mesh = parallel.initialize_model_parallel(
+        dcn_data_parallel_size=2, devices=jax.devices()[:4])
+    x = jnp.arange(4 * 8 * 4, dtype=jnp.float32).reshape(4, 32)
+
+    def hier(x):
+        r = cc.axis_index("dcn") * cc.axis_size("dp") + cc.axis_index("dp")
+        mine = jax.lax.dynamic_index_in_dim(x, r, 0, keepdims=False)
+        shard = cc.hierarchical_reduce_scatter(mine, "dp", "dcn")
+        return cc.hierarchical_all_gather(shard, "dp")
+
+    def flat(x):
+        r = cc.axis_index("dcn") * cc.axis_size("dp") + cc.axis_index("dp")
+        mine = jax.lax.dynamic_index_in_dim(x, r, 0, keepdims=False)
+        shard = cc.hierarchical_reduce_scatter(mine, ("dcn", "dp"), None)
+        return cc.all_gather(shard, ("dcn", "dp"))
+
+    out_h = cc.shard_over(hier, mesh=mesh, in_specs=P(), out_specs=P())(x)
+    out_f = cc.shard_over(flat, mesh=mesh, in_specs=P(), out_specs=P())(x)
+    ref = np.asarray(x).sum(axis=0)
+    np.testing.assert_allclose(np.asarray(out_h), ref, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(out_f), ref, rtol=1e-6)
+
+
+def test_hierarchical_outer_noop_on_single_slice():
+    """outer_axis on a size-1 dcn axis must be a no-op (the 'correct at
+    any scale' default)."""
+    mesh = parallel.initialize_model_parallel()  # dcn=1, dp=8
+    x = jnp.ones((8, 16), jnp.float32)
+
+    def f(x):
+        mine = x  # same on every rank: in_specs P() replicates
+        return cc.hierarchical_reduce_scatter(mine[0], "dp", "dcn")
+
+    out = cc.shard_over(f, mesh=mesh, in_specs=P(), out_specs=P("dp"))(x)
+    np.testing.assert_allclose(np.asarray(out), 8.0)
+
+
+def test_grad_accumulation_transform_matches_full_batch():
+    """grad_accumulation(grad_fn, N) == grad_fn on the whole batch for a
+    mean loss (no mesh needed; N=4 microbatches)."""
+    rng = np.random.RandomState(0)
+    params = {"w": jnp.asarray(rng.randn(6, 3).astype(np.float32))}
+    X = jnp.asarray(rng.randn(16, 6).astype(np.float32))
+    Y = jnp.asarray(rng.randn(16, 3).astype(np.float32))
+
+    def loss_fn(p, batch):
+        x, y = batch
+        return jnp.mean((x @ p["w"] - y) ** 2)
+
+    grad_fn = lambda p, b: jax.value_and_grad(loss_fn)(p, b)
+    loss_full, g_full = grad_fn(params, (X, Y))
+    loss_acc, g_acc = grad_accumulation(grad_fn, 4)(params, (X, Y))
+    np.testing.assert_allclose(float(loss_acc), float(loss_full), rtol=1e-6)
+    assert_tree_close(g_acc, g_full, rtol=1e-5, atol=1e-6)
+    # indivisible batch is a loud error, not silent truncation
+    with pytest.raises(ValueError, match="divisible"):
+        grad_accumulation(grad_fn, 3)(params, (X, Y))
+
+
+def test_state_partition_specs_structure():
+    """Spec tree mirrors init's state structure in both layouts."""
+    params = {"w": jnp.ones((13, 7)),
+              "h": jnp.ones((8,), jnp.bfloat16)}
+    flat = DistributedFusedAdam(n_buckets=2)
+    specs = flat.state_partition_specs(params)
+    assert specs.step == P()
+    # two dtype-groups x two buckets
+    assert len(specs.master) == 2
+    assert all(len(bufs) == 2 and all(s == P("dp") for s in bufs)
+               for bufs in specs.master)
+    leafy = DistributedFusedAdam(flat_bucket=False)
+    specs = leafy.state_partition_specs(params)
+    assert specs.slots["exp_avg"]["w"] == P("dp")
+
+
+# ---------------------------------------------------------------------------
+# Slow tier: full numeric-parity chain on the 8-device mesh
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("n_buckets", [1, 3])
+def test_flat_bucket_adam_parity(n_buckets):
+    """flat-bucket ZeRO == per-leaf ZeRO == replicated FusedAdam."""
+    parallel.initialize_model_parallel()
+    params = make_params(jax.random.PRNGKey(0))
+    grads = per_rank_grads(params, jax.random.PRNGKey(1), 8)
+    ref = run_replicated(
+        FusedAdam(lr=1e-2, weight_decay=0.01, master_weights=True),
+        params, grads)
+    flat = run_sharded(
+        DistributedFusedAdam(lr=1e-2, weight_decay=0.01,
+                             n_buckets=n_buckets),
+        params, grads)
+    assert_tree_close(flat, ref)
+    if n_buckets == 1:
+        leaf = run_sharded(
+            DistributedFusedAdam(lr=1e-2, weight_decay=0.01,
+                                 flat_bucket=False),
+            params, grads)
+        assert_tree_close(flat, leaf, rtol=1e-6, atol=1e-7)
+
+
+@pytest.mark.slow
+def test_flat_bucket_adam_mixed_dtype_groups():
+    """bf16 + fp32 leaves split into dtype-groups; params keep their
+    dtypes through the bucketed gather."""
+    parallel.initialize_model_parallel()
+    params = make_params(jax.random.PRNGKey(2))
+    params["h"] = jax.random.normal(
+        jax.random.PRNGKey(3), (9, 3)).astype(jnp.bfloat16)
+    grads = per_rank_grads(params, jax.random.PRNGKey(4), 8)
+    a = run_sharded(DistributedFusedAdam(lr=1e-2, weight_decay=0.01),
+                    params, grads)
+    b = run_sharded(DistributedFusedAdam(lr=1e-2, weight_decay=0.01,
+                                         flat_bucket=False),
+                    params, grads)
+    assert_tree_close(a, b, rtol=1e-4, atol=1e-4)
+    for k in params:
+        assert a[k].dtype == params[k].dtype
+
+
+@pytest.mark.slow
+def test_flat_bucket_lamb_parity():
+    """flat-bucket ZeRO LAMB (segmented trust-ratio norms) == per-leaf
+    ZeRO LAMB == replicated FusedLAMB, incl. the global-norm clip."""
+    parallel.initialize_model_parallel()
+    params = make_params(jax.random.PRNGKey(6))
+    grads = per_rank_grads(params, jax.random.PRNGKey(7), 8)
+    ref = run_replicated(
+        FusedLAMB(lr=1e-2, weight_decay=0.01, master_weights=True),
+        params, grads)
+    flat = run_sharded(DistributedFusedLAMB(lr=1e-2, weight_decay=0.01),
+                       params, grads)
+    assert_tree_close(flat, ref)
+    leaf = run_sharded(
+        DistributedFusedLAMB(lr=1e-2, weight_decay=0.01,
+                             flat_bucket=False),
+        params, grads)
+    assert_tree_close(flat, leaf, rtol=1e-6, atol=1e-6)
+    # tiny max_grad_norm: the clip engages and still matches per-leaf
+    a = run_sharded(DistributedFusedLAMB(lr=1e-2, max_grad_norm=0.5),
+                    params, grads)
+    b = run_sharded(DistributedFusedLAMB(lr=1e-2, max_grad_norm=0.5,
+                                         flat_bucket=False),
+                    params, grads)
+    assert_tree_close(a, b, rtol=2e-6, atol=2e-6)
+
+
+@pytest.mark.slow
+def test_hierarchical_2x2_parity():
+    """2x2 (dcn, dp) mesh: hierarchical reduction (RS over ICI dp +
+    shard all-reduce over DCN) == flat reduction over the combined axis
+    == replicated FusedAdam on the 4-replica mean grads."""
+    parallel.initialize_model_parallel(
+        dcn_data_parallel_size=2, devices=jax.devices()[:4])
+    params = make_params(jax.random.PRNGKey(8))
+    grads = per_rank_grads(params, jax.random.PRNGKey(9), 4)
+    ref = run_replicated(
+        FusedAdam(lr=1e-2, weight_decay=0.01, master_weights=True),
+        params, grads)
+
+    def rank_fn():
+        return cc.axis_index("dcn") * cc.axis_size("dp") \
+            + cc.axis_index("dp")
+
+    hier = run_sharded(
+        DistributedFusedAdam(lr=1e-2, weight_decay=0.01),  # outer="dcn"
+        params, grads, rank_fn=rank_fn)
+    assert_tree_close(hier, ref)
+    flat = run_sharded(
+        DistributedFusedAdam(lr=1e-2, weight_decay=0.01,
+                             axis=("dcn", "dp"), outer_axis=None),
+        params, grads, rank_fn=rank_fn)
+    assert_tree_close(hier, flat, rtol=1e-5, atol=1e-6)
+    # bf16 DCN wire: same update within bf16 wire noise
+    bf16_wire = run_sharded(
+        DistributedFusedAdam(lr=1e-2, weight_decay=0.01,
+                             dcn_reduce_dtype=jnp.bfloat16),
+        params, grads, rank_fn=rank_fn)
+    assert_tree_close(bf16_wire, ref, rtol=5e-2, atol=5e-2)
+
+
+@pytest.mark.slow
+def test_zero_checkpoint_roundtrip_hierarchical():
+    """gather/scatter_zero_state on a (dcn=2, dp=2) mesh: bitwise
+    round-trip of bucketed state.  Regression: eager jnp ops on the
+    dp-sharded (dcn-replicated) shard_map outputs used to SUM the
+    replicated dim in the gather concat (values doubled by the dcn
+    size); the gather is numpy-first now."""
+    from apex_tpu.checkpoint import gather_zero_state, scatter_zero_state
+
+    mesh = parallel.initialize_model_parallel(
+        dcn_data_parallel_size=2, devices=jax.devices()[:4])
+    params = make_params(jax.random.PRNGKey(12))
+    grads = {k: jnp.ones_like(v) for k, v in params.items()}
+    opt = DistributedFusedAdam(lr=1e-2, n_buckets=2)
+    specs = opt.state_partition_specs(params)
+
+    def local(p, g):
+        s = opt.init(p)
+        return opt.step(g, s, p)
+
+    p2, s2 = cc.shard_over(
+        local, mesh=mesh,
+        in_specs=(P(), P()),
+        out_specs=(P(), specs))(params, grads)
+    portable = gather_zero_state(opt, s2, p2)
+    # grads identical on all 4 replicas -> mean grad 1 -> exp_avg exactly
+    # (1 - beta1); a replicated-dim double-count would read 2x that
+    ea = np.asarray(portable["slots"]["exp_avg"]["b"])
+    np.testing.assert_allclose(ea, 0.1, rtol=1e-6)
+    resharded = scatter_zero_state(opt, portable, s2, p2)
+    for a, b in zip(jax.tree_util.tree_leaves(s2),
+                    jax.tree_util.tree_leaves(resharded)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+@pytest.mark.slow
+def test_grad_accumulation_train_step_parity():
+    """zero_data_parallel_train_step with microbatches=2 == microbatches=1
+    == replicated FusedAdam pjit path, on the same total batch."""
+    mesh = parallel.initialize_model_parallel()
+    rng = np.random.RandomState(1)
+    w0 = rng.randn(4, 2).astype(np.float32)
+    X = rng.randn(64, 4).astype(np.float32)
+    Y = (X @ rng.randn(4, 2)).astype(np.float32)
+
+    def loss_fn(p, batch):
+        x, y = batch
+        return jnp.mean((x @ p["w"] - y) ** 2)
+
+    batch = dp_shard_batch((jnp.asarray(X), jnp.asarray(Y)), mesh)
+
+    def train_zero(microbatches):
+        opt = DistributedFusedAdam(lr=0.05)
+        p = replicate({"w": jnp.asarray(w0)}, mesh)
+        s = zero_init(opt, p, mesh)
+        step = zero_data_parallel_train_step(
+            loss_fn, opt, mesh=mesh, donate=False,
+            microbatches=microbatches)
+        for _ in range(5):
+            p, s, loss = step(p, s, batch)
+        return p, float(loss)
+
+    p1, l1 = train_zero(1)
+    p2, l2 = train_zero(2)
+    assert_tree_close(p1, p2, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(l1, l2, rtol=1e-5)
+
+    from apex_tpu.parallel import data_parallel_train_step
+
+    opt = FusedAdam(lr=0.05)
+    p = replicate({"w": jnp.asarray(w0)}, mesh)
+    s = replicate(opt.init(p), mesh)
+    step = data_parallel_train_step(loss_fn, opt, mesh=mesh, donate=False)
+    for _ in range(5):
+        p, s, _ = step(p, s, batch)
+    assert_tree_close(p1, p, rtol=1e-5, atol=1e-6)
