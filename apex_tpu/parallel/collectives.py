@@ -32,6 +32,7 @@ __all__ = [
     "hierarchical_reduce_scatter",
     "hierarchical_all_gather",
     "ppermute",
+    "ring_chunks",
     "all_to_all",
     "broadcast",
     "axis_index",
@@ -175,6 +176,29 @@ def ppermute(x, axis: AxisName, perm):
     """Point-to-point permutation — the p2p send/recv analog
     (``apex/transformer/pipeline_parallel/p2p_communication.py:48-166``)."""
     return lax.ppermute(x, axis, perm)
+
+
+def ring_chunks(x, axis: Union[AxisName, int], dim: int = 0):
+    """View ``x`` with dimension ``dim`` split into the axis's per-rank
+    chunks, chunk index leading: ``[..., n*c, ...] -> [n, ..., c, ...]``.
+
+    Chunk ``i`` is rank ``i``'s shard of ``dim`` (the tiled all-gather /
+    reduce-scatter layout), which is exactly the order ring-decomposed
+    collectives walk one ``ppermute`` hop at a time — the collective-matmul
+    rings (:mod:`apex_tpu.transformer.tensor_parallel.overlap`) index these
+    chunks with ``lax.dynamic_index_in_dim`` at a traced rank offset.
+    ``axis`` may be a bound mesh axis name or an explicit chunk count.
+    """
+    n = axis if isinstance(axis, int) else _axis_size(axis)
+    dim = dim % x.ndim
+    if x.shape[dim] % n:
+        raise ValueError(
+            f"dimension {dim} of size {x.shape[dim]} not divisible into "
+            f"{n} ring chunks"
+        )
+    c = x.shape[dim] // n
+    split = x.reshape(x.shape[:dim] + (n, c) + x.shape[dim + 1:])
+    return jnp.moveaxis(split, dim, 0)
 
 
 def send_recv_next(x, axis: AxisName):

@@ -1,3 +1,4 @@
-"""Test/validation harnesses (L1 stored-baseline traces)."""
+"""Test/validation harnesses (L1 stored-baseline traces, compiled-HLO
+inspection)."""
 
-from apex_tpu.testing import l1  # noqa: F401
+from apex_tpu.testing import hlo, l1  # noqa: F401

@@ -14,6 +14,10 @@ from apex_tpu.transformer.tensor_parallel.layers import (
     linear_with_grad_accumulation,
     parallel_init,
 )
+from apex_tpu.transformer.tensor_parallel.overlap import (
+    gather_matmul,
+    matmul_scatter,
+)
 from apex_tpu.transformer.tensor_parallel.partition import (
     DEFAULT_RULES,
     infer_param_specs,
@@ -52,6 +56,8 @@ __all__ = [
     "VocabParallelEmbedding",
     "linear_with_grad_accumulation",
     "parallel_init",
+    "gather_matmul",
+    "matmul_scatter",
     "copy_to_tensor_model_parallel_region",
     "gather_from_sequence_parallel_region",
     "gather_from_tensor_model_parallel_region",

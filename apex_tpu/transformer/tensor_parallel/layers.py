@@ -91,6 +91,7 @@ def linear_with_grad_accumulation(
     sequence_parallel: bool = False,
     axis: Optional[str] = TENSOR_AXIS,
     fp8_metas=None,
+    overlap_comm: bool = False,
 ):
     """``y = x @ w.T + b`` with optional SP all-gather of ``x``.
 
@@ -104,10 +105,24 @@ def linear_with_grad_accumulation(
     ``fp8_metas``: ``{"x": Fp8Meta, "w": Fp8Meta}`` — route the GEMM
     through :func:`apex_tpu.amp.fp8.fp8_matmul_t` (e4m3 operands, delayed
     scaling; e5m2 just-in-time cotangent).  The caller rolls the metas.
+
+    ``overlap_comm``: replace the monolithic SP all-gather + GEMM with the
+    ring-decomposed collective matmul
+    (:func:`~apex_tpu.transformer.tensor_parallel.overlap.gather_matmul` —
+    each ICI hop travels under a partial GEMM, forward and backward).
     """
     if sequence_parallel:
         if axis is None:
             raise ValueError("sequence_parallel requires a tensor axis")
+        if overlap_comm:
+            from apex_tpu.transformer.tensor_parallel.overlap import (
+                gather_matmul,
+            )
+
+            y = gather_matmul(x, weight, axis, fp8_metas=fp8_metas)
+            if bias is not None:
+                y = y + bias
+            return y
         x = mappings.gather_from_sequence_parallel_region(
             x, axis, True
         )
@@ -246,6 +261,11 @@ class ColumnParallelLinear(nn.Module, _Fp8MetaMixin):
     dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
     fp8: bool = False  # e4m3/e5m2 GEMM with delayed scaling (fp8_matmul_t)
+    # Ring-decomposed collective matmul: pipeline the SP all-gather under
+    # partial GEMMs (overlap.gather_matmul).  Only affects the
+    # sequence_parallel path — without SP there is no forward collective
+    # on this layer to decompose.
+    overlap_comm: bool = False
 
     @nn.compact
     def __call__(self, x):
@@ -281,6 +301,7 @@ class ColumnParallelLinear(nn.Module, _Fp8MetaMixin):
             sequence_parallel=self.sequence_parallel and world > 1,
             axis=shard_axis,
             fp8_metas=None if fp8_metas is None else fp8_metas.value,
+            overlap_comm=self.overlap_comm,
         )
         if fp8_metas is not None:
             self._fp8_roll(fp8_metas, x, weight, world > 1)
@@ -321,6 +342,11 @@ class RowParallelLinear(nn.Module, _Fp8MetaMixin):
     dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
     fp8: bool = False  # e4m3/e5m2 GEMM with delayed scaling (fp8_matmul_t)
+    # Ring-decomposed collective matmul: compute the SP reduce-scatter as
+    # traveling partial-GEMM sums (overlap.matmul_scatter).  Only affects
+    # the sequence_parallel path — the non-SP all-reduce exit is left to
+    # XLA's own scheduling.
+    overlap_comm: bool = False
 
     @nn.compact
     def __call__(self, x):
@@ -352,21 +378,31 @@ class RowParallelLinear(nn.Module, _Fp8MetaMixin):
                 )
             x = mappings.scatter_to_tensor_model_parallel_region(x, self.axis)
         fp8_metas = self._fp8_metas() if self.fp8 else None
-        y = linear_with_grad_accumulation(
-            x, weight, None, sequence_parallel=False, axis=shard_axis,
-            fp8_metas=None if fp8_metas is None else fp8_metas.value,
-        )
+        metas_val = None if fp8_metas is None else fp8_metas.value
+        if self.sequence_parallel and self.overlap_comm and world > 1:
+            # GEMM + reduce-scatter as one ring: partial sums travel the
+            # ICI hops under the next partial GEMM (overlap.matmul_scatter)
+            from apex_tpu.transformer.tensor_parallel.overlap import (
+                matmul_scatter,
+            )
+
+            y = matmul_scatter(x, weight, self.axis, fp8_metas=metas_val)
+        else:
+            y = linear_with_grad_accumulation(
+                x, weight, None, sequence_parallel=False, axis=shard_axis,
+                fp8_metas=metas_val,
+            )
+            if world > 1:
+                if self.sequence_parallel:
+                    y = mappings.reduce_scatter_to_sequence_parallel_region(
+                        y, self.axis
+                    )
+                else:
+                    y = mappings.reduce_from_tensor_model_parallel_region(
+                        y, self.axis
+                    )
         if fp8_metas is not None:
             self._fp8_roll(fp8_metas, x, weight, world > 1)
-        if world > 1:
-            if self.sequence_parallel:
-                y = mappings.reduce_scatter_to_sequence_parallel_region(
-                    y, self.axis
-                )
-            else:
-                y = mappings.reduce_from_tensor_model_parallel_region(
-                    y, self.axis
-                )
         if self.skip_bias_add:
             return y, bias
         if bias is not None:

@@ -1,0 +1,257 @@
+"""Ring-decomposed collective matmul: overlap TP/SP collectives with GEMMs.
+
+The monolithic sequence-parallel layers serialize communication against
+computation: :class:`ColumnParallelLinear` all-gathers the sequence shards
+*then* runs its GEMM, and :class:`RowParallelLinear` runs its GEMM *then*
+reduce-scatters — ICI sits idle during the MXU work and vice versa.  This
+module decomposes both into ``tp``-step rings so every step's
+``collective-permute`` (one ICI neighbor hop) travels *under* a partial GEMM
+the step does not depend on — the collective-matmul schedule of veScale
+(arxiv 2509.07003) and TorchTitan's async TP (arxiv 2410.06511), and the
+same overlap-first philosophy the ZeRO bucket pipeline applies on the data
+axis.
+
+- :func:`gather_matmul` computes ``all_gather(x, dim=0) @ w.T`` without ever
+  materializing a monolithic all-gather: each step matmuls the
+  currently-held sequence chunk against the full local weight shard while
+  ``lax.ppermute`` rotates the next chunk one hop closer.
+- :func:`matmul_scatter` computes ``reduce_scatter(x @ w.T, dim=0)`` as the
+  transposed ring: each step adds one partial GEMM into an accumulator that
+  travels the ring toward its home rank.
+
+Both carry a custom VJP whose backward is the *matching transposed ring*
+(``gather_matmul``'s input grad is a ``matmul_scatter``-shaped ring and vice
+versa) rather than a monolithic collective, so the overlap survives
+differentiation.  Per-chunk operand/cotangent products are pulled through
+``jax.vjp`` of the underlying GEMM core, so the fp8 delayed-scaling path
+(:func:`apex_tpu.amp.fp8.fp8_matmul_t` — e4m3 operands, e5m2 just-in-time
+cotangents) composes without re-deriving its quantization math here; the
+unused half of each pulled-back pair is dead-code-eliminated under jit.
+
+Chunk bookkeeping: rank ``r`` starts holding chunk ``r``; rotation receives
+from rank ``r+1``, so after ``t`` hops rank ``r`` holds chunk ``(r+t) % n``
+(:func:`apex_tpu.parallel.collectives.ring_chunks` is the matching split).
+The rings are Python-unrolled — ``tp`` is small and static — so the
+compiled HLO carries ``n-1`` distinct ``collective-permute`` ops for XLA's
+latency-hiding scheduler to sink under the neighboring dots
+(:mod:`apex_tpu.testing.hlo` counts them; ``tests/test_tensor_parallel.py``
+asserts the decomposition survives jit).
+
+All functions run inside ``shard_map`` with ``axis`` bound, like the rest of
+:mod:`~apex_tpu.transformer.tensor_parallel.mappings`.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from apex_tpu.parallel import collectives as cc
+from apex_tpu.parallel.mesh import TENSOR_AXIS
+
+__all__ = ["gather_matmul", "matmul_scatter"]
+
+
+def _mm(x, w, metas):
+    """The local GEMM core: ``x @ w.T`` (torch weight layout), routed
+    through the fp8 delayed-scaling GEMM when metas are supplied."""
+    if metas is None:
+        return jnp.matmul(x, w.T)
+    from apex_tpu.amp.fp8 import fp8_matmul_t
+
+    return fp8_matmul_t(x, w, metas["x"], metas["w"])
+
+
+def _mm_dx(g, x, w, metas):
+    """Input cotangent of one chunk's ``_mm`` (``g @ w`` for the plain
+    core; the e5m2 pullback for fp8).  The sibling weight-grad GEMM inside
+    the pulled-back VJP is unused and DCE'd."""
+    _, pull = jax.vjp(lambda xx: _mm(xx, w, metas), x)
+    return pull(g)[0]
+
+
+def _mm_dw(g, x, w, metas):
+    """Weight cotangent of one chunk's ``_mm`` (``g.T @ x`` shaped
+    ``[out, in]`` for the plain core)."""
+    _, pull = jax.vjp(lambda ww: _mm(x, ww, metas), w)
+    return pull(g)[0]
+
+
+# Ring hops reuse the pipeline p2p helpers: cc.send_recv_prev receives
+# from rank+1 (the held chunk index increases by one — the gather rings),
+# cc.send_recv_next sends to rank+1 (the traveling-accumulator hop of the
+# reduce-scatter rings).
+
+
+def _gather_matmul_ring(x, w, metas, axis):
+    """``all_gather(x, dim=0) @ w.T`` as an unrolled ring.
+
+    Step ``t``: rank ``r`` holds chunk ``c = (r+t) % n``; the next chunk's
+    ppermute is issued alongside the current chunk's GEMM (no data
+    dependence between them — XLA overlaps the hop under the dot)."""
+    n = cc.axis_size(axis)
+    r = lax.axis_index(axis)
+    cur, parts = x, []
+    for t in range(n):
+        nxt = cc.send_recv_prev(cur, axis) if t < n - 1 else None
+        parts.append(((r + t) % n, _mm(cur, w, metas)))
+        cur = nxt
+    out = jnp.zeros((n,) + parts[0][1].shape, parts[0][1].dtype)
+    for c, p in parts:
+        out = lax.dynamic_update_index_in_dim(out, p, c, 0)
+    return out.reshape((n * x.shape[0],) + out.shape[2:])
+
+
+def _matmul_scatter_ring(x, w, metas, axis):
+    """``reduce_scatter(x @ w.T, dim=0)`` as an unrolled ring.
+
+    The accumulator travels toward rank+1; at step ``t`` rank ``r`` holds
+    the partial sum destined for chunk ``d = (r + n-1-t) % n`` and adds its
+    local partial GEMM for that chunk — after the remaining ``n-1-t`` hops
+    the sum lands home with every rank's contribution folded in.  The hop
+    is issued before the GEMM it overlaps with (the GEMM reads only local
+    data)."""
+    n = cc.axis_size(axis)
+    r = lax.axis_index(axis)
+    xc = cc.ring_chunks(x, n, 0)
+    acc = None
+    for t in range(n):
+        if t:
+            acc = cc.send_recv_next(acc, axis)
+        d = (r + n - 1 - t) % n
+        part = _mm(lax.dynamic_index_in_dim(xc, d, 0, keepdims=False),
+                   w, metas)
+        acc = part if acc is None else acc + part
+    return acc
+
+
+# --- gather_matmul -------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _gather_matmul(axis, x, w, metas):
+    return _gather_matmul_ring(x, w, metas, axis)
+
+
+def _gather_matmul_fwd(axis, x, w, metas):
+    return _gather_matmul_ring(x, w, metas, axis), (x, w, metas)
+
+
+def _gather_matmul_bwd(axis, res, dy):
+    """Transposed rings, no monolithic collective.
+
+    ``dx`` is the matmul-scatter ring over the (local) cotangent chunks:
+    rank ``r``'s weight shard contributes ``dmm_x(dy_d)`` to every sequence
+    chunk ``d``, and the partial sums travel home.  ``dw`` re-rotates the
+    saved activation chunks (the forward's ring, re-driven — each rank's
+    cotangent is local, so its weight grad needs no cross-rank reduction).
+    """
+    x, w, metas = res
+    n = cc.axis_size(axis)
+    r = lax.axis_index(axis)
+    dyc = cc.ring_chunks(dy, n, 0)
+
+    acc = None
+    for t in range(n):
+        if t:
+            acc = cc.send_recv_next(acc, axis)
+        d = (r + n - 1 - t) % n
+        g_d = lax.dynamic_index_in_dim(dyc, d, 0, keepdims=False)
+        part = _mm_dx(g_d, x, w, metas)
+        acc = part if acc is None else acc + part
+    dx = acc
+
+    cur, dw = x, None
+    for t in range(n):
+        c = (r + t) % n
+        nxt = cc.send_recv_prev(cur, axis) if t < n - 1 else None
+        g_c = lax.dynamic_index_in_dim(dyc, c, 0, keepdims=False)
+        part = _mm_dw(g_c, cur, w, metas)
+        dw = part if dw is None else dw + part
+        cur = nxt
+    return dx, dw, None
+
+
+_gather_matmul.defvjp(_gather_matmul_fwd, _gather_matmul_bwd)
+
+
+def gather_matmul(x, w, axis: Optional[str] = TENSOR_AXIS, *, fp8_metas=None):
+    """``all_gather(x, dim=0) @ w.T`` with the gather pipelined under the
+    partial GEMMs (and the transposed ring as backward).
+
+    ``x``: the local sequence shard ``[s_local, ..., in]``; ``w``: the full
+    local weight shard ``[out_local, in]`` (torch layout).  Returns
+    ``[s_local * tp, ..., out_local]`` — exactly the sequence-parallel
+    :class:`ColumnParallelLinear` forward.  ``fp8_metas``
+    (``{"x", "w"}`` :class:`~apex_tpu.amp.fp8.Fp8Meta`) routes each partial
+    GEMM through the fp8 core; per-tensor delayed scales commute with
+    sequence chunking, so the quantized values match the monolithic path.
+    Degenerates to one local GEMM when ``axis`` is ``None`` or unbound.
+    """
+    if axis is None or cc.bound_axis_size(axis) == 1:
+        return _mm(x, w, fp8_metas)
+    return _gather_matmul(axis, x, w, fp8_metas)
+
+
+# --- matmul_scatter ------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _matmul_scatter(axis, x, w, metas):
+    return _matmul_scatter_ring(x, w, metas, axis)
+
+
+def _matmul_scatter_fwd(axis, x, w, metas):
+    return _matmul_scatter_ring(x, w, metas, axis), (x, w, metas)
+
+
+def _matmul_scatter_bwd(axis, res, dy):
+    """One shared ring serves both grads: the cotangent shard rotates
+    (the transposed all-gather), and at each step its visiting chunk feeds
+    the input grad for that sequence chunk *and* this rank's weight-grad
+    partial — ``n-1`` hops total for the whole backward."""
+    x, w, metas = res
+    n = cc.axis_size(axis)
+    r = lax.axis_index(axis)
+    xc = cc.ring_chunks(x, n, 0)
+
+    cur, dx_parts, dw = dy, [], None
+    for t in range(n):
+        c = (r + t) % n
+        nxt = cc.send_recv_prev(cur, axis) if t < n - 1 else None
+        x_c = lax.dynamic_index_in_dim(xc, c, 0, keepdims=False)
+        # One joint pullback per step: both cotangents of the same
+        # (chunk, weight) GEMM come from a single linearization.
+        _, pull = jax.vjp(lambda xx, ww: _mm(xx, ww, metas), x_c, w)
+        dx_c, dw_c = pull(cur)
+        dx_parts.append((c, dx_c))
+        dw = dw_c if dw is None else dw + dw_c
+        cur = nxt
+    dx = jnp.zeros((n,) + dx_parts[0][1].shape, dx_parts[0][1].dtype)
+    for c, p in dx_parts:
+        dx = lax.dynamic_update_index_in_dim(dx, p, c, 0)
+    return dx.reshape(x.shape), dw, None
+
+
+_matmul_scatter.defvjp(_matmul_scatter_fwd, _matmul_scatter_bwd)
+
+
+def matmul_scatter(x, w, axis: Optional[str] = TENSOR_AXIS, *,
+                   fp8_metas=None):
+    """``reduce_scatter(x @ w.T, dim=0)`` with the scatter pipelined as
+    traveling partial sums (and the transposed ring as backward).
+
+    ``x``: the full-sequence input-sharded activation
+    ``[s_local * tp, ..., in_local]``; ``w``: ``[out, in_local]``.  Returns
+    the local sequence shard ``[s_local, ..., out]`` of the summed output —
+    exactly the sequence-parallel :class:`RowParallelLinear` forward
+    (bias, replicated, is added by the caller *after* the reduction).
+    Degenerates to one local GEMM when ``axis`` is ``None`` or unbound.
+    """
+    if axis is None or cc.bound_axis_size(axis) == 1:
+        return _mm(x, w, fp8_metas)
+    return _matmul_scatter(axis, x, w, fp8_metas)

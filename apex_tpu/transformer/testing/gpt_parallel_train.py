@@ -155,17 +155,25 @@ def build_gpt_3d(
         return params, specs
 
     def _local_loss(p: GPT3DParams, tokens):
-        """Mean LM loss of the local dp shard; runs with dp/pp/tp bound."""
+        """Mean LM loss of the local dp shard; runs with dp/pp/tp bound.
+
+        Returns a ``(1,)``-shaped array, NOT a scalar: jax 0.4.x's
+        old-style shard_map cannot name-check rank-0 values crossing the
+        shard_map boundary under ``value_and_grad`` (scalar residual
+        out-names trip ``_check_names`` with a ``_SpecError``; the
+        promotion pass misses forwarded scalars), so every scalar on the
+        loss tail keeps a singleton axis until outside the shard_map."""
         mbs = split_into_microbatches(tokens, num_microbatches)
 
         def embed_one(t):
             return embed.apply({"params": p.embedding}, t)
 
         h = jax.vmap(embed_one)(mbs)  # [m, s(/tp), mb, hid]
-        # MoE aux loss rides the pipeline as a per-microbatch scalar in the
-        # activation pytree (stage output structure stays homogeneous);
-        # dense configs carry a zero.
-        aux0 = jnp.zeros((num_microbatches,), jnp.float32)
+        # MoE aux loss rides the pipeline as a per-microbatch (1,)-shaped
+        # slot in the activation pytree (stage output structure stays
+        # homogeneous); dense configs carry a zero.  (1,) and not rank-0
+        # per tick for the same _check_names reason as the loss below.
+        aux0 = jnp.zeros((num_microbatches, 1), jnp.float32)
 
         def stage_fn(lp, xa):
             x, aux = xa
@@ -188,15 +196,15 @@ def build_gpt_3d(
             return jnp.mean(gpt_next_token_loss(logits, t, cfg))
 
         losses = jax.vmap(head_one)(out, mbs)
-        ce = jnp.mean(losses)
+        ce = jnp.mean(losses).reshape(1)
         if cfg.num_experts is not None:
-            aux_term = jnp.mean(aux_out)
+            aux_term = jnp.mean(aux_out).reshape(1)
             if cfg.tensor_axis is not None:
                 # Under SP each tp rank routed a different sequence shard,
                 # so its aux scalar differs; ce is tp-replicated (vocab-
                 # parallel CE psums over tp) and the loss leaves this
-                # shard_map with out_specs=P() — average aux over tp so
-                # the replication contract stays honest
+                # shard_map with a replicated out-spec — average aux over
+                # tp so the replication contract stays honest
                 # (tensor_parallel/partition.py docstring).
                 aux_term = cc.all_reduce(aux_term, tp_axis, "mean")
             ce = ce + moe_aux_coeff * aux_term
@@ -211,13 +219,28 @@ def build_gpt_3d(
         norms/biases — because the specs tell the truth about replication
         (tensor_parallel/partition.py).  Taking grads *inside* the
         shard_map instead would silently drop the dp reduction.
+
+        The loss leaves the shard_map body as a ``(1,)``-shaped array with
+        an explicit replicated spec and is squeezed back to a scalar
+        *outside*: jax 0.4.x's ``jax.experimental.shard_map`` partial-eval
+        (staging under ``value_and_grad``) runs ``_check_names`` over the
+        body's outputs and trips a ``_SpecError`` on a rank-0 residual
+        out-name — a scalar output has no dimension to carry the vma
+        names, while the ``(1,)`` form checks cleanly on every jax version
+        we shim (new shard_map accepts both).
         """
-        return cc.shard_over(
-            lambda p, t: cc.all_reduce(_local_loss(p, t), dp_axis, "mean"),
+        inner = cc.shard_over(
+            lambda p, t: cc.all_reduce(
+                _local_loss(p, t), dp_axis, "mean"),
             mesh=mesh,
             in_specs=(param_specs, P(dp_axis)),
-            out_specs=P(),
+            out_specs=P(None),
         )
+
+        def loss_fn(params, tokens):
+            return jnp.squeeze(inner(params, tokens), axis=0)
+
+        return loss_fn
 
     def make_train_step(opt, param_specs):
         loss_fn = make_loss_fn(param_specs)

@@ -104,6 +104,13 @@ class TransformerConfig:
 
     sequence_parallel: bool = False
     tensor_axis: Optional[str] = TENSOR_AXIS  # None = no tensor parallelism
+    # Ring-decomposed collective matmul on every Column/Row parallel linear
+    # (tensor_parallel/overlap.py): the SP all-gather/reduce-scatter is
+    # pipelined under partial GEMMs, one collective-permute hop at a time,
+    # forward and backward.  Only changes the schedule (and only where
+    # sequence_parallel puts a collective on the layer); values and grads
+    # match the monolithic path to fp32 tolerance.
+    overlap_comm: bool = False
     # Context parallelism (ring attention over a cp mesh axis): activations
     # carry the LOCAL sequence shard [s/cp, b, h]; the causal core runs
     # :func:`apex_tpu.transformer.context_parallel.ring_attention`.  Run the
@@ -233,6 +240,7 @@ class ParallelMLP(nn.Module):
             axis=cfg.tensor_axis,
             kernel_init=cfg.init_method(),
             dtype=cfg.dtype, param_dtype=cfg.param_dtype, fp8=cfg.fp8,
+            overlap_comm=cfg.overlap_comm,
             name="dense_h_to_4h",
         )(x)
         if cfg.swiglu:
@@ -249,6 +257,7 @@ class ParallelMLP(nn.Module):
                 axis=cfg.tensor_axis,
                 kernel_init=cfg.init_method(),
                 dtype=cfg.dtype, param_dtype=cfg.param_dtype, fp8=cfg.fp8,
+                overlap_comm=cfg.overlap_comm,
                 name="dense_h_to_4h_gate",
             )(x)
             h = jax.nn.silu(gate + gate_bias) * (h + bias)
@@ -264,6 +273,7 @@ class ParallelMLP(nn.Module):
             axis=cfg.tensor_axis,
             kernel_init=cfg.scaled_init_method(),
             dtype=cfg.dtype, param_dtype=cfg.param_dtype, fp8=cfg.fp8,
+            overlap_comm=cfg.overlap_comm,
             name="dense_4h_to_h",
         )(h)
         return out, out_bias
@@ -457,6 +467,7 @@ class ParallelAttention(nn.Module):
                 axis=cfg.tensor_axis,
                 kernel_init=cfg.init_method(),
                 dtype=cfg.dtype, param_dtype=cfg.param_dtype, fp8=cfg.fp8,
+                overlap_comm=cfg.overlap_comm,
                 name="query_key_value",
             )(x)
             s, b = qkv.shape[0], qkv.shape[1]
@@ -481,6 +492,7 @@ class ParallelAttention(nn.Module):
                 sequence_parallel=cfg.sequence_parallel,
                 axis=cfg.tensor_axis, kernel_init=cfg.init_method(),
                 dtype=cfg.dtype, param_dtype=cfg.param_dtype, fp8=cfg.fp8,
+                overlap_comm=cfg.overlap_comm,
                 name="query",
             )(x)
             kv = ColumnParallelLinear(
@@ -488,6 +500,7 @@ class ParallelAttention(nn.Module):
                 sequence_parallel=False, axis=cfg.tensor_axis,
                 kernel_init=cfg.init_method(),
                 dtype=cfg.dtype, param_dtype=cfg.param_dtype, fp8=cfg.fp8,
+                overlap_comm=cfg.overlap_comm,
                 name="key_value",
             )(encoder_output)
             s, b = q.shape[0], q.shape[1]
@@ -509,6 +522,7 @@ class ParallelAttention(nn.Module):
             axis=cfg.tensor_axis,
             kernel_init=cfg.scaled_init_method(),
             dtype=cfg.dtype, param_dtype=cfg.param_dtype, fp8=cfg.fp8,
+            overlap_comm=cfg.overlap_comm,
             name="dense",
         )(ctx)
         return out, bias
@@ -571,7 +585,15 @@ class ParallelTransformerLayer(nn.Module):
                 dtype=cfg.dtype, param_dtype=cfg.param_dtype,
                 name="mlp",
             )(ln2)
-            mlp_bias = jnp.zeros((), cfg.dtype)
+            # (1,)-shaped, NOT rank-0: this zero rides the gradient path
+            # (mlp_out + mlp_bias), and under jax 0.4.x's old shard_map a
+            # rank-0 value crossing the shard_map boundary in the
+            # transposed (backward) program has no dimension to carry its
+            # device-varying names — `_check_names` raises `_SpecError`
+            # when the 3D trainer stages under `value_and_grad`.  The
+            # singleton axis broadcasts identically and checks cleanly on
+            # every jax version we shim.
+            mlp_bias = jnp.zeros((1,), cfg.dtype)
         else:
             mlp_out, mlp_bias = ParallelMLP(cfg, name="mlp")(ln2)
         residual = ln2 if cfg.apply_residual_connection_post_layernorm else h

@@ -20,7 +20,10 @@ individually guarded so one failure cannot empty the record:
                               platform)
 - ``gpt_long_context``      — the seq-8192 flash config
 - ``tp_gpt``                — tensor-parallel GPT train step (shard_map over
-                              the tp axis; tp=#devices)
+                              the tp axis; tp=#devices); A/B-measures the
+                              ring-decomposed collective matmul
+                              (``overlap_comm`` on/off — ``vs_monolithic``
+                              < 1 = the overlap schedule wins)
 - ``fused_adam_step``       — optimizer step-time microbench (the
                               "fused-optimizer step time" BASELINE metric);
                               measures per-leaf AND chunked-flat configs
@@ -713,7 +716,45 @@ def bench_tp_gpt(jax, on_tpu):
         st = step(params, state, tokens)
         jax.block_until_ready(st)
         _log(f"tp_gpt: compiled in {time.perf_counter() - t0:.1f}s")
-        dt, _ = _timeit(jax, lambda p, s: step(p, s, tokens), st, steps)
+        dt, st = _timeit(jax, lambda p, s: step(p, s, tokens), st, steps)
+
+        # A/B: the same step with overlap_comm=True — the SP
+        # all-gather/reduce-scatter ring-decomposed into collective-permute
+        # hops pipelined under partial GEMMs (tensor_parallel/overlap.py).
+        # Shares this child's expensive setup (params/opt state thread
+        # through — the monolithic timing loop's final buffers are valid
+        # inputs); only the step recompiles.  vs_monolithic < 1 = overlap
+        # wins (same time-ratio convention as zero_adam_step's
+        # vs_per_leaf).
+        dt_overlap = None
+        if n > 1:
+            import dataclasses
+
+            model_ov = GPTModel(dataclasses.replace(cfg, overlap_comm=True))
+
+            def tp_loss_ov(p, t):
+                losses = model_ov.apply({"params": p}, t, labels=t)
+                return jax.lax.pmean(jnp.mean(losses), "tp")
+
+            @partial(jax.jit, donate_argnums=(0, 1))
+            def step_ov(params, state, tokens):
+                def local(p, s, t):
+                    g = jax.grad(tp_loss_ov)(p, t)
+                    return opt.step(g, s, p)
+                return cc.shard_over(
+                    local,
+                    in_specs=(param_specs, state_specs, P()),
+                    out_specs=(param_specs, state_specs),
+                )(params, state, tokens)
+
+            _log("tp_gpt: overlap variant compile start")
+            t0 = time.perf_counter()
+            st = step_ov(*st, tokens)
+            jax.block_until_ready(st)
+            _log("tp_gpt: overlap variant compiled in "
+                 f"{time.perf_counter() - t0:.1f}s")
+            dt_overlap, _ = _timeit(
+                jax, lambda p, s: step_ov(p, s, tokens), st, steps)
 
         tps = batch * seq * steps / dt
         on_cpu_mesh = jax.devices()[0].platform != "tpu" and n > 1
@@ -736,6 +777,10 @@ def bench_tp_gpt(jax, on_tpu):
                 "dryrun_multichip + virtual-mesh scaling records" if n == 1
                 else "tp=%d on %d attached TPU chips" % (n, n)),
         }
+        if dt_overlap is not None:
+            rec["overlap_tokens_per_sec"] = round(
+                batch * seq * steps / dt_overlap, 1)
+            rec["vs_monolithic"] = round(dt_overlap / dt, 3)
         return rec
     finally:
         parallel.mesh.destroy_model_parallel()
@@ -1396,7 +1441,7 @@ def compact_record(record, max_bytes: int = 1500) -> dict:
     future record still exceeds ``max_bytes``; never returns an oversized
     payload."""
     row_keys = ("value", "unit", "mfu", "platform", "vs_native", "vs_bf16",
-                "vs_synthetic", "vs_per_leaf")
+                "vs_synthetic", "vs_per_leaf", "vs_monolithic")
     rows = {}
     for name, row in list(record.get("extras", {}).items()):
         if not isinstance(row, dict):

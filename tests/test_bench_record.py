@@ -7,8 +7,9 @@ emission ends with a compact line that (a) is <= 1500 bytes, (b) parses,
 (c) carries the driver contract fields, and (d) survives a simulated
 2000-byte tail even in the worst case (all eleven BENCH_ORDER rows
 verbose — including ``real_data_rn50`` with its ``vs_synthetic``
-composition and ``zero_adam_step`` with ``vs_per_leaf`` — + embedded
-prior TPU evidence).
+composition, ``zero_adam_step`` with ``vs_per_leaf``, and ``tp_gpt``
+with its overlap_comm A/B fields (``overlap_tokens_per_sec`` /
+``vs_monolithic``) — + embedded prior TPU evidence).
 """
 
 import io
@@ -34,7 +35,8 @@ def _worst_case_results():
                        "mfu": 0.5059},
         "resnet50_lamb_syncbn": {"value": 2566.8,
                                  "unit": "images/sec/chip"},
-        "tp_gpt": {"value": 761.9, "unit": "tokens/sec"},
+        "tp_gpt": {"value": 761.9, "unit": "tokens/sec",
+                   "overlap_tokens_per_sec": 700.1, "vs_monolithic": 1.088},
         "fused_adam_step": {"value": 4777.5, "unit": "us/step",
                             "vs_native": 0.706},
         "zero_adam_step": {"value": 359273.7, "unit": "us/step",
@@ -78,6 +80,7 @@ def test_compact_record_under_1500_bytes():
     assert compact["rows"]["fused_adam_step"]["vs_native"] == 0.706
     assert compact["rows"]["real_data_rn50"]["vs_synthetic"] == 0.693
     assert compact["rows"]["zero_adam_step"]["vs_per_leaf"] == 0.655
+    assert compact["rows"]["tp_gpt"]["vs_monolithic"] == 1.088
 
 
 def test_compact_record_degrades_instead_of_overflowing():

@@ -1,0 +1,32 @@
+"""Smoke-test the driver entry's multichip staging path (ISSUE 2 satellite).
+
+``__graft_entry__.dryrun_multichip`` regressed silently for a full round:
+it runs only via the driver, so a jax-version-specific staging failure (the
+old-shard_map ``_SpecError`` on a scalar loss under ``value_and_grad``)
+never showed up in the test suite.  This fast-tier test runs the real
+dryrun in a subprocess — exactly how the driver does, and required anyway
+because ``--xla_force_host_platform_device_count`` must precede backend
+init — so the 3D trainer's staging can never silently regress again.
+"""
+
+import os
+import subprocess
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_dryrun_multichip_8_exits_zero():
+    env = dict(os.environ)
+    # A clean slate for the child: the parent's test flags must not leak
+    # (the dryrun pins CPU and sets its own device count).
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         "import __graft_entry__ as g; g.dryrun_multichip(8)"],
+        cwd=_REPO, env=env, capture_output=True, timeout=540,
+    )
+    assert proc.returncode == 0, (
+        f"dryrun_multichip(8) rc={proc.returncode}\n"
+        f"stderr tail:\n{proc.stderr.decode(errors='replace')[-2000:]}"
+    )
