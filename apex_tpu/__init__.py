@@ -20,6 +20,8 @@ Top-level layout (mirrors the reference export list ``apex/__init__.py:9``):
 - :mod:`apex_tpu.normalization`  — fused LayerNorm / RMSNorm
 - :mod:`apex_tpu.ops`            — fused functional ops (softmax, dense, xentropy, ...)
 - :mod:`apex_tpu.parallel`       — mesh builder, collectives, DDP analog, SyncBN
+- :mod:`apex_tpu.resilience`     — crash-safe checkpoint lifecycle, non-finite
+  sentinel, preemption handling (the GradScaler/recoverable-state survival layer)
 - :mod:`apex_tpu.transformer`    — tensor/sequence/pipeline-parallel runtime
 - :mod:`apex_tpu.models`         — reference models (MLP, ResNet, GPT, BERT)
 - :mod:`apex_tpu.contrib`        — optional extensions (group_norm, sparsity, ...)
@@ -36,6 +38,7 @@ __all__ = [
     "normalization",
     "ops",
     "parallel",
+    "resilience",
     "transformer",
     "models",
     "contrib",

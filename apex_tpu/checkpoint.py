@@ -24,6 +24,8 @@ from __future__ import annotations
 
 import json
 import os
+import threading
+import zlib
 from typing import Any, Optional
 
 import jax
@@ -37,9 +39,29 @@ __all__ = [
     "save_checkpoint_sharded_async",
     "restore_checkpoint",
     "restore_checkpoint_sharded",
+    "verify_checkpoint",
+    "verify_checkpoint_sharded",
+    "CheckpointCorruptError",
     "gather_zero_state",
     "scatter_zero_state",
 ]
+
+
+class CheckpointCorruptError(ValueError):
+    """A checkpoint failed integrity verification (checksum mismatch,
+    torn/truncated file, unreadable archive).  Subclasses ``ValueError``
+    so pre-existing ``except ValueError`` restore guards keep working;
+    :meth:`apex_tpu.resilience.CheckpointManager.restore_latest` catches
+    it to fall back to the previous intact checkpoint."""
+
+
+def _checksum(arr: np.ndarray) -> int:
+    """crc32 over a leaf's raw bytes (dtype/shape are checked separately
+    via the manifest, so bytes alone pin the value).  Fed through the
+    buffer protocol — ``tobytes()`` would transiently double host memory
+    per leaf on every save AND every verify."""
+    flat = np.ascontiguousarray(arr).reshape(-1).view(np.uint8)
+    return zlib.crc32(flat) & 0xFFFFFFFF
 
 
 def _path_str(path) -> str:
@@ -102,21 +124,27 @@ def _snapshot(tree, step, copy_host_leaves=False):
     return arrays, manifest
 
 
-def _write_npz(path, manifest, arrays) -> str:
-    # Unique temp file in the target dir: concurrent saves to the same
-    # path cannot race on a shared temp name, and os.replace stays atomic
-    # (same filesystem) so there are no torn checkpoints on preemption.
-    # O_CREAT with mode 0o666 lets the kernel apply the process umask
-    # atomically (the file gets exactly the mode a plain open() would),
-    # with no umask() probing that could race other threads.
+def _atomic_write(path, writer) -> str:
+    """Crash-safe file write: ``writer(fileobj)`` into a unique temp in
+    the target dir, fsync the file BEFORE the atomic ``os.replace`` and
+    the directory AFTER it — without both, a host preemption can leave
+    the rename durable but the data pages not (a named file full of
+    zeros), the exact torn-checkpoint mode the rename exists to prevent.
+    The unique temp name means concurrent saves to the same path cannot
+    race, and the temp is unlinked on ANY failure (no orphan temps).
+    O_CREAT with mode 0o666 lets the kernel apply the process umask
+    atomically, with no umask() probing that could race other threads."""
     import uuid
 
     tmp = f"{path}.tmp.{uuid.uuid4().hex}"
     fd = os.open(tmp, os.O_CREAT | os.O_EXCL | os.O_WRONLY, 0o666)
     try:
         with os.fdopen(fd, "wb") as f:
-            np.savez(f, __manifest__=json.dumps(manifest), **arrays)
+            writer(f)
+            f.flush()
+            os.fsync(f.fileno())
         os.replace(tmp, path)
+        _fsync_dir(os.path.dirname(os.path.abspath(path)))
     except BaseException:
         try:
             os.unlink(tmp)
@@ -124,6 +152,31 @@ def _write_npz(path, manifest, arrays) -> str:
             pass
         raise
     return path
+
+
+def _write_npz(path, manifest, arrays) -> str:
+    # Every array's crc32 rides in the manifest so torn or bit-flipped
+    # data is detectable at verify/restore time (ISSUE 3).
+    manifest = dict(manifest)
+    manifest["checksums"] = {k: _checksum(v) for k, v in arrays.items()}
+    return _atomic_write(
+        path,
+        lambda f: np.savez(f, __manifest__=json.dumps(manifest), **arrays))
+
+
+def _fsync_dir(dirpath: str) -> None:
+    """Make a rename durable: fsync the containing directory (no-op on
+    filesystems that cannot open directories, e.g. some FUSE mounts)."""
+    try:
+        dfd = os.open(dirpath, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(dfd)
+    except OSError:
+        pass
+    finally:
+        os.close(dfd)
 
 
 def save_checkpoint(path: str, tree: Any, step: Optional[int] = None) -> None:
@@ -140,10 +193,12 @@ def save_checkpoint(path: str, tree: Any, step: Optional[int] = None) -> None:
     hosts can read (NFS / GCS-fuse / single-host tests) — rank-0-local
     storage leaves other ranks unable to ``restore_checkpoint``.
     """
+    _reraise_pending_failure(path)  # surface dropped async failures too
     arrays, manifest = _snapshot(tree, step)
     multi = jax.process_count() > 1
     if not multi or jax.process_index() == 0:
         _write_npz(path, manifest, arrays)
+    _clear_write_failure(path)  # a durable save supersedes old failures
     if multi:
         from jax.experimental import multihost_utils
 
@@ -171,31 +226,106 @@ def save_checkpoint_async(path: str, tree: Any,
             "save_checkpoint_async is single-process; multi-host saves "
             "need the collective gather of save_checkpoint (or the "
             "gather-free save_checkpoint_sharded_async)")
+    _reraise_pending_failure(path)
     # sync D2H (host-numpy leaves copied), then async IO
     arrays, manifest = _snapshot(tree, step, copy_host_leaves=True)
     return _submit_write(path, manifest, arrays, "async checkpoint")
 
 
-def _submit_write(path, manifest, arrays, label):
+# Failed background writes, keyed by destination (file path or sharded
+# dir).  A dropped handle must not let a failed save masquerade as
+# durable: the NEXT save to the same destination re-raises the recorded
+# failure (ISSUE 3 satellite), in addition to the future's own
+# ``result()`` re-raise and the worker-thread log line.
+_FAILED_WRITES: dict = {}
+_FAILED_WRITES_LOCK = threading.Lock()
+
+
+def _record_write_failure(key: str, exc: BaseException) -> None:
+    with _FAILED_WRITES_LOCK:
+        _FAILED_WRITES[key] = exc
+
+
+def _clear_write_failure(key: str) -> None:
+    """The recorded failure exists ONLY for the dropped-handle case: it
+    is cleared the moment it is observed (the handle's ``result()``
+    re-raise) or superseded (a later successful save to the same
+    destination) — otherwise a legitimate retry of the same step would
+    spuriously trip the 'never waited on' guard."""
+    with _FAILED_WRITES_LOCK:
+        _FAILED_WRITES.pop(key, None)
+
+
+def _reraise_pending_failure(dest: str) -> None:
+    """Surface a recorded unobserved failure before starting a new save
+    to ``dest`` OR to a sibling destination (same parent directory):
+    step-indexed layouts write each save to a fresh ``step_N`` path, so
+    exact-key matching alone would never revisit a failed step's key and
+    the dropped-handle guarantee would be vacuous exactly where it
+    matters most."""
+    parent = os.path.dirname(os.path.abspath(dest))
+    with _FAILED_WRITES_LOCK:
+        key = next(
+            (k for k in _FAILED_WRITES
+             if k == dest or os.path.dirname(os.path.abspath(k)) == parent),
+            None)
+        exc = _FAILED_WRITES.pop(key, None) if key is not None else None
+    if exc is not None:
+        raise RuntimeError(
+            f"a previous async checkpoint write to {key!r} failed and was "
+            "never waited on — the checkpoint there is NOT durable"
+        ) from exc
+
+
+class _TrackedFuture:
+    """Future wrapper that clears the per-destination failure record when
+    the failure is delivered through ``result()`` (a timeout is not a
+    delivery — the write is still in flight)."""
+
+    def __init__(self, future, key):
+        self._future = future
+        self._key = key
+
+    def done(self) -> bool:
+        return self._future.done()
+
+    def result(self, timeout=None):
+        import concurrent.futures
+
+        try:
+            return self._future.result(timeout)
+        except (TimeoutError, concurrent.futures.TimeoutError):
+            raise
+        except BaseException:
+            _clear_write_failure(self._key)
+            raise
+
+
+def _submit_write(path, manifest, arrays, label, failure_key=None):
     """Background write on a dedicated single-use worker; failures are
-    logged from the worker (not silent if the caller drops the handle)
-    AND re-raised through the returned future's ``result()``."""
+    logged from the worker (not silent if the caller drops the handle),
+    re-raised through the returned future's ``result()``, AND recorded
+    under ``failure_key`` so the next save to the same destination
+    re-raises them (a dropped handle cannot hide a failed save)."""
     import concurrent.futures
+
+    key = failure_key if failure_key is not None else path
 
     def _write_logged():
         try:
             return _write_npz(path, manifest, arrays)
-        except BaseException:
+        except BaseException as e:
             import logging
 
             logging.getLogger(__name__).exception(
                 "%s write to %r failed", label, path)
+            _record_write_failure(key, e)
             raise
 
     pool = concurrent.futures.ThreadPoolExecutor(max_workers=1)
     future = pool.submit(_write_logged)
     pool.shutdown(wait=False)
-    return future
+    return _TrackedFuture(future, key)
 
 
 def _validate_template(manifest, like):
@@ -249,6 +379,54 @@ def restore_checkpoint(path: str, like: Any):
     return jax.tree_util.tree_unflatten(treedef, out), manifest["step"]
 
 
+def _verify_npz(path: str) -> dict:
+    """Integrity-check ONE ``.npz`` checkpoint file: the archive must be
+    readable and every stored array must match its manifest crc32.
+    Returns the manifest; raises :class:`CheckpointCorruptError` on any
+    damage (torn write, truncation, bit flip).  Checkpoints written
+    before checksums existed verify structurally only (archive readable,
+    every manifest leaf present)."""
+    try:
+        with np.load(path, allow_pickle=False) as data:
+            manifest = json.loads(str(data["__manifest__"]))
+            sums = manifest.get("checksums")
+            keys = [k for k in data.files if k != "__manifest__"]
+            for key in keys:
+                arr = data[key]  # zipfile's own CRC also trips here
+                if sums is not None:
+                    want = sums.get(key)
+                    if want is None:
+                        raise CheckpointCorruptError(
+                            f"{path}: array {key!r} missing from the "
+                            "checksum manifest")
+                    got = _checksum(arr)
+                    if got != want:
+                        raise CheckpointCorruptError(
+                            f"{path}: checksum mismatch on {key!r} "
+                            f"(stored {want}, recomputed {got})")
+            if sums is not None and set(sums) - set(keys):
+                raise CheckpointCorruptError(
+                    f"{path}: arrays missing from archive: "
+                    f"{sorted(set(sums) - set(keys))}")
+    except CheckpointCorruptError:
+        raise
+    except Exception as e:
+        # zipfile.BadZipFile, zlib.error, OSError on truncated reads,
+        # json decode of a torn manifest — all are corruption here.
+        raise CheckpointCorruptError(f"{path}: unreadable ({e!r})") from e
+    return manifest
+
+
+def verify_checkpoint(path: str) -> dict:
+    """Full integrity pass over a flat checkpoint (``save_checkpoint`` /
+    ``save_checkpoint_async`` output): archive readable, every array's
+    crc32 matches the manifest.  Returns the manifest.  Raises
+    :class:`CheckpointCorruptError` — callers that can fall back (e.g.
+    ``CheckpointManager.restore_latest``) catch it and try the previous
+    checkpoint."""
+    return _verify_npz(path)
+
+
 # ---------------------------------------------------------------------------
 # Sharded (per-process) checkpointing — the pod-scale path
 # ---------------------------------------------------------------------------
@@ -284,15 +462,32 @@ def save_checkpoint_sharded(ckpt_dir: str, tree: Any,
     :func:`restore_checkpoint_sharded` will run with a different
     process-to-host mapping.
     """
+    _reraise_pending_failure(ckpt_dir)  # surface dropped async failures
     _clean_stale_shards(ckpt_dir)
     arrays, manifest, proc = _sharded_snapshot(tree, step)
     _write_npz(os.path.join(ckpt_dir, f"shard_{proc}.npz"),
                manifest, arrays)
+    _clear_write_failure(ckpt_dir)  # durable save supersedes old failures
+    _finish_sharded_save(ckpt_dir, manifest)
+
+
+def _finish_sharded_save(ckpt_dir: str, manifest: Optional[dict]) -> None:
+    """The one copy of the commit protocol, shared by the sync save and
+    ``ShardedSaveHandle.finalize``: barrier (every rank's shard write is
+    done) -> rank-0 ``manifest.json`` commit -> second barrier (no rank
+    returns — and possibly restores — before the commit is durable)."""
     if jax.process_count() > 1:
         from jax.experimental import multihost_utils
 
         multihost_utils.sync_global_devices(
             f"save_checkpoint_sharded:{ckpt_dir}")
+    if manifest is not None:
+        _commit_shard_manifest(ckpt_dir, manifest)
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(
+            f"save_checkpoint_sharded:commit:{ckpt_dir}")
 
 
 def _sharded_snapshot(tree, step, copy_host_leaves=False):
@@ -333,21 +528,79 @@ def _sharded_snapshot(tree, step, copy_host_leaves=False):
     return arrays, manifest, proc
 
 
+_SHARD_MANIFEST = "manifest.json"
+
+
+def _commit_shard_manifest(ckpt_dir: str, shard_manifest: dict) -> None:
+    """Rank 0 commits the save by writing ``manifest.json`` (atomic:
+    temp + fsync + rename) AFTER every shard write has completed and the
+    cross-process barrier has passed.  The manifest names the shard files
+    the save owns, so (a) restore reads exactly those files — stale
+    leftovers are ignored rather than fatal — and (b) stale-shard cleanup
+    has an authority for what is referenced (the concurrent-writer race
+    fix: only unreferenced files strictly older than the committed
+    manifest are removed)."""
+    if jax.process_index() != 0:
+        return
+    count = shard_manifest.get("process_count", 1)
+    doc = {
+        "version": 1,
+        "step": shard_manifest.get("step"),
+        "process_count": count,
+        "files": [f"shard_{p}.npz" for p in range(count)],
+    }
+    _atomic_write(os.path.join(ckpt_dir, _SHARD_MANIFEST),
+                  lambda f: f.write(json.dumps(doc).encode()))
+
+
+def _read_shard_manifest(ckpt_dir: str) -> Optional[dict]:
+    try:
+        with open(os.path.join(ckpt_dir, _SHARD_MANIFEST)) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
 def _clean_stale_shards(ckpt_dir) -> None:
-    """Rank 0 drops shard files from an earlier save with MORE processes
-    (restore validates file count == process_count; a leftover high-index
-    shard would otherwise blend old weights in)."""
+    """Rank 0 drops stale shard files so a later restore cannot blend old
+    weights in.  Concurrent-writer safe (ISSUE 3 satellite): a shard file
+    is removed only when it is (a) NOT referenced by the committed
+    ``manifest.json`` AND (b) strictly older than that manifest — a file
+    a second in-flight sharded save just renamed into place is younger
+    than the last committed manifest and survives.  Temp files
+    (``*.tmp.*``) are never touched here: the in-flight save that owns
+    them unlinks on failure, and a crash leaves them inert (restore never
+    reads them).  Without a committed manifest (legacy dirs) the old
+    index-vs-process_count rule applies, which is safe because legacy
+    saves were synchronous."""
     os.makedirs(ckpt_dir, exist_ok=True)
     if jax.process_index() != 0:
         return
     import glob as _glob
 
+    committed = _read_shard_manifest(ckpt_dir)
+    try:
+        manifest_mtime = os.path.getmtime(
+            os.path.join(ckpt_dir, _SHARD_MANIFEST))
+    except OSError:
+        manifest_mtime = None
+
     for old in _glob.glob(os.path.join(ckpt_dir, "shard_*.npz")):
+        name = os.path.basename(old)
         try:
-            idx = int(os.path.basename(old)[len("shard_"):-len(".npz")])
+            idx = int(name[len("shard_"):-len(".npz")])
         except ValueError:
             continue
-        if idx >= jax.process_count():
+        if committed is not None and manifest_mtime is not None:
+            if name in committed.get("files", []):
+                continue  # referenced by the committed save
+            try:
+                if os.path.getmtime(old) >= manifest_mtime:
+                    continue  # younger than the commit: in-flight writer
+                os.unlink(old)
+            except OSError:
+                continue
+        elif idx >= jax.process_count():
             os.unlink(old)
 
 
@@ -369,9 +622,10 @@ class ShardedSaveHandle:
     including the reference's rank-0 NCCL gather.
     """
 
-    def __init__(self, future, ckpt_dir):
+    def __init__(self, future, ckpt_dir, manifest=None):
         self._future = future
         self._ckpt_dir = ckpt_dir
+        self._manifest = manifest
 
     def done(self) -> bool:
         return self._future.done()
@@ -381,11 +635,16 @@ class ShardedSaveHandle:
 
     def finalize(self, timeout=None):
         path = self.result(timeout)
-        if jax.process_count() > 1:
-            from jax.experimental import multihost_utils
-
-            multihost_utils.sync_global_devices(
-                f"save_checkpoint_sharded:{self._ckpt_dir}")
+        # Commit AFTER every shard is durable (local write waited, peer
+        # writes barriered).  In a FRESH directory (the manager's
+        # step-indexed layout) a crash before this point leaves the new
+        # shards uncommitted and inert.  When overwriting a previous
+        # save's directory in place, a crash mid-sequence can leave the
+        # old manifest over replaced shard bytes — that state is
+        # DETECTED (manifest-vs-shard step mismatch / inconsistent-shard
+        # checks) rather than prevented; use one directory per step for
+        # lossless recovery.
+        _finish_sharded_save(self._ckpt_dir, self._manifest)
         return path
 
 
@@ -401,13 +660,75 @@ def save_checkpoint_sharded_async(ckpt_dir: str, tree: Any,
     moves into :meth:`ShardedSaveHandle.finalize`, which every process
     must call from its main thread.
     """
+    _reraise_pending_failure(ckpt_dir)
     _clean_stale_shards(ckpt_dir)
     arrays, manifest, proc = _sharded_snapshot(
         tree, step, copy_host_leaves=True)
     path = os.path.join(ckpt_dir, f"shard_{proc}.npz")
     return ShardedSaveHandle(
-        _submit_write(path, manifest, arrays, "async sharded checkpoint"),
-        ckpt_dir)
+        _submit_write(path, manifest, arrays, "async sharded checkpoint",
+                      failure_key=ckpt_dir),
+        ckpt_dir, manifest)
+
+
+def _shard_paths(ckpt_dir: str):
+    """The shard files a restore/verify should read: exactly the ones the
+    committed ``manifest.json`` references when one exists (stale
+    leftovers from older/larger saves are ignored, not fatal), else every
+    ``shard_*.npz`` in the dir (legacy layout — restore's own
+    process_count check then guards staleness)."""
+    committed = _read_shard_manifest(ckpt_dir)
+    if committed is not None:
+        paths = [os.path.join(ckpt_dir, name)
+                 for name in committed.get("files", [])]
+        missing = [p for p in paths if not os.path.exists(p)]
+        if missing:
+            raise CheckpointCorruptError(
+                f"{ckpt_dir}: manifest references missing shard files "
+                f"{[os.path.basename(p) for p in missing]}")
+        return paths
+    import glob
+
+    return sorted(glob.glob(os.path.join(ckpt_dir, "shard_*.npz")))
+
+
+def verify_checkpoint_sharded(ckpt_dir: str) -> dict:
+    """Full integrity pass over a sharded checkpoint dir: every
+    referenced shard archive readable, every array's crc32 matching,
+    step/process_count consistent across shards, and the shard-file
+    count matching the writer count.  Returns the (first shard's)
+    manifest.  Raises :class:`CheckpointCorruptError`."""
+    paths = _shard_paths(ckpt_dir)
+    if not paths:
+        raise CheckpointCorruptError(
+            f"{ckpt_dir}: no shard files to verify")
+    first = None
+    for p in paths:
+        m = _verify_npz(p)
+        if first is None:
+            first = m
+        elif (m.get("step") != first.get("step")
+              or m.get("process_count") != first.get("process_count")):
+            raise CheckpointCorruptError(
+                f"{ckpt_dir}: inconsistent shard manifests "
+                f"({os.path.basename(p)}: step={m.get('step')} "
+                f"process_count={m.get('process_count')} vs "
+                f"step={first.get('step')} "
+                f"process_count={first.get('process_count')})")
+    if len(paths) != first.get("process_count"):
+        raise CheckpointCorruptError(
+            f"{ckpt_dir}: {len(paths)} shard files but the checkpoint "
+            f"was written by {first.get('process_count')} processes")
+    committed = _read_shard_manifest(ckpt_dir)
+    if committed is not None and committed.get("step") != first.get("step"):
+        # Overlapping saves finalized out of order: the commit says one
+        # step, the surviving shard bytes are another's — ambiguous, and
+        # the reason CheckpointManager serializes saves.
+        raise CheckpointCorruptError(
+            f"{ckpt_dir}: committed manifest is step "
+            f"{committed.get('step')} but shard contents are step "
+            f"{first.get('step')} — overlapping saves to one dir?")
+    return first
 
 
 def restore_checkpoint_sharded(ckpt_dir: str, like: Any):
@@ -422,9 +743,7 @@ def restore_checkpoint_sharded(ckpt_dir: str, like: Any):
     shapes; slice boundaries must align, which holds for any layout
     produced by the same named-sharding rules).
     """
-    import glob
-
-    paths = sorted(glob.glob(os.path.join(ckpt_dir, "shard_*.npz")))
+    paths = _shard_paths(ckpt_dir)
     if not paths:
         raise FileNotFoundError(f"no shard_*.npz under {ckpt_dir!r}")
     # Lazy index: npz entries decompress only on access (NpzFile reads the

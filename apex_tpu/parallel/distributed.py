@@ -285,6 +285,7 @@ def zero_data_parallel_train_step(
     mesh=None,
     donate: bool = True,
     microbatches: int = 1,
+    scaler=None,
 ):
     """The shard_map ZeRO path: per-replica local grads feed a
     ZeRO-sharded optimizer (``DistributedFusedAdam``/``LAMB``) whose
@@ -299,34 +300,83 @@ def zero_data_parallel_train_step(
     params replicated, optimizer state sharded (:func:`zero_init`).
     Returns ``step(params, opt_state, batch, lr=None) ->
     (params, opt_state, loss)`` on global arrays.
+
+    ``scaler`` (an ``amp`` scaler algorithm, e.g. ``DynamicLossScale()``)
+    arms the unified non-finite sentinel
+    (:mod:`apex_tpu.resilience.sentinel`): the loss is scaled, gradients
+    are overflow-checked with the flag ``pmin``-agreed over the data
+    axes, and the ENTIRE optimizer apply — reduce-scatter, update,
+    all-gather — runs under one ``lax.cond``, so an overflow step leaves
+    params and optimizer state bit-unchanged and moves no collective
+    bytes, with no host sync.  The step signature gains sentinel state
+    LAST (the same position as the 3D GPT trainer's sentinel step):
+    ``step(params, opt_state, batch, sentinel, lr=None) -> (params,
+    opt_state, sentinel, loss)`` (init with
+    :func:`apex_tpu.resilience.sentinel_init`; ``sentinel.skipped_steps``
+    counts skipped updates; the reported loss is unscaled).
     """
     if mesh is None:
         mesh = mesh_lib.get_mesh()
     dp_axes = tuple(a for a in (mesh_lib.DCN_AXIS, mesh_lib.DATA_AXIS)
                     if a in mesh.shape)
 
-    grad_fn = grad_accumulation(
-        lambda p, b: jax.value_and_grad(loss_fn)(p, b), microbatches)
-
-    def per_shard(params, opt_state, batch, lr):
-        loss, grads = grad_fn(params, batch)
-        params, opt_state = optimizer.step(grads, opt_state, params, lr=lr)
-        loss = cc.all_reduce(loss, dp_axes, op="mean")
-        return params, opt_state, loss
-
     def batch_spec(x):
         return P(dp_axes, *([None] * (jnp.ndim(x) - 1)))
 
-    def step(params, opt_state, batch, lr=None):
-        param_specs = jax.tree_util.tree_map(lambda _: P(), params)
-        state_specs = optimizer.state_partition_specs(params)
-        in_specs = (param_specs, state_specs,
-                    jax.tree_util.tree_map(batch_spec, batch), P())
-        out_specs = (param_specs, state_specs, P())
-        lr_in = jnp.float32(optimizer.lr if lr is None else lr)
-        return cc.shard_over(
-            per_shard, mesh=mesh, in_specs=in_specs, out_specs=out_specs
-        )(params, opt_state, batch, lr_in)
+    def jit_shard_step(per_shard):
+        """ONE copy of the spec/shard_over/jit/donate plumbing for both
+        shapes: ``rest`` is ``(batch,)`` or ``(batch, sentinel)`` — the
+        batch comes first, any carry-state after it is replicated and
+        mirrored into the outputs (before the loss)."""
+        def step(params, opt_state, *rest, lr=None):
+            batch, carry = rest[0], rest[1:]
+            param_specs = jax.tree_util.tree_map(lambda _: P(), params)
+            state_specs = optimizer.state_partition_specs(params)
+            carry_specs = [jax.tree_util.tree_map(lambda _: P(), r)
+                           for r in carry]
+            in_specs = (param_specs, state_specs,
+                        jax.tree_util.tree_map(batch_spec, batch),
+                        *carry_specs, P())
+            out_specs = (param_specs, state_specs, *carry_specs, P())
+            lr_in = jnp.float32(optimizer.lr if lr is None else lr)
+            return cc.shard_over(
+                per_shard, mesh=mesh, in_specs=in_specs,
+                out_specs=out_specs
+            )(params, opt_state, batch, *carry, lr_in)
 
-    donate_argnums = (0, 1) if donate else ()
-    return jax.jit(step, donate_argnums=donate_argnums)
+        return jax.jit(step, donate_argnums=(0, 1) if donate else ())
+
+    if scaler is None:
+        grad_fn = grad_accumulation(
+            lambda p, b: jax.value_and_grad(loss_fn)(p, b), microbatches)
+
+        def per_shard(params, opt_state, batch, lr):
+            loss, grads = grad_fn(params, batch)
+            params, opt_state = optimizer.step(grads, opt_state, params,
+                                               lr=lr)
+            loss = cc.all_reduce(loss, dp_axes, op="mean")
+            return params, opt_state, loss
+
+        return jit_shard_step(per_shard)
+
+    from apex_tpu.resilience.sentinel import sentinel_guarded_apply
+
+    def per_shard_guarded(params, opt_state, batch, sent, lr):
+        # Scale with the CURRENT step's scale (captured before the
+        # sentinel update — the update may back off for the next step).
+        scale_used = sent.scaler.scale
+
+        def scaled_loss(p, b):
+            return scaler.scale(loss_fn(p, b), sent.scaler)
+
+        grad_fn = grad_accumulation(
+            lambda p, b: jax.value_and_grad(scaled_loss)(p, b),
+            microbatches)
+        loss_s, grads = grad_fn(params, batch)
+        params, opt_state, sent = sentinel_guarded_apply(
+            scaler, optimizer, grads, opt_state, params, sent,
+            axes=dp_axes, lr=lr, grad_scale=scale_used)
+        loss = cc.all_reduce(loss_s / scale_used, dp_axes, op="mean")
+        return params, opt_state, sent, loss
+
+    return jit_shard_step(per_shard_guarded)

@@ -1,0 +1,46 @@
+"""Fault-tolerant training — the survival layer over the fast paths.
+
+The reference's production value was never only speed: the GradScaler/DDP
+machinery exists so long mixed-precision runs *survive* (skipped steps on
+overflow, recoverable state — ``apex/amp/handle.py:128-154``,
+``apex/amp/scaler.py``).  This package is that layer for the TPU stack,
+covering the failures a production run on preemptible slices actually
+hits:
+
+- :mod:`.manager` — :class:`CheckpointManager`: crash-safe checkpoint
+  lifecycle (atomic verified saves, keep-last-k retention,
+  retry-with-backoff on transient I/O, ``restore_latest`` falling back
+  past corrupt checkpoints) over both the flat and sharded layouts of
+  :mod:`apex_tpu.checkpoint`, ZeRO-sharded optimizer state included.
+- :mod:`.sentinel` — the unified non-finite sentinel:
+  :class:`SentinelState` and the single ``lax.cond``-guarded optimizer
+  apply reusing ``amp.all_finite``/``DynamicLossScale.update``, threaded
+  through ``zero_data_parallel_train_step`` and the 3D GPT trainer so an
+  overflow step skips the parameter/optimizer update everywhere with no
+  host sync.
+- :mod:`.preemption` — :class:`PreemptionGuard`: SIGTERM-driven clean
+  shutdown (drain in-flight async saves, final checkpoint, exit 0) — the
+  ADLR autoresume idea at the signal layer.
+
+The matching fault-injection harness lives in
+:mod:`apex_tpu.testing.faults`; the failure model and recovery matrix in
+``docs/resilience.md``.
+"""
+
+from apex_tpu.resilience.manager import CheckpointManager
+from apex_tpu.resilience.preemption import PreemptionGuard
+from apex_tpu.resilience.sentinel import (
+    SentinelState,
+    guarded_optimizer_step,
+    sentinel_init,
+    sentinel_update,
+)
+
+__all__ = [
+    "CheckpointManager",
+    "PreemptionGuard",
+    "SentinelState",
+    "guarded_optimizer_step",
+    "sentinel_init",
+    "sentinel_update",
+]

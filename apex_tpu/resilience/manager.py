@@ -1,0 +1,279 @@
+"""Crash-safe checkpoint lifecycle — the ``CheckpointManager``.
+
+The primitives in :mod:`apex_tpu.checkpoint` make ONE save atomic and
+verifiable (temp + fsync + rename, per-array crc32); this module owns the
+*sequence* of saves a long run produces: step-indexed directories,
+keep-last-k retention, retry-with-backoff on transient I/O errors, and a
+``restore_latest`` that falls back to the previous intact checkpoint when
+the newest fails verification — the recoverable-checkpoint contract
+TorchTitan treats as a first-class production requirement (PAPERS.md) and
+veScale's save/restore consistency argument applies to our sharded layout.
+
+Layout under ``directory``::
+
+    step_00000003.npz        # flat layout (sharded=False)
+    step_00000007/           # sharded layout (sharded=True)
+        shard_0.npz ... shard_{P-1}.npz
+        manifest.json        # committed last; authority for restore
+
+Both layouts carry any pytree — params, ``OptState``s (including
+ZeRO-sharded flat-bucket state as global arrays), scaler/sentinel state,
+counters — because the underlying functions are tree-generic.
+
+Multi-host note: ``save`` (sync, sharded) is collective — call it from
+every process, like ``save_checkpoint_sharded``.  Retries are
+single-process only: a collective save has a fixed barrier sequence,
+and re-entering it on one rank would deadlock its peers, so with
+``process_count > 1`` every save gets one attempt and a failure is the
+job runtime's to handle (like any collective failure).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import re
+import shutil
+import time
+from typing import Any, Optional
+
+from apex_tpu import checkpoint as ckpt
+
+__all__ = ["CheckpointManager"]
+
+logger = logging.getLogger(__name__)
+
+_STEP_RE = re.compile(r"^step_(\d{8,})(\.npz)?$")  # :08d grows past 8
+
+
+class CheckpointManager:
+    """Manage a directory of step-indexed checkpoints.
+
+    ``keep``      — retain at most this many newest checkpoints (older
+                    ones are deleted after a successful save; the save
+                    that just landed is never deleted).
+    ``sharded``   — use the per-process ``save_checkpoint_sharded``
+                    layout (one subdirectory per step) instead of the
+                    flat single-file layout.
+    ``retries`` / ``backoff_s`` — transient-I/O policy for SYNC saves
+                    (and the snapshot/submission part of async ones): an
+                    ``OSError`` is retried up to ``retries`` times with
+                    exponentially growing sleeps (``backoff_s * 2**k``).
+                    A failure inside an async save's BACKGROUND write is
+                    not retried — the snapshot is consumed by the worker,
+                    so it is surfaced once from ``wait()``/the next save
+                    and the caller re-saves from live state.
+                    Non-``OSError`` failures propagate immediately.
+
+    The manager is host-side bookkeeping only — nothing here traces or
+    jits; call it between steps (or hand it ``save_async`` handles).
+    """
+
+    def __init__(self, directory: str, *, keep: int = 3,
+                 sharded: bool = False, retries: int = 3,
+                 backoff_s: float = 0.25):
+        if keep < 1:
+            raise ValueError(f"keep must be >= 1, got {keep}")
+        self.directory = directory
+        self.keep = keep
+        self.sharded = sharded
+        self.retries = retries
+        self.backoff_s = backoff_s
+        self._inflight = None  # (step, handle) of the pending async save
+        os.makedirs(directory, exist_ok=True)
+
+    # -- paths ---------------------------------------------------------
+
+    def _path(self, step: int) -> str:
+        name = f"step_{step:08d}"
+        return os.path.join(self.directory,
+                            name if self.sharded else name + ".npz")
+
+    def all_steps(self):
+        """Step numbers with a checkpoint present, ascending (presence,
+        not integrity — ``restore_latest`` verifies)."""
+        steps = []
+        try:
+            entries = os.listdir(self.directory)
+        except OSError:
+            return []
+        for name in entries:
+            m = _STEP_RE.match(name)
+            if m is None:
+                continue
+            is_dir = m.group(2) is None
+            if is_dir != self.sharded:
+                continue
+            steps.append(int(m.group(1)))
+        return sorted(steps)
+
+    # -- save ----------------------------------------------------------
+
+    def _with_retries(self, fn, what: str):
+        """Run ``fn`` retrying transient ``OSError``s with backoff — the
+        blip-on-NFS/GCS-fuse case; deterministic failures (corruption
+        bugs, bad trees) are not ``OSError`` and propagate at once.
+
+        Multi-process gets ONE attempt: the sharded save is a collective
+        with a fixed barrier sequence, and one rank re-entering it while
+        its peers sit at a later barrier would deadlock the job — a
+        failed collective save belongs to the job runtime, not a local
+        retry loop."""
+        import jax
+
+        retries = self.retries if jax.process_count() == 1 else 0
+        for attempt in range(retries + 1):
+            try:
+                return fn()
+            except OSError as e:
+                if attempt == retries:
+                    raise
+                delay = self.backoff_s * (2.0 ** attempt)
+                logger.warning(
+                    "%s failed (%r), retry %d/%d in %.2fs",
+                    what, e, attempt + 1, retries, delay)
+                time.sleep(delay)
+
+    def save(self, tree: Any, step: int) -> str:
+        """Synchronous checkpoint of ``tree`` at ``step``; returns the
+        checkpoint path.  Waits for any in-flight async save first (its
+        failure, if any, is raised here — never silently dropped), then
+        applies retention."""
+        self.wait()
+        path = self._path(step)
+        if self.sharded:
+            self._with_retries(
+                lambda: ckpt.save_checkpoint_sharded(path, tree, step=step),
+                f"sharded save step {step}")
+        else:
+            self._with_retries(
+                lambda: ckpt.save_checkpoint(path, tree, step=step),
+                f"save step {step}")
+        self._apply_retention()
+        return path
+
+    def save_async(self, tree: Any, step: int):
+        """Overlapped checkpoint: snapshot now (buffers may be donated
+        immediately after return), write in the background.  Returns the
+        underlying handle; the NEXT ``save``/``save_async``/``wait``
+        drains it and re-raises any write failure.  Retention runs when
+        the handle is drained (deleting old checkpoints while a writer
+        is mid-flight cannot race the new file: retention only ever
+        removes OTHER steps)."""
+        self.wait()
+        path = self._path(step)
+        if self.sharded:
+            handle = self._with_retries(
+                lambda: ckpt.save_checkpoint_sharded_async(
+                    path, tree, step=step),
+                f"async sharded save step {step}")
+        else:
+            handle = self._with_retries(
+                lambda: ckpt.save_checkpoint_async(path, tree, step=step),
+                f"async save step {step}")
+        self._inflight = (step, handle)
+        return handle
+
+    def wait(self, timeout: Optional[float] = None) -> None:
+        """Drain the in-flight async save (finalizing the sharded commit
+        barrier/manifest), re-raising its failure.  No-op when idle.
+        Call before shutdown — a checkpoint is durable only once its
+        handle has been waited on.
+
+        A ``timeout`` expiry is NOT a failure: the writer is still in
+        flight, so the handle stays tracked — call ``wait`` again.  No
+        retry wraps the handle either: a failed ``Future``'s exception
+        is sticky, so re-polling it could never succeed — the error is
+        raised once and the torn state is left for verification to skip
+        (never deleted: the same path may hold an older durable save)."""
+        if self._inflight is None:
+            return
+        import concurrent.futures
+
+        step, handle = self._inflight
+        try:
+            if hasattr(handle, "finalize"):  # ShardedSaveHandle
+                handle.finalize(timeout)
+            else:  # concurrent.futures.Future
+                handle.result(timeout)
+        except (TimeoutError, concurrent.futures.TimeoutError):
+            raise  # still writing: keep tracking, caller may wait again
+        except Exception:
+            # Nothing is discarded on failure: the atomic-write/commit
+            # protocol guarantees the failed save left either nothing
+            # visible or a state verification detects (empty step dir,
+            # uncommitted shards), and restore_latest falls back past
+            # it — whereas deleting self._path(step) here would destroy
+            # a previously DURABLE checkpoint when a step is re-saved
+            # over an existing one.
+            self._inflight = None
+            raise
+        self._inflight = None
+        self._apply_retention()
+
+    def _discard(self, path: str) -> None:
+        try:
+            if os.path.isdir(path):
+                shutil.rmtree(path, ignore_errors=True)
+            elif os.path.exists(path):
+                os.unlink(path)
+        except OSError:
+            pass
+
+    def _apply_retention(self) -> None:
+        steps = self.all_steps()
+        for step in steps[:-self.keep]:  # keep >= 1 enforced in __init__
+            logger.info("retention: dropping checkpoint step %d", step)
+            self._discard(self._path(step))
+
+    # -- restore -------------------------------------------------------
+
+    def verify(self, step: int) -> dict:
+        """Integrity pass over one step's checkpoint (checksums, torn
+        files).  Raises :class:`apex_tpu.checkpoint.CheckpointCorruptError`."""
+        path = self._path(step)
+        if self.sharded:
+            return ckpt.verify_checkpoint_sharded(path)
+        return ckpt.verify_checkpoint(path)
+
+    def restore_latest(self, like: Any, *, verify: bool = True):
+        """Restore the newest intact checkpoint into the structure (and
+        shardings) of ``like``; returns ``(tree, step)``.
+
+        Newest-first: each candidate is verified (full checksum pass)
+        before restore; a candidate that fails verification OR restore
+        is logged and skipped, falling back to the previous one — the
+        corrupted-newest case (bit-flipped shard, save killed between
+        rename and manifest commit) recovers automatically.  Raises
+        ``FileNotFoundError`` when no intact checkpoint exists.
+
+        The verify pass deliberately reads every array a second time
+        (restore reads them again): complete integrity is established
+        BEFORE any restore side effects, including for slices a sharded
+        restore would lazily skip.  ``verify=False`` trades that for
+        one-pass speed when the storage is trusted.
+        """
+        failures = []
+        for step in reversed(self.all_steps()):
+            path = self._path(step)
+            try:
+                if verify:
+                    self.verify(step)
+                if self.sharded:
+                    tree, at = ckpt.restore_checkpoint_sharded(path, like)
+                else:
+                    tree, at = ckpt.restore_checkpoint(path, like)
+                if failures:
+                    logger.warning(
+                        "restore_latest fell back to step %d past %s",
+                        step, "; ".join(failures))
+                return tree, at
+            except (ckpt.CheckpointCorruptError, ValueError, OSError,
+                    KeyError) as e:
+                failures.append(f"step {step}: {e!r}")
+                logger.warning(
+                    "checkpoint step %d unusable (%r); falling back",
+                    step, e)
+        raise FileNotFoundError(
+            f"no intact checkpoint under {self.directory!r}"
+            + (f" (tried: {'; '.join(failures)})" if failures else ""))

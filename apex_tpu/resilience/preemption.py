@@ -1,0 +1,74 @@
+"""SIGTERM-driven clean shutdown — preemptible-slice survival.
+
+Preemptible TPU hosts get a SIGTERM grace window before the SIGKILL.  The
+reference's answer at the scheduler layer is the ADLR autoresume polling
+protocol (``apex/transformer/testing/global_vars.py`` →
+``apex_tpu.transformer.testing.global_vars.AutoResume``); this module is
+the signal-layer complement: catch the signal, finish the step, drain any
+in-flight async checkpoint writes, take a final checkpoint, exit cleanly.
+
+Usage (the crash/resume smoke trainer drives exactly this)::
+
+    guard = PreemptionGuard()            # installs the SIGTERM handler
+    mgr = CheckpointManager(ckpt_dir)
+    for step in range(start, num_steps):
+        state = train_step(state, batch(step))
+        mgr.save_async(state, step)
+        if guard.triggered:               # grace window: wind down
+            mgr.wait()                    # drain: this step is durable
+            break
+    guard.uninstall()
+
+The handler only sets a flag (async-signal-safe); all real work happens
+on the main thread at the step boundary, so no jit dispatch, collective,
+or file write is ever interrupted mid-flight by the handler itself.
+"""
+
+from __future__ import annotations
+
+import signal
+import threading
+from typing import Iterable
+
+__all__ = ["PreemptionGuard"]
+
+
+class PreemptionGuard:
+    """Flag-setting signal handler for graceful preemption.
+
+    ``signals`` defaults to SIGTERM (what preemption sends); add SIGINT
+    to make Ctrl-C drain instead of tearing down mid-save.  Install from
+    the **main thread** (a CPython signal-API requirement).  Use as a
+    context manager or call :meth:`uninstall` to restore the previous
+    handlers.
+    """
+
+    def __init__(self, signals: Iterable[int] = (signal.SIGTERM,)):
+        self._event = threading.Event()
+        self._previous = {}
+        for sig in signals:
+            self._previous[sig] = signal.signal(sig, self._handle)
+
+    def _handle(self, signum, frame):
+        self._event.set()
+
+    @property
+    def triggered(self) -> bool:
+        """True once a shutdown signal has arrived (sticky)."""
+        return self._event.is_set()
+
+    def trigger(self) -> None:
+        """Programmatic preemption (fault injection / tests)."""
+        self._event.set()
+
+    def uninstall(self) -> None:
+        """Restore the previous signal handlers (idempotent)."""
+        for sig, prev in self._previous.items():
+            signal.signal(sig, prev)
+        self._previous = {}
+
+    def __enter__(self) -> "PreemptionGuard":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.uninstall()

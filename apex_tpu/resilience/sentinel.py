@@ -1,0 +1,157 @@
+"""Unified non-finite sentinel — one overflow guard for every trainer.
+
+The reference skips the optimizer step when any gradient is non-finite
+(``apex/amp/handle.py:128-154`` patches ``optimizer.step`` to a no-op;
+every multi-tensor kernel early-outs on the ``noop_flag``).  Our amp path
+already had that (``amp/scaler.py`` + ``skip_update``), but the ZeRO and
+3D-parallel trainers grew without it — a single NaN step would poison
+Adam moments and master weights across the whole job.  This module is the
+one guard all of them share:
+
+- :class:`SentinelState` carries the ``amp`` scaler state plus a
+  ``skipped_steps`` counter (surfaced through the trainers, the analog of
+  counting ``optimizer.step`` skips in the reference's logs);
+- :func:`sentinel_update` reuses ``amp.all_finite`` and the scaler's
+  ``update`` — overflow detection and loss-scale backoff are ONE
+  implementation, never re-derived per trainer;
+- :func:`guarded_optimizer_step` wraps the whole optimizer apply in a
+  single ``lax.cond``: on a non-finite step *nothing* runs — no
+  reduce-scatter, no Adam math, no all-gather; params and state pass
+  through bit-unchanged.  The predicate is a traced scalar, so the guard
+  stays inside the one compiled program (no host round-trip — assert via
+  :mod:`apex_tpu.testing.hlo` that ``conditional`` survives jit).
+
+Collective-safety: inside ``shard_map`` the local grads differ per rank,
+so a rank-local finite flag could diverge and deadlock the collectives
+inside the guarded branch.  ``sentinel_update(axes=...)`` therefore
+``pmin``-reduces the flag over the data axes first — every rank takes the
+same branch (the reference all-reduces its overflow flag for the same
+reason, ``apex/amp/scaler.py:usage in DDP``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from apex_tpu.amp.scaler import LossScaleState, all_finite
+
+__all__ = [
+    "SentinelState",
+    "sentinel_init",
+    "sentinel_update",
+    "sentinel_guarded_apply",
+    "guarded_optimizer_step",
+]
+
+
+class SentinelState(NamedTuple):
+    """Jit-carried overflow-sentinel state.
+
+    ``scaler``        — the ``amp`` :class:`LossScaleState` (scale,
+                        growth/hysteresis trackers, ``found_inf``).
+    ``skipped_steps`` — int32 count of updates skipped so far (the
+                        counter the 3D GPT trainer surfaces).
+    """
+
+    scaler: LossScaleState
+    skipped_steps: jnp.ndarray
+
+    @property
+    def scale(self):
+        return self.scaler.scale
+
+
+def sentinel_init(scaler_algo) -> SentinelState:
+    """Fresh sentinel state for a scaler algorithm
+    (``DynamicLossScale()``, ``StaticLossScale(...)``, ...)."""
+    return SentinelState(scaler=scaler_algo.init(),
+                         skipped_steps=jnp.int32(0))
+
+
+def sentinel_update(
+    scaler_algo,
+    grads: Any,
+    state: SentinelState,
+    *,
+    axes: Optional[Any] = None,
+) -> Tuple[jnp.ndarray, SentinelState]:
+    """One sentinel tick: check ``grads``, update scaler + skip counter.
+
+    Returns ``(finite, new_state)`` where ``finite`` is a traced bool —
+    globally agreed over ``axes`` when given (REQUIRED inside shard_map
+    whenever the guarded step contains collectives; see module
+    docstring).  Everything is jnp arithmetic: no host sync.
+    """
+    finite = all_finite(grads)
+    if axes is not None:
+        # pmin over the mesh: any rank's NaN vetoes the step everywhere.
+        finite = lax.pmin(finite.astype(jnp.int32), axes) > 0
+    new_scaler = scaler_algo.update(state.scaler, finite)
+    skipped = state.skipped_steps + jnp.where(finite, 0, 1).astype(jnp.int32)
+    return finite, SentinelState(scaler=new_scaler, skipped_steps=skipped)
+
+
+def sentinel_guarded_apply(
+    scaler_algo,
+    optimizer,
+    grads: Any,
+    opt_state: Any,
+    params: Any,
+    state: SentinelState,
+    *,
+    axes: Optional[Any] = None,
+    lr=None,
+    grad_scale=None,
+):
+    """The whole sentinel tick + guarded apply in one call — the ONE
+    copy of the check→update→cond-apply sequence every trainer threads
+    (a second hand-rolled copy is exactly how per-trainer overflow
+    handling diverged before this module).  Returns ``(params,
+    opt_state, new_sentinel_state)``.  ``axes`` is REQUIRED inside
+    ``shard_map`` when the optimizer communicates (see module
+    docstring); ``grad_scale`` is the scale the loss was multiplied by
+    — capture it BEFORE this call, since the update may back off."""
+    finite, state = sentinel_update(scaler_algo, grads, state, axes=axes)
+    params, opt_state = guarded_optimizer_step(
+        optimizer, grads, opt_state, params, finite,
+        lr=lr, grad_scale=grad_scale)
+    return params, opt_state, state
+
+
+def guarded_optimizer_step(
+    optimizer,
+    grads: Any,
+    opt_state: Any,
+    params: Any,
+    finite: jnp.ndarray,
+    *,
+    lr=None,
+    grad_scale=None,
+):
+    """The single ``lax.cond``-guarded apply: run ``optimizer.step`` only
+    when ``finite``; otherwise params and optimizer state pass through
+    bit-unchanged (and none of the step's collectives execute — a skipped
+    step costs no wire bytes, like the reference's skipped
+    ``optimizer.step``).
+
+    ``finite`` must be identical on every rank of any mesh axes the
+    optimizer communicates over (use ``sentinel_update(axes=...)``).
+    ``grad_scale`` folds the loss-scale division into the update
+    (``div_scale`` of the reference's multi-tensor kernels).
+    """
+
+    def do_step(g, s, p):
+        new_p, new_s = optimizer.step(g, s, p, lr=lr, grad_scale=grad_scale)
+        # step counters stay consistent with the number of APPLIED
+        # updates even though this branch only runs on finite steps.
+        return new_p, new_s
+
+    def skip_step(g, s, p):
+        return p, s
+
+    return lax.cond(jnp.asarray(finite), do_step, skip_step,
+                    grads, opt_state, params)

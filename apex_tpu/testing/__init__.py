@@ -1,4 +1,4 @@
 """Test/validation harnesses (L1 stored-baseline traces, compiled-HLO
-inspection)."""
+inspection, fault injection, crash/resume smoke trainer)."""
 
-from apex_tpu.testing import hlo, l1  # noqa: F401
+from apex_tpu.testing import faults, hlo, l1  # noqa: F401

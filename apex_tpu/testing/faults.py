@@ -1,0 +1,238 @@
+"""Fault injection — make the failure paths testable on a laptop.
+
+The resilience layer (:mod:`apex_tpu.resilience`) claims to survive
+non-finite gradients, torn/corrupted checkpoints, dying async writers,
+flaky filesystems, and SIGTERM preemption.  Claims proven by inspection
+rot; this module injects each failure deterministically so the fast tier
+drives save→kill→resume and corrupt→fallback→resume end to end:
+
+- :func:`poison_grads` — jit-safe NaN/Inf injection into a gradient tree
+  at a chosen step (a ``jnp.where`` on the step counter: the injection
+  itself compiles into the train step, so the sentinel is tested inside
+  the very program it guards);
+- :func:`bitflip_file` / :func:`truncate_file` /
+  :func:`corrupt_checkpoint` — storage damage (single flipped bit in the
+  array payload, torn tail) that per-array checksums must catch;
+- :func:`transient_os_errors` — a wrapped filesystem raising
+  ``OSError`` from the first N matching operations (the NFS/GCS-fuse
+  blip the manager's retry-with-backoff exists for), scoped by path
+  prefix so only checkpoint traffic is hit;
+- :func:`hung_writes` — park async checkpoint writers on an event, so a
+  test can kill/abandon a writer provably mid-flight and assert no torn
+  checkpoint becomes visible;
+- :func:`simulate_sigterm` — deliver a real SIGTERM to the process (the
+  preemption grace signal), driving
+  :class:`apex_tpu.resilience.PreemptionGuard`.
+
+Everything restores global state on exit; the context managers are
+reentrancy-hostile by design (one fault at a time — compose scenarios
+sequentially, as production failures arrive).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import errno
+import os
+import signal
+import threading
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "poison_grads",
+    "bitflip_file",
+    "truncate_file",
+    "corrupt_checkpoint",
+    "transient_os_errors",
+    "hung_writes",
+    "simulate_sigterm",
+]
+
+
+# ---------------------------------------------------------------------------
+# Non-finite gradients
+# ---------------------------------------------------------------------------
+
+
+def poison_grads(grads, *, step, at_step, kind: str = "nan",
+                 leaf: int = 0):
+    """Return ``grads`` with leaf ``leaf`` filled with NaN/Inf when
+    ``step == at_step`` — pure jnp, so it stages into the jitted train
+    step (``step`` may be a traced counter).  ``kind``: ``"nan"``,
+    ``"inf"``, or ``"-inf"``."""
+    bad = {"nan": np.nan, "inf": np.inf, "-inf": -np.inf}[kind]
+    leaves, treedef = jax.tree_util.tree_flatten(grads)
+    x = leaves[leaf]
+    leaves[leaf] = jnp.where(jnp.asarray(step) == at_step,
+                             jnp.full_like(x, bad), x)
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+# ---------------------------------------------------------------------------
+# Storage corruption
+# ---------------------------------------------------------------------------
+
+
+def bitflip_file(path: str, *, frac: float = 0.75, bit: int = 3) -> int:
+    """Flip one bit inside an ARRAY PAYLOAD of an ``.npz`` checkpoint
+    (not zip metadata, which nothing checksums): the data offset is read
+    from the zip directory, targeting the last non-manifest entry.  For
+    non-zip files, flips at ``frac`` of the file.  Returns the byte
+    offset flipped.  The damage must trip both zipfile's entry CRC and
+    the manifest crc32."""
+    import zipfile
+
+    size = os.path.getsize(path)
+    off = min(size - 1, max(0, int(size * frac)))
+    try:
+        with zipfile.ZipFile(path) as zf:
+            infos = [i for i in zf.infolist()
+                     if i.filename != "__manifest__.npy"] or zf.infolist()
+            info = infos[-1]
+            with open(path, "rb") as f:
+                # local header: 26..28 hold name/extra lengths; payload
+                # starts after the 30-byte header + name + extra.
+                f.seek(info.header_offset + 26)
+                n, m = np.frombuffer(f.read(4), dtype="<u2")
+            data_start = info.header_offset + 30 + int(n) + int(m)
+            # skip the ~100-byte .npy header too: land in raw values
+            off = min(data_start + max(128, info.compress_size // 2),
+                      data_start + info.compress_size - 1)
+    except Exception:
+        pass  # not a zip (or torn already): positional flip
+    with open(path, "r+b") as f:
+        f.seek(off)
+        b = f.read(1)
+        f.seek(off)
+        f.write(bytes([b[0] ^ (1 << bit)]))
+    return off
+
+
+def truncate_file(path: str, *, keep_frac: float = 0.5) -> None:
+    """Tear the file's tail off — the torn-write shape a crashed
+    non-atomic writer (or a lying filesystem) produces.  For ``.npz``
+    this destroys the zip central directory: the archive does not even
+    open."""
+    size = os.path.getsize(path)
+    with open(path, "r+b") as f:
+        f.truncate(max(1, int(size * keep_frac)))
+
+
+def corrupt_checkpoint(path: str, *, mode: str = "bitflip",
+                       shard: int = 0) -> str:
+    """Damage a checkpoint: ``path`` may be a flat ``.npz`` file or a
+    sharded checkpoint directory (then ``shard_{shard}.npz`` inside it
+    is hit).  ``mode``: ``"bitflip"`` or ``"truncate"``.  Returns the
+    file actually damaged."""
+    target = path
+    if os.path.isdir(path):
+        target = os.path.join(path, f"shard_{shard}.npz")
+    if mode == "bitflip":
+        bitflip_file(target)
+    elif mode == "truncate":
+        truncate_file(target)
+    else:
+        raise ValueError(f"unknown corruption mode {mode!r}")
+    return target
+
+
+# ---------------------------------------------------------------------------
+# Flaky / hung filesystem
+# ---------------------------------------------------------------------------
+
+
+@contextlib.contextmanager
+def transient_os_errors(times: int, *, path_prefix: str,
+                        op: str = "replace",
+                        err: int = errno.EIO):
+    """Make ``os.<op>`` (default the atomic-rename commit point) raise
+    ``OSError(err)`` for the first ``times`` calls whose first argument
+    starts with ``path_prefix``.  Later calls pass through — the
+    *transient* failure the manager's retry-with-backoff absorbs.
+    ``path_prefix`` is REQUIRED so only the intended traffic is hit:
+    unrelated subsystems rename files too (e.g. the persistent XLA
+    compilation cache), and an unscoped fault would be consumed by them,
+    silently blunting the test.  Yields a counter object with
+    ``.failed`` (injected-failure count).
+    """
+    real = getattr(os, op)
+    lock = threading.Lock()
+
+    class _Counter:
+        failed = 0
+
+    counter = _Counter()
+
+    def flaky(*args, **kwargs):
+        src = os.fspath(args[0]) if args else ""
+        with lock:
+            inject = (counter.failed < times
+                      and str(src).startswith(path_prefix))
+            if inject:
+                counter.failed += 1
+        if inject:
+            raise OSError(err, f"injected transient {op} failure "
+                               f"#{counter.failed}", str(src))
+        return real(*args, **kwargs)
+
+    setattr(os, op, flaky)
+    try:
+        yield counter
+    finally:
+        setattr(os, op, real)
+
+
+class _HangHandle:
+    """Controls writers parked by :func:`hung_writes`."""
+
+    def __init__(self):
+        self._gate = threading.Event()
+        self.entered = threading.Event()  # a writer reached the gate
+
+    def release(self) -> None:
+        """Let parked (and all future) writers proceed."""
+        self._gate.set()
+
+
+@contextlib.contextmanager
+def hung_writes(*, path_prefix: str = ""):
+    """Park every checkpoint write whose destination starts with
+    ``path_prefix`` on a gate *before any byte is written*.  The test
+    now provably holds a writer mid-flight: abandon it, overlap another
+    save, or ``release()`` it.  On context exit the gate opens (no
+    writer leaks parked)."""
+    from apex_tpu import checkpoint as ckpt
+
+    handle = _HangHandle()
+    real = ckpt._write_npz
+
+    def gated(path, manifest, arrays):
+        if str(path).startswith(path_prefix):
+            handle.entered.set()
+            handle._gate.wait()
+        return real(path, manifest, arrays)
+
+    ckpt._write_npz = gated
+    try:
+        yield handle
+    finally:
+        handle.release()
+        ckpt._write_npz = real
+
+
+# ---------------------------------------------------------------------------
+# Preemption
+# ---------------------------------------------------------------------------
+
+
+def simulate_sigterm(pid: Optional[int] = None) -> None:
+    """Deliver a real SIGTERM (the preemption grace signal) to ``pid``
+    (default: this process).  With a
+    :class:`apex_tpu.resilience.PreemptionGuard` installed this sets the
+    drain flag; without one, default signal disposition applies — so
+    install the guard first."""
+    os.kill(os.getpid() if pid is None else pid, signal.SIGTERM)

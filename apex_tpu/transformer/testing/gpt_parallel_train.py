@@ -242,14 +242,51 @@ def build_gpt_3d(
 
         return loss_fn
 
-    def make_train_step(opt, param_specs):
+    def make_train_step(opt, param_specs, scaler=None, grad_tap=None):
+        """``scaler=None``: the plain step.  With an ``amp`` scaler
+        algorithm the unified non-finite sentinel
+        (:mod:`apex_tpu.resilience.sentinel`) is threaded through: the
+        loss is scaled, gradients overflow-checked (on the *global*
+        grads, outside the shard_map — every rank sees the same flag),
+        and the optimizer apply runs under one ``lax.cond`` so an
+        overflow step leaves params and optimizer state bit-unchanged;
+        ``sentinel.skipped_steps`` surfaces the skip count.  Signature
+        becomes ``step(params, state, tokens, sentinel) -> (params,
+        state, sentinel, loss)`` (loss reported unscaled).
+
+        ``grad_tap`` (sentinel path only): a ``grads -> grads`` hook
+        applied between the backward and the sentinel check — the seam
+        the fault harness (:mod:`apex_tpu.testing.faults`) uses to
+        inject non-finite gradients inside the compiled step."""
         loss_fn = make_loss_fn(param_specs)
 
-        def step(params, state, tokens):
-            loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
-            new_p, new_state = opt.step(grads, state, params)
-            return new_p, new_state, loss
+        if scaler is None:
+            def step(params, state, tokens):
+                loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
+                new_p, new_state = opt.step(grads, state, params)
+                return new_p, new_state, loss
 
-        return step
+            return step
+
+        from apex_tpu.resilience.sentinel import sentinel_guarded_apply
+
+        def guarded_step(params, state, tokens, sent):
+            scale_used = sent.scaler.scale
+
+            def scaled_loss(p, t):
+                return loss_fn(p, t) * scale_used
+
+            loss_s, grads = jax.value_and_grad(scaled_loss)(params, tokens)
+            if grad_tap is not None:
+                grads = grad_tap(grads)
+            # grads here are GLOBAL arrays (the shard_map lives inside
+            # loss_fn), so no cross-rank flag agreement is needed:
+            # axes=None.
+            new_p, new_state, sent = sentinel_guarded_apply(
+                scaler, opt, grads, state, params, sent,
+                grad_scale=scale_used)
+            return new_p, new_state, sent, loss_s / scale_used
+
+        return guarded_step
 
     return init_fn, make_loss_fn, make_train_step

@@ -31,6 +31,11 @@ individually guarded so one failure cannot empty the record:
                               vs per-leaf ``DistributedFusedAdam`` vs
                               replicated ``FusedAdam`` (``vs_per_leaf``
                               < 1 = the bucketed exchange wins)
+- ``ckpt_save_restore``     — checkpoint-path wall-time: save/verify/
+                              restore for the flat vs sharded layouts
+                              (``vs_sharded`` = flat/sharded total), so
+                              crash-safety machinery (checksums, fsync,
+                              manifest commit) shows regressions
 - ``input_pipeline``        — host decode + packed decode-free loader rates
                               vs the chip's consumption rate
 - ``real_data_rn50``        — end-to-end real-JPEG training through the
@@ -1171,6 +1176,78 @@ def bench_zero_adam_step(jax, on_tpu):
     }
 
 
+def bench_ckpt_save_restore(jax, on_tpu):
+    """Checkpoint-path wall-time (ISSUE 3): save / verify / restore for
+    the flat (``save_checkpoint``) vs sharded (``save_checkpoint_sharded``)
+    layouts on the same train-state-shaped tree, so checkpoint-path
+    regressions (checksumming cost, fsync stalls, manifest overhead)
+    show up in the perf trajectory like any compute row.  ``vs_sharded``
+    = flat total / sharded total (< 1 = flat faster; sharded wins once
+    per-process parallel writes matter, which a single host can't show).
+    On CPU the child runs with 8 virtual devices so the sharded layout
+    actually splits shards over a dp mesh."""
+    import tempfile
+
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from apex_tpu import checkpoint as ckpt
+    from apex_tpu import parallel
+
+    n_tensors = 32
+    size = 262_144 if on_tpu else 32_768  # fp32 elems per leaf
+    reps = 3
+    mesh = parallel.initialize_model_parallel()  # all devices on dp
+    sharding = NamedSharding(mesh, P(("dcn", "dp")))
+    tree = {
+        f"w{i}": jax.device_put(
+            jnp.full((size,), float(i % 7) + 0.5, jnp.float32), sharding)
+        for i in range(n_tensors)
+    }
+    jax.block_until_ready(tree)
+    nbytes = n_tensors * size * 4
+
+    def timed(fn):
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            fn()
+            best = min(best, time.perf_counter() - t0)
+        return best * 1e3  # ms
+
+    with tempfile.TemporaryDirectory() as d:
+        flat = os.path.join(d, "flat.npz")
+        flat_save = timed(lambda: ckpt.save_checkpoint(flat, tree, step=1))
+        flat_verify = timed(lambda: ckpt.verify_checkpoint(flat))
+        flat_restore = timed(lambda: ckpt.restore_checkpoint(flat, tree))
+
+        shd = os.path.join(d, "sharded")
+        shd_save = timed(
+            lambda: ckpt.save_checkpoint_sharded(shd, tree, step=1))
+        shd_verify = timed(lambda: ckpt.verify_checkpoint_sharded(shd))
+        shd_restore = timed(
+            lambda: ckpt.restore_checkpoint_sharded(shd, tree))
+
+    parallel.destroy_model_parallel()
+    flat_total = flat_save + flat_verify + flat_restore
+    shd_total = shd_save + shd_verify + shd_restore
+    return {
+        "value": round(flat_total, 2),
+        "unit": "ms/save+verify+restore",
+        "config": "flat",
+        "flat_save_ms": round(flat_save, 2),
+        "flat_verify_ms": round(flat_verify, 2),
+        "flat_restore_ms": round(flat_restore, 2),
+        "sharded_save_ms": round(shd_save, 2),
+        "sharded_verify_ms": round(shd_verify, 2),
+        "sharded_restore_ms": round(shd_restore, 2),
+        "vs_sharded": round(flat_total / max(shd_total, 1e-9), 3),
+        "checkpoint_mb": round(nbytes / 2**20, 1),
+        "dp": mesh.shape["dp"] if "dp" in mesh.shape else 1,
+    }
+
+
 # ---------------------------------------------------------------------------
 
 BENCHES = {
@@ -1183,6 +1260,7 @@ BENCHES = {
     "tp_gpt": bench_tp_gpt,
     "fused_adam_step": bench_fused_adam_step,
     "zero_adam_step": bench_zero_adam_step,
+    "ckpt_save_restore": bench_ckpt_save_restore,
     "input_pipeline": bench_input_pipeline,
     "real_data_rn50": bench_real_data_rn50,
     # Diagnostic-only combos (run via ``--one``, not in BENCH_ORDER):
@@ -1203,7 +1281,7 @@ BENCHES = {
 # back to CPU because tp_gpt ate 900 s + the retry).
 BENCH_ORDER = ["resnet50_o2", "gpt_flash", "bert_large",
                "resnet50_lamb_syncbn", "fused_adam_step",
-               "zero_adam_step",
+               "zero_adam_step", "ckpt_save_restore",
                "gpt_flash_fp8", "gpt_long_context", "input_pipeline",
                "real_data_rn50", "tp_gpt"]
 
@@ -1237,7 +1315,7 @@ def _run_child(name: str, platform: str, timeout: float) -> dict:
     env = dict(os.environ)
     if platform == "cpu":
         env["JAX_PLATFORMS"] = "cpu"
-        if name in ("tp_gpt", "zero_adam_step"):
+        if name in ("tp_gpt", "zero_adam_step", "ckpt_save_restore"):
             # r3 VERDICT weak #5: tp_gpt at tp=1 on the single bench chip
             # exercises zero TP collectives.  The CPU row instead runs a
             # *real* tp=8 shard_map on a virtual 8-device host mesh, so at
@@ -1245,6 +1323,8 @@ def _run_child(name: str, platform: str, timeout: float) -> dict:
             # the row's "measured" field states exactly what it is.
             # zero_adam_step needs the same mesh: its whole point is the
             # flat-bucket-vs-per-leaf collective count over dp=8.
+            # ckpt_save_restore: the sharded layout only splits shards
+            # when there is a real multi-device dp mesh to shard over.
             env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
                                 + " --xla_force_host_platform_device_count=8")
     _log(f"launching {name} (timeout {timeout:.0f}s)")
@@ -1275,7 +1355,7 @@ def _run_child(name: str, platform: str, timeout: float) -> dict:
 # Expected single-chip TPU runtimes are minutes; a wedge burns the whole
 # per-bench budget, so cheap benches get tighter caps than the 900s default.
 _TPU_BENCH_CAP_S = {"fused_adam_step": 420.0, "zero_adam_step": 420.0,
-                    "tp_gpt": 900.0}
+                    "ckpt_save_restore": 420.0, "tp_gpt": 900.0}
 
 
 # Failed TPU attempts per bench that were *not* attributable to a chip
@@ -1441,7 +1521,8 @@ def compact_record(record, max_bytes: int = 1500) -> dict:
     future record still exceeds ``max_bytes``; never returns an oversized
     payload."""
     row_keys = ("value", "unit", "mfu", "platform", "vs_native", "vs_bf16",
-                "vs_synthetic", "vs_per_leaf", "vs_monolithic")
+                "vs_synthetic", "vs_per_leaf", "vs_monolithic",
+                "vs_sharded")
     rows = {}
     for name, row in list(record.get("extras", {}).items()):
         if not isinstance(row, dict):

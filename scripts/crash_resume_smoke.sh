@@ -1,0 +1,105 @@
+#!/usr/bin/env bash
+# Crash/resume smoke (ISSUE 3): the save→SIGKILL→resume proof, end to
+# end through the 3D GPT trainer (apex_tpu.testing.crash_resume).
+#
+#   1. an uninterrupted N-step run records its loss curve;
+#   2. a second run is SIGKILLed mid-run (after >= KILL_AFTER checkpoints
+#      landed — the kill races the in-flight async sharded save on
+#      purpose: whatever state disk is in, recovery must work);
+#   3. optionally ($CORRUPT_NEWEST=1) the newest checkpoint is bit-flipped
+#      on top, so the resume must ALSO fall back past it by checksum;
+#   4. the run is resumed from the latest verified checkpoint and must
+#      reproduce the uninterrupted loss curve BIT-EXACTLY (losses are
+#      logged as raw fp32 bits).
+#
+# Usage: scripts/crash_resume_smoke.sh [workdir]
+# Env: STEPS (default 6), KILL_AFTER (default 2), CORRUPT_NEWEST (0/1),
+#      PYTHON (default python).
+# Exit 0 = bit-exact resume; non-zero otherwise.
+set -u -o pipefail
+
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+WORK="${1:-$(mktemp -d)}"
+STEPS="${STEPS:-6}"
+KILL_AFTER="${KILL_AFTER:-2}"
+CORRUPT_NEWEST="${CORRUPT_NEWEST:-0}"
+PYTHON="${PYTHON:-python}"
+mkdir -p "$WORK"
+cd "$REPO"
+
+run_trainer() { # args: ckpt_dir losses_file [extra flags...]
+  "$PYTHON" -m apex_tpu.testing.crash_resume \
+    --ckpt-dir "$1" --losses "$2" --steps "$STEPS" "${@:3}"
+}
+
+echo "crash_resume_smoke: [1/4] uninterrupted run" >&2
+rm -f "$WORK/losses_ref.txt"
+run_trainer "$WORK/ckpt_ref" "$WORK/losses_ref.txt" || exit 1
+[ "$(wc -l < "$WORK/losses_ref.txt")" -eq "$STEPS" ] || {
+  echo "reference run logged wrong number of steps" >&2; exit 1; }
+
+echo "crash_resume_smoke: [2/4] interrupted run (SIGKILL mid-run)" >&2
+rm -rf "$WORK/ckpt_crash"; rm -f "$WORK/losses_crash.txt"
+# background the python DIRECTLY (no function/subshell wrapper): $! must
+# be the trainer's own PID or the SIGKILL hits a wrapper and the trainer
+# survives to completion, making the resume vacuous.  --step-delay
+# throttles ONLY this run: with the compilation cache warm from run 1,
+# an unthrottled trainer can finish all steps between two poll ticks and
+# the SIGKILL would race (observed flake) — the per-step sleep while the
+# async save is in flight makes the kill window deterministic.
+"$PYTHON" -m apex_tpu.testing.crash_resume \
+  --ckpt-dir "$WORK/ckpt_crash" --losses "$WORK/losses_crash.txt" \
+  --steps "$STEPS" --step-delay 0.6 &
+PID=$!
+# wait until KILL_AFTER losses are logged (=> that many saves kicked
+# off), then SIGKILL — possibly mid-async-sharded-write
+for _ in $(seq 1 600); do
+  n=0
+  [ -f "$WORK/losses_crash.txt" ] && n=$(wc -l < "$WORK/losses_crash.txt")
+  if [ "$n" -ge "$KILL_AFTER" ]; then break; fi
+  if ! kill -0 "$PID" 2>/dev/null; then
+    echo "trainer exited before the kill point" >&2; wait "$PID"; exit 1
+  fi
+  sleep 0.1
+done
+kill -9 "$PID" 2>/dev/null
+wait "$PID" 2>/dev/null
+KILLED_AT=$(wc -l < "$WORK/losses_crash.txt")
+echo "crash_resume_smoke: killed after $KILLED_AT steps" >&2
+# the crash must be real: a trainer that finished anyway proves nothing
+[ "$KILLED_AT" -lt "$STEPS" ] || {
+  echo "trainer completed before SIGKILL landed — raise STEPS" >&2; exit 1; }
+
+if [ "$CORRUPT_NEWEST" = "1" ]; then
+  echo "crash_resume_smoke: [3/4] bit-flipping the newest checkpoint" >&2
+  # the injection must not fail silently: a skipped corruption would
+  # green-light a run that never exercised the checksum-fallback path
+  "$PYTHON" - "$WORK/ckpt_crash" <<'EOF'
+import os, sys
+from apex_tpu.testing import faults
+root = sys.argv[1]
+# newest step dir that actually HAS a shard: the SIGKILL may have left
+# the very newest dir empty (created, shard never durable)
+steps = sorted(d for d in os.listdir(root) if d.startswith("step_")
+               and os.path.exists(os.path.join(root, d, "shard_0.npz")))
+if not steps:
+    sys.exit("no corruptible checkpoint found")
+target = os.path.join(root, steps[-1])
+print("corrupting", faults.corrupt_checkpoint(target), file=sys.stderr)
+EOF
+  [ $? -eq 0 ] || { echo "corruption injection failed" >&2; exit 1; }
+else
+  echo "crash_resume_smoke: [3/4] skipping corruption (CORRUPT_NEWEST=0)" >&2
+fi
+
+echo "crash_resume_smoke: [4/4] resume from latest verified checkpoint" >&2
+run_trainer "$WORK/ckpt_crash" "$WORK/losses_crash.txt" --resume || exit 1
+
+if cmp -s "$WORK/losses_ref.txt" "$WORK/losses_crash.txt"; then
+  echo "crash_resume_smoke: PASS — resumed loss curve bit-identical" >&2
+  exit 0
+else
+  echo "crash_resume_smoke: FAIL — loss curves differ:" >&2
+  diff "$WORK/losses_ref.txt" "$WORK/losses_crash.txt" >&2 || true
+  exit 1
+fi

@@ -144,6 +144,37 @@ class TestDynamicLossScale:
         kept = algo.adjust(new, old, s)
         np.testing.assert_allclose(np.asarray(kept["w"]), 1.0)
 
+    def test_adjust_mixed_dtype_tree(self):
+        """``adjust`` over a realistic mixed train state (bf16 params,
+        fp8 quantized buffers + delayed-scaling ``Fp8Meta``, int
+        counters): the predicated select must preserve every leaf's
+        dtype and pick per-leaf correctly on both branches (ISSUE 3
+        satellite — the unified sentinel predicates whole state trees,
+        not just fp16 params)."""
+        from apex_tpu.amp.fp8 import E4M3, Fp8Meta
+
+        algo = amp.DynamicLossScale()
+
+        def tree(v):
+            return {
+                "w": jnp.full((2, 2), v, jnp.bfloat16),
+                "q": jnp.full((3,), v, E4M3),
+                "meta": Fp8Meta(
+                    amax_history=jnp.full((4,), v, jnp.float32),
+                    scale=jnp.float32(v)),
+                "steps": jnp.int32(int(v)),
+            }
+
+        old, new = tree(1.0), tree(2.0)
+        for finite, want in [(False, old), (True, new)]:
+            s = algo.update(algo.init(), finite)
+            kept = algo.adjust(new, old, s)
+            for k, w in zip(jax.tree_util.tree_leaves(kept),
+                            jax.tree_util.tree_leaves(want)):
+                assert k.dtype == w.dtype
+                np.testing.assert_array_equal(np.asarray(k),
+                                              np.asarray(w))
+
 
 class TestAllFinite:
     def test_finite(self):
@@ -157,6 +188,50 @@ class TestAllFinite:
 
     def test_ignores_ints(self):
         assert bool(amp.all_finite({"ids": jnp.arange(3)}))
+
+    # Mixed-dtype trees (ISSUE 3 satellite): the unified sentinel runs
+    # all_finite over whole train-state grads/trees — fp8 delayed-scaling
+    # state, int leaves, bool flags — so only the fp16 happy path being
+    # covered would let a dtype regression slip under the sentinel.
+
+    def test_mixed_tree_with_fp8_and_ints_finite(self):
+        from apex_tpu.amp.fp8 import E4M3, E5M2, Fp8Meta
+
+        tree = {
+            "w": jnp.ones((2, 2), jnp.bfloat16),
+            "q_act": jnp.ones((3,), E4M3),
+            "q_grad": jnp.ones((3,), E5M2),
+            "fp8_meta": Fp8Meta.init(history_len=4),
+            "ids": jnp.arange(3),
+            "flag": jnp.asarray(True),
+            "count": 5,
+        }
+        assert bool(amp.all_finite(tree))
+
+    def test_fp8_nan_detected(self):
+        """e4m3fn has NaN (no inf): a NaN fp8 leaf must trip the
+        sentinel exactly like an fp16 one."""
+        from apex_tpu.amp.fp8 import E4M3
+
+        bad = jnp.asarray(jnp.nan, jnp.float32).astype(E4M3)
+        assert not bool(amp.all_finite({"q": jnp.array([bad, bad])}))
+
+    def test_fp8_e5m2_inf_detected(self):
+        from apex_tpu.amp.fp8 import E5M2
+
+        bad = jnp.asarray(jnp.inf, jnp.float32).astype(E5M2)
+        assert not bool(amp.all_finite({"q": jnp.array([bad])}))
+
+    def test_nonfinite_int_neighbor_does_not_mask(self):
+        """Int leaves are skipped but must not short-circuit a NaN in a
+        floating sibling (regression guard on the leaf filter)."""
+        tree = {"ids": jnp.arange(4), "g": jnp.array([jnp.nan]),
+                "more_ids": jnp.zeros((2,), jnp.int8)}
+        assert not bool(amp.all_finite(tree))
+
+    def test_all_int_tree_is_finite(self):
+        assert bool(amp.all_finite({"a": jnp.arange(2),
+                                    "b": np.arange(3)}))
 
 
 class TestMasterWeights:

@@ -5,11 +5,12 @@ last JSON line.  Rounds 1-4 all delivered ``parsed: null`` because the
 full record line grew past the tail size.  These tests pin the fix: every
 emission ends with a compact line that (a) is <= 1500 bytes, (b) parses,
 (c) carries the driver contract fields, and (d) survives a simulated
-2000-byte tail even in the worst case (all eleven BENCH_ORDER rows
+2000-byte tail even in the worst case (all twelve BENCH_ORDER rows
 verbose — including ``real_data_rn50`` with its ``vs_synthetic``
-composition, ``zero_adam_step`` with ``vs_per_leaf``, and ``tp_gpt``
+composition, ``zero_adam_step`` with ``vs_per_leaf``, ``tp_gpt``
 with its overlap_comm A/B fields (``overlap_tokens_per_sec`` /
-``vs_monolithic``) — + embedded prior TPU evidence).
+``vs_monolithic``), and ``ckpt_save_restore`` with ``vs_sharded`` —
++ embedded prior TPU evidence).
 """
 
 import io
@@ -23,10 +24,10 @@ import bench  # noqa: E402
 
 
 def _worst_case_results():
-    """All eleven BENCH_ORDER rows, each fattened with prose fields, like
+    """All twelve BENCH_ORDER rows, each fattened with prose fields, like
     a CPU-fallback day — the REAL worst case (the pre-fix nine-row set
-    under-tested the <=1500-byte guarantee once ``real_data_rn50`` and
-    ``zero_adam_step`` landed)."""
+    under-tested the <=1500-byte guarantee once ``real_data_rn50``,
+    ``zero_adam_step``, and ``ckpt_save_restore`` landed)."""
     rows = {
         "resnet50_o2": {"value": 8824.6, "unit": "images/sec/chip"},
         "gpt_flash": {"value": 95167.3, "unit": "tokens/sec/chip",
@@ -41,6 +42,9 @@ def _worst_case_results():
                             "vs_native": 0.706},
         "zero_adam_step": {"value": 359273.7, "unit": "us/step",
                            "vs_per_leaf": 0.655},
+        "ckpt_save_restore": {"value": 523.4,
+                              "unit": "ms/save+verify+restore",
+                              "vs_sharded": 1.113},
         "gpt_flash_fp8": {"value": 4112.3, "unit": "tokens/sec/chip"},
         "gpt_long_context": {"value": 2580.7, "unit": "tokens/sec/chip"},
         "input_pipeline": {"value": 9685.0, "unit": "images/sec"},
@@ -81,6 +85,7 @@ def test_compact_record_under_1500_bytes():
     assert compact["rows"]["real_data_rn50"]["vs_synthetic"] == 0.693
     assert compact["rows"]["zero_adam_step"]["vs_per_leaf"] == 0.655
     assert compact["rows"]["tp_gpt"]["vs_monolithic"] == 1.088
+    assert compact["rows"]["ckpt_save_restore"]["vs_sharded"] == 1.113
 
 
 def test_compact_record_degrades_instead_of_overflowing():

@@ -273,8 +273,12 @@ def test_sharded_restore_across_mesh_shapes(tmp_path):
 
 
 def test_sharded_rejects_stale_and_casts_dtype(tmp_path):
-    """Stale extra shard files fail loudly; restore casts to the
-    template's dtype (the portable-precision flow)."""
+    """Stale extra shard files are ignored when the committed
+    ``manifest.json`` is present (it names exactly the files the save
+    owns) and fail loudly on legacy dirs without one; restore casts to
+    the template's dtype (the portable-precision flow)."""
+    import os
+
     from jax.sharding import NamedSharding
 
     from apex_tpu.checkpoint import (
@@ -289,16 +293,25 @@ def test_sharded_rejects_stale_and_casts_dtype(tmp_path):
                            NamedSharding(mesh, P(("dcn", "dp"), None)))
         save_checkpoint_sharded(ckpt, {"w": w}, step=2)
 
-        # stale file from an imaginary larger-cluster run
+        # stale file from an imaginary larger-cluster run: the committed
+        # manifest does not reference it, so restore ignores it
         import shutil
 
         shutil.copy(f"{ckpt}/shard_0.npz", f"{ckpt}/shard_7.npz")
         like = {"w": w}
+        restored, step = restore_checkpoint_sharded(ckpt, like)
+        assert step == 2
+
+        # legacy dir (no committed manifest): the stale file fails loudly
+        os.unlink(f"{ckpt}/manifest.json")
         with pytest.raises(ValueError, match="stale|duplicate"):
             restore_checkpoint_sharded(ckpt, like)
 
-        # re-saving into the same dir cleans the stale file
+        # re-saving into the legacy dir cleans the stale file (the old
+        # index-vs-process_count rule still applies without a committed
+        # manifest) and recommits manifest.json
         save_checkpoint_sharded(ckpt, {"w": w}, step=3)
+        assert not os.path.exists(f"{ckpt}/shard_7.npz")
         restored, step = restore_checkpoint_sharded(ckpt, like)
         assert step == 3
 

@@ -1,0 +1,36 @@
+"""Crash/resume smoke, fast tier (ISSUE 3 CI satellite).
+
+Runs ``scripts/crash_resume_smoke.sh`` in a subprocess — the real
+save→SIGKILL→resume sequence through the 3D GPT trainer with async
+sharded checkpoints, plus a bit-flip of the newest checkpoint so the
+resume must ALSO fall back past it by checksum.  Subprocess for the same
+reason as ``tests/test_entry_dryrun.py``: platform pinning and the
+device count must precede backend init, and a SIGKILL needs a process to
+kill.  The script asserts the resumed loss curve is bit-identical to an
+uninterrupted run (losses logged as raw fp32 bits) and that the kill
+landed mid-run (a trainer that finished anyway fails the script).
+"""
+
+import os
+import subprocess
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_crash_resume_smoke_bit_exact(tmp_path):
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)  # the trainer pins its own device count
+    env["JAX_PLATFORMS"] = "cpu"
+    env["CORRUPT_NEWEST"] = "1"
+    env["PYTHON"] = sys.executable
+    proc = subprocess.run(
+        ["bash", os.path.join(_REPO, "scripts", "crash_resume_smoke.sh"),
+         str(tmp_path / "work")],
+        cwd=_REPO, env=env, capture_output=True, timeout=540,
+    )
+    assert proc.returncode == 0, (
+        f"crash_resume_smoke.sh rc={proc.returncode}\n"
+        f"stderr tail:\n{proc.stderr.decode(errors='replace')[-3000:]}"
+    )
+    assert b"PASS" in proc.stderr
