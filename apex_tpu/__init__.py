@@ -22,6 +22,8 @@ Top-level layout (mirrors the reference export list ``apex/__init__.py:9``):
 - :mod:`apex_tpu.parallel`       — mesh builder, collectives, DDP analog, SyncBN
 - :mod:`apex_tpu.resilience`     — crash-safe checkpoint lifecycle, non-finite
   sentinel, preemption handling (the GradScaler/recoverable-state survival layer)
+- :mod:`apex_tpu.analysis`       — static jaxpr/HLO graph linter mechanizing the
+  mesh-correctness rules (no Apex analog; veScale-style consistency checking)
 - :mod:`apex_tpu.transformer`    — tensor/sequence/pipeline-parallel runtime
 - :mod:`apex_tpu.models`         — reference models (MLP, ResNet, GPT, BERT)
 - :mod:`apex_tpu.contrib`        — optional extensions (group_norm, sparsity, ...)

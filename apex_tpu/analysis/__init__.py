@@ -1,0 +1,81 @@
+"""Static analysis of staged programs — the mesh-correctness linter.
+
+Three PRs in, the repo's hardest-won invariants lived only as prose and
+ad-hoc asserts: the old-jax rank-0/shard_map tracing footgun (the PR 2
+``_SpecError`` hunt) was a ROADMAP paragraph, the ring-decomposition
+survival proof was a per-test opcode count, and the sentinel's
+"a skipped step moves no collective bytes" contract was one hand-rolled
+HLO string assert.  veScale (PAPERS.md) argues SPMD tensor programs need
+*consistency checking as a first-class pass*; this package is that layer
+for apex_tpu — every prose rule mechanized as a registered, documented
+check emitting structured findings.
+
+Two inspection tiers (``docs/analysis.md`` has the full rulebook):
+
+- **jaxpr tier** (:mod:`~apex_tpu.analysis.jaxpr_tier`): trace a function
+  *without executing it* and walk the closed jaxpr — rank-0 differentiated
+  values crossing ``shard_map``/``shard_over`` boundaries (APX101),
+  collectives under a ``lax.cond`` whose predicate is not axis-agreed
+  (APX102), collectives over axis names absent from the enclosing mesh
+  (APX103), malformed ``ppermute`` permutations (APX104).
+- **HLO tier** (:mod:`~apex_tpu.analysis.hlo`): rule-based checks on
+  *optimized* HLO — ring integrity for ``overlap_comm`` (APX201),
+  ``collective-permute`` pair well-formedness (APX202), ``conditional``
+  survival for the sentinel-guarded apply (APX203), and the
+  donation/aliasing audit (APX204).
+
+Entry points:
+
+- :func:`lint_traced` / :func:`lint_hlo` — lint one function / one
+  compiled-HLO text; both return a :class:`~apex_tpu.analysis.findings.Report`.
+- ``python -m apex_tpu.analysis --all-entries`` — run the whole rulebook
+  over the registered entry configs (3D GPT trainer, ZeRO train steps,
+  dryrun MoE config, overlap rings) on the CPU mesh
+  (``scripts/graph_lint.sh``; ``tests/test_analysis.py`` gates the suite).
+- the ``graph_lint`` pytest fixture
+  (:mod:`~apex_tpu.analysis.fixtures`) — lint any model a test already
+  traces.
+
+:mod:`apex_tpu.testing.hlo` remains as a back-compat re-export of the
+HLO helpers that were hoisted into :mod:`apex_tpu.analysis.hlo`.
+"""
+
+from apex_tpu.analysis.findings import (  # noqa: F401
+    ERROR,
+    Finding,
+    INFO,
+    Report,
+    WARNING,
+)
+from apex_tpu.analysis.registry import RULEBOOK, Rule, rules_for  # noqa: F401
+from apex_tpu.analysis.program import Program  # noqa: F401
+from apex_tpu.analysis.hlo import (  # noqa: F401
+    compiled_hlo,
+    count_hlo_ops,
+    hlo_op_counts,
+    parse_hlo,
+)
+from apex_tpu.analysis.runner import (  # noqa: F401
+    analyze_program,
+    lint_hlo,
+    lint_traced,
+)
+
+__all__ = [
+    "ERROR",
+    "WARNING",
+    "INFO",
+    "Finding",
+    "Report",
+    "Rule",
+    "RULEBOOK",
+    "rules_for",
+    "Program",
+    "compiled_hlo",
+    "hlo_op_counts",
+    "count_hlo_ops",
+    "parse_hlo",
+    "analyze_program",
+    "lint_traced",
+    "lint_hlo",
+]

@@ -1,0 +1,102 @@
+"""``python -m apex_tpu.analysis`` — run the rulebook from the shell.
+
+The CI face of the analyzer (``scripts/graph_lint.sh``): lints the
+registered entry configs on the CPU mesh and exits non-zero when any
+ERROR finding fires, so a regressed invariant fails fast in the same
+place for every consumer.  ``tests/test_analysis.py`` calls
+:func:`main` in-process as the fast-tier suite gate.
+
+Platform: like every other standalone runner here (l1 record, crash
+resume), this pins CPU and 8 virtual devices *before* backend init so a
+shell invocation matches the test environment exactly; under pytest the
+conftest has already done both and the calls are no-ops.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+__all__ = ["main"]
+
+
+def _ensure_platform() -> None:
+    from apex_tpu.utils.platform import force_host_device_count, pin_cpu
+
+    force_host_device_count(8)
+    pin_cpu()
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m apex_tpu.analysis", description=__doc__)
+    ap.add_argument("--all-entries", action="store_true",
+                    help="lint every registered entry config")
+    ap.add_argument("--entries", default="",
+                    help="comma-separated entry names (see --list-entries)")
+    ap.add_argument("--list-entries", action="store_true")
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--json", action="store_true",
+                    help="emit findings as a JSON array")
+    args = ap.parse_args(argv)
+
+    from apex_tpu.analysis.registry import RULEBOOK
+
+    if args.list_rules:
+        for r in sorted(RULEBOOK.values(), key=lambda r: r.id):
+            print(f"{r.id} [{r.tier:5s}] {r.title}: {r.catches}")
+        return 0
+
+    # entry builders import jax lazily; platform must be pinned first
+    _ensure_platform()
+    from apex_tpu.analysis.entries import ENTRIES, run_entry
+    from apex_tpu.analysis.findings import Report
+
+    if args.list_entries:
+        for name in ENTRIES:
+            print(name)
+        return 0
+
+    if args.all_entries:
+        names = list(ENTRIES)
+    elif args.entries:
+        names = [n.strip() for n in args.entries.split(",") if n.strip()]
+        unknown = [n for n in names if n not in ENTRIES]
+        if unknown:
+            print(f"unknown entries: {unknown} "
+                  f"(known: {list(ENTRIES)})", file=sys.stderr)
+            return 2
+    else:
+        ap.print_help()
+        return 2
+
+    report = Report()
+    n_programs = 0
+    for name in names:
+        sub, n = run_entry(name)
+        n_programs += n
+        report.extend(sub)
+        if not args.json:
+            e, w, _ = sub.counts()
+            status = "FAIL" if sub.errors() else "ok"
+            print(f"[{status}] {name}: {n} program(s), "
+                  f"{e} error(s), {w} warning(s)")
+
+    if args.json:
+        print(json.dumps([vars(f) for f in report], indent=1))
+    elif report.findings:
+        print(report.format())
+    e, w, _ = report.counts()
+    verdict = "FAIL" if e else "PASS"
+    # under --json, stdout is reserved for the machine-readable array
+    print(f"apex_tpu.analysis: {len(names)} entries / {n_programs} "
+          f"programs / {len(RULEBOOK)} rules -> "
+          f"{e} error(s), {w} warning(s) [{verdict}]",
+          file=sys.stderr if args.json else sys.stdout)
+    return 1 if e else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

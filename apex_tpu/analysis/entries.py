@@ -1,0 +1,224 @@
+"""Registered entry configs — the programs the whole rulebook runs over.
+
+``python -m apex_tpu.analysis --all-entries`` lints the same staged
+programs the test suite and the driver exercise, each built tiny on the
+8-virtual-device CPU mesh (the ``tests/conftest.py`` environment):
+
+- ``gpt_3d``    — the dp×pp×tp+sp 3D GPT trainer: its *loss function*
+  (linted as a to-be-differentiated program — the APX101 rank-0 contract
+  from the PR 2 postmortem) and its sentinel-armed, donated train step.
+- ``zero_flat`` / ``zero_leaf`` — the ZeRO data-parallel train steps
+  (flat-bucket and per-leaf layouts), sentinel armed, donated: the
+  cond-guarded collective path (APX102/APX203) and the donation audit
+  over the sharded optimizer state (APX204).
+- ``dryrun``    — the MoE-enabled 3D config mirroring
+  ``__graft_entry__.dryrun_multichip``'s first step (dp=2 × pp=2(×vpp=2)
+  × tp=2+sp, Switch-MoE experts on the dp axis).
+- ``overlap``   — the PR 2 ring-decomposed collective matmuls at tp=2:
+  ring integrity (APX201) and permutation well-formedness (APX104/202).
+
+Builders construct params by *executing only initializers* — the linted
+train/loss/ring programs themselves are traced and lowered, never run.
+Each entry owns the global mesh for its lifetime; ``run_entry`` destroys
+it afterwards so entries compose in one process (and with pytest's
+``_fresh_parallel_state``).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Tuple
+
+from apex_tpu.analysis.findings import Report
+from apex_tpu.analysis.program import Program
+from apex_tpu.analysis.runner import analyze_program
+
+__all__ = ["ENTRIES", "run_entry"]
+
+ENTRIES: Dict[str, Callable[[], List[Program]]] = {}
+
+
+def _entry(name):
+    def deco(fn):
+        ENTRIES[name] = fn
+        return fn
+
+    return deco
+
+
+def _leaves(*trees) -> int:
+    import jax
+
+    return sum(len(jax.tree_util.tree_leaves(t)) for t in trees)
+
+
+def _build_zero(flat_bucket: bool, tag: str) -> List[Program]:
+    import jax
+    import jax.numpy as jnp
+
+    from apex_tpu import parallel
+    from apex_tpu.amp.scaler import DynamicLossScale
+    from apex_tpu.contrib.optimizers import DistributedFusedAdam
+    from apex_tpu.parallel.distributed import (
+        dp_shard_batch,
+        zero_data_parallel_train_step,
+        zero_init,
+    )
+    from apex_tpu.resilience import sentinel_init
+
+    mesh = parallel.initialize_model_parallel()  # all dp
+    params = {"w": jax.random.normal(jax.random.PRNGKey(0), (13, 7)),
+              "b": jnp.zeros((7,))}
+
+    def loss_fn(p, batch):
+        x, y = batch
+        return jnp.mean((x @ p["w"] + p["b"] - y) ** 2)
+
+    opt = DistributedFusedAdam(lr=1e-2, flat_bucket=flat_bucket,
+                               n_buckets=2)
+    state = zero_init(opt, params, mesh)
+    scaler = DynamicLossScale(init_scale=16.0)
+    sent = sentinel_init(scaler)
+    step = zero_data_parallel_train_step(
+        loss_fn, opt, mesh=mesh, scaler=scaler, donate=True)
+    x = jax.random.normal(jax.random.PRNGKey(1), (16, 13))
+    y = jax.random.normal(jax.random.PRNGKey(2), (16, 7))
+    batch = dp_shard_batch((x, y), mesh)
+    return [Program(
+        name=f"{tag}/train_step",
+        fn=step, args=(params, state, batch, sent),
+        expect_conditional=True,
+        expect_donation=_leaves(params, state),
+    )]
+
+
+@_entry("zero_flat")
+def _zero_flat() -> List[Program]:
+    return _build_zero(True, "zero_flat")
+
+
+@_entry("zero_leaf")
+def _zero_leaf() -> List[Program]:
+    return _build_zero(False, "zero_leaf")
+
+
+def _build_gpt(tag: str, *, moe: bool) -> List[Program]:
+    import jax
+
+    from apex_tpu.amp.scaler import DynamicLossScale
+    from apex_tpu.optimizers import FusedAdam
+    from apex_tpu.parallel import mesh as mesh_lib
+    from apex_tpu.resilience import sentinel_init
+    from apex_tpu.transformer.testing import TransformerConfig
+    from apex_tpu.transformer.testing.gpt_parallel_train import build_gpt_3d
+
+    vpp = 2 if moe else 1
+    mesh = mesh_lib.initialize_model_parallel(
+        tensor_model_parallel_size=2, pipeline_model_parallel_size=2,
+        virtual_pipeline_model_parallel_size=vpp if vpp > 1 else None)
+    cfg = TransformerConfig(
+        hidden_size=32, num_layers=2 * vpp, num_attention_heads=2,
+        padded_vocab_size=64, max_position_embeddings=16,
+        hidden_dropout=0.0, attention_dropout=0.0,
+        tensor_axis="tp", sequence_parallel=True,
+        num_experts=4 if moe else None,
+        expert_axis="dp" if moe else None)
+    init_fn, make_loss_fn, make_train_step = build_gpt_3d(
+        cfg, num_chunks=vpp, num_microbatches=2, mesh=mesh)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 16), 0, 64)
+    params, specs = init_fn(jax.random.PRNGKey(0), tokens)
+    loss_fn = make_loss_fn(specs)
+    opt = FusedAdam(lr=1e-3)
+    state = opt.init(params)
+    scaler = DynamicLossScale()
+    sent = sentinel_init(scaler)
+    step = jax.jit(make_train_step(opt, specs, scaler=scaler),
+                   donate_argnums=(0, 1))
+    return [
+        # the program users differentiate: the APX101 rank-0 contract
+        # (grad-path scalars (1,)-shaped inside, squeezed outside) is
+        # enforced here, where the PR 2 _SpecError lived
+        Program(name=f"{tag}/loss", fn=loss_fn, args=(params, tokens),
+                differentiated=True, hlo_tier=False),
+        # Donation floor: the optimizer state (m/v — the 2x-params HBM
+        # the audit exists for) must stay fully aliased.  XLA declines
+        # aliasing for a minority of the [vpp, pp, ...]-stacked layer
+        # params on this path, so the all-leaves bound used for the ZeRO
+        # entries would be flaky here; a dropped donate_argnums still
+        # crashes through this floor (0 aliased).
+        #
+        # The dryrun (MoE) variant skips the HLO tier: its unique
+        # coverage — expert-parallel all_to_alls, the vpp-stacked layer
+        # params, the MoE aux slot riding the pipeline — is all visible
+        # to the jaxpr rules, while the HLO contracts (conditional
+        # survival, donation) are structurally identical to gpt_3d's and
+        # already compiled there; skipping the second 3D XLA compile
+        # keeps graph_lint inside the tier-1 window.
+        Program(name=f"{tag}/train_step",
+                fn=step, args=(params, state, tokens, sent),
+                hlo_tier=not moe,
+                expect_conditional=not moe,
+                expect_donation=_leaves(state) if not moe else None),
+    ]
+
+
+@_entry("gpt_3d")
+def _gpt_3d() -> List[Program]:
+    return _build_gpt("gpt_3d", moe=False)
+
+
+@_entry("dryrun")
+def _dryrun() -> List[Program]:
+    return _build_gpt("dryrun", moe=True)
+
+
+@_entry("overlap")
+def _overlap() -> List[Program]:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+
+    from apex_tpu import parallel
+    from apex_tpu.parallel import collectives as cc
+    from apex_tpu.transformer.tensor_parallel.overlap import (
+        gather_matmul,
+        matmul_scatter,
+    )
+
+    tp = 2
+    parallel.initialize_model_parallel(tensor_model_parallel_size=tp)
+    k1, k2 = jax.random.split(jax.random.PRNGKey(0))
+    x = jax.random.normal(k1, (16, 3, 8), jnp.float32)
+    w = jax.random.normal(k2, (24, 8), jnp.float32) / np.sqrt(8)
+
+    gm = cc.shard_over(
+        lambda xs, ws: gather_matmul(xs, ws, "tp"),
+        in_specs=(P("tp", None, None), P("tp", None)),
+        out_specs=P(None, None, "tp"))
+    ms = cc.shard_over(
+        lambda xs, ws: matmul_scatter(xs, ws, "tp"),
+        in_specs=(P(None, None, "tp"), P(None, "tp")),
+        out_specs=P("tp", None, None))
+    return [
+        Program(name="overlap/gather_matmul", fn=gm, args=(x, w),
+                expect_ring=tp, forbid_ops=("all-gather",)),
+        Program(name="overlap/matmul_scatter", fn=ms, args=(x, w),
+                expect_ring=tp, forbid_ops=("reduce-scatter",)),
+    ]
+
+
+def run_entry(name: str) -> Tuple[Report, int]:
+    """Build one entry, run the rulebook over each of its programs, tear
+    the mesh down.  Returns (report, program_count)."""
+    from apex_tpu.parallel import mesh as mesh_lib
+
+    report = Report()
+    try:
+        # builders register the global mesh; keep them inside the
+        # try so a failed build cannot leak it to later callers
+        programs = ENTRIES[name]()
+        for prog in programs:
+            report.extend(analyze_program(prog))
+    finally:
+        mesh_lib.destroy_model_parallel()
+    return report, len(programs)

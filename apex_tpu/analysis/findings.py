@@ -1,0 +1,93 @@
+"""Structured findings — what every rule emits and every consumer reads.
+
+A finding is (rule id, severity, location, message, remediation): enough
+for the CLI to print an actionable line, for tests to assert "exactly
+rule X fired here", and for the rulebook table in ``docs/analysis.md`` to
+stay the single glossary.  Severity semantics follow the usual linter
+contract: only ``ERROR`` findings fail ``python -m apex_tpu.analysis``
+(and therefore ``tests/test_analysis.py``); ``WARNING`` marks hazards the
+analyzer could not fully resolve statically (e.g. a cond predicate whose
+slice leaves the scope it can see).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterable, List, Tuple
+
+__all__ = ["ERROR", "WARNING", "INFO", "Finding", "Report"]
+
+ERROR = "error"
+WARNING = "warning"
+INFO = "info"
+
+_ORDER = {ERROR: 0, WARNING: 1, INFO: 2}
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation (or hazard) at one location.
+
+    ``rule``        — rulebook id (``"APX101"``, ...; see docs/analysis.md)
+    ``severity``    — :data:`ERROR` / :data:`WARNING` / :data:`INFO`
+    ``location``    — where: program name + eqn/instruction path + source
+                      line when the jaxpr carries one
+    ``message``     — what is wrong, concretely (shapes, axes, counts)
+    ``remediation`` — how to fix it (the rule's cookbook line)
+    """
+
+    rule: str
+    severity: str
+    location: str
+    message: str
+    remediation: str = ""
+
+    def format(self) -> str:
+        txt = f"{self.rule} {self.severity.upper():7s} {self.location}: " \
+              f"{self.message}"
+        if self.remediation:
+            txt += f"\n    hint: {self.remediation}"
+        return txt
+
+
+class Report:
+    """An ordered collection of findings with pass/fail semantics."""
+
+    def __init__(self, findings: Iterable[Finding] = ()):
+        self.findings: List[Finding] = list(findings)
+
+    def extend(self, findings: Iterable[Finding]) -> None:
+        self.findings.extend(findings)
+
+    def by_rule(self, rule: str) -> List[Finding]:
+        return [f for f in self.findings if f.rule == rule]
+
+    def errors(self) -> List[Finding]:
+        return [f for f in self.findings if f.severity == ERROR]
+
+    @property
+    def ok(self) -> bool:
+        """No ERROR findings (warnings do not fail a lint)."""
+        return not self.errors()
+
+    def counts(self) -> Tuple[int, int, int]:
+        e = sum(1 for f in self.findings if f.severity == ERROR)
+        w = sum(1 for f in self.findings if f.severity == WARNING)
+        return e, w, len(self.findings) - e - w
+
+    def format(self) -> str:
+        if not self.findings:
+            return "no findings"
+        ordered = sorted(self.findings,
+                         key=lambda f: (_ORDER.get(f.severity, 9), f.rule))
+        return "\n".join(f.format() for f in ordered)
+
+    def __iter__(self):
+        return iter(self.findings)
+
+    def __len__(self):
+        return len(self.findings)
+
+    def __repr__(self):
+        e, w, i = self.counts()
+        return f"Report(errors={e}, warnings={w}, info={i})"

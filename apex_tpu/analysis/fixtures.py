@@ -1,0 +1,48 @@
+"""Pytest integration: lint any model a test already traces.
+
+``tests/conftest.py`` imports :func:`graph_lint`, so every test can ask
+for the fixture and run the shared rulebook over a function or a
+compiled-HLO text it already has in hand — the same rule
+implementations the CLI runs, never a re-derived assert::
+
+    def test_my_step_keeps_its_guard(graph_lint):
+        hlo = compiled_hlo(step, params, state, batch, sent)
+        graph_lint(hlo=hlo, expect_conditional=True)
+
+    def test_my_loss_is_old_jax_safe(graph_lint):
+        graph_lint(loss_fn, params, tokens, differentiated=True)
+
+The fixture raises ``AssertionError`` with the formatted findings when
+any ERROR fires, and returns the full Report otherwise (so tests can
+additionally assert on warnings or specific rules).
+"""
+
+from __future__ import annotations
+
+import pytest
+
+__all__ = ["graph_lint"]
+
+
+@pytest.fixture
+def graph_lint():
+    from apex_tpu.analysis import lint_hlo, lint_traced
+
+    def _lint(fn=None, *args, hlo=None, name=None, differentiated=False,
+              **expect):
+        if fn is not None:
+            report = lint_traced(fn, *args, name=name,
+                                 differentiated=differentiated,
+                                 hlo=hlo is True, **expect)
+            if isinstance(hlo, str):
+                hlo_report = lint_hlo(hlo, name=name or "hlo", **expect)
+                report.extend(hlo_report.findings)
+        elif isinstance(hlo, str):
+            report = lint_hlo(hlo, name=name or "hlo", **expect)
+        else:
+            raise TypeError("graph_lint needs a function or hlo text")
+        assert report.ok, (
+            "graph lint found errors:\n" + report.format())
+        return report
+
+    return _lint

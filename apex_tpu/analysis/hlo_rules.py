@@ -1,0 +1,160 @@
+"""HLO-tier rules: contracts that only hold (or break) AFTER XLA.
+
+The jaxpr tier sees what was written; this tier sees what will run.
+XLA is free to re-fuse a ring into a monolithic all-gather, hoist a
+guarded apply out of its ``conditional``, or drop input-output aliasing
+when a program stops being donation-friendly — all invisible at trace
+time.  These rules run over the parsed optimized-HLO module
+(:func:`apex_tpu.analysis.hlo.parse_hlo`) and are gated on each
+program's declared expectations (:class:`apex_tpu.analysis.program.Program`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, List
+
+from apex_tpu.analysis.findings import ERROR, Finding
+from apex_tpu.analysis.hlo import HloModule, hlo_op_counts
+from apex_tpu.analysis.jaxpr_tier import perm_problems
+from apex_tpu.analysis.registry import register
+
+__all__ = ["HloCtx", "run_hlo_rules"]
+
+
+@dataclasses.dataclass
+class HloCtx:
+    """What an HLO-tier rule sees."""
+
+    program: Any          # analysis.program.Program
+    module: HloModule
+
+
+def run_hlo_rules(ctx: HloCtx, rules=None) -> List[Finding]:
+    from apex_tpu.analysis.registry import rules_for
+
+    findings: List[Finding] = []
+    for rule in (rules if rules is not None else rules_for("hlo")):
+        findings.extend(rule.fn(ctx))
+    return findings
+
+
+@register("APX201", tier="hlo", title="ring-integrity",
+          catches="overlap_comm ring re-fused by XLA into a monolithic "
+                  "collective (>= tp-1 collective-permutes must survive; "
+                  "forbidden monolithic opcodes must stay at zero)",
+          motivation="PR 2: XLA's own collective-matmul pass works in "
+                     "the opposite direction — a silent re-fusion makes "
+                     "the overlap tests vacuously pass on values while "
+                     "measuring nothing (testing/hlo.py's raison d'etre)")
+def ring_integrity(ctx: HloCtx):
+    tp = ctx.program.expect_ring
+    if not tp:
+        return
+    counts = hlo_op_counts(ctx.module)
+    got = counts["collective-permute"]
+    if got < tp - 1:
+        yield Finding(
+            rule="APX201", severity=ERROR,
+            location=f"{ctx.program.name}: optimized HLO",
+            message=f"ring decomposition did not survive jit: "
+                    f"{got} collective-permute(s) < tp-1 = {tp - 1}",
+            remediation="the unrolled ring must keep one distinct "
+                        "ppermute per hop (transformer/tensor_parallel/"
+                        "overlap.py); check for a jax/XLA version change "
+                        "re-fusing the schedule")
+    for op in ctx.program.forbid_ops:
+        n = counts[op]
+        if n:
+            yield Finding(
+                rule="APX201", severity=ERROR,
+                location=f"{ctx.program.name}: optimized HLO",
+                message=f"monolithic {op} reappeared on the decomposed "
+                        f"path ({n} occurrence(s))",
+                remediation="XLA re-fused the ring into the collective "
+                            "the decomposition exists to avoid; the "
+                            "overlap is measuring nothing")
+
+
+@register("APX202", tier="hlo", title="collective-permute-pairs",
+          catches="collective-permute whose source_target_pairs is not "
+                  "a valid partial permutation (duplicate source or "
+                  "target)",
+          motivation="PR 2: a mismatched ring permutation is a deadlock "
+                     "on real ICI — two senders into one receiver, or "
+                     "one rank sending twice, wedges the chip-to-chip "
+                     "transfer engine")
+def collective_permute_pairs(ctx: HloCtx):
+    for inst in ctx.module.instructions():
+        if inst.base_opcode != "collective-permute":
+            continue
+        pairs = inst.source_target_pairs()
+        if not pairs:
+            continue
+        problems = perm_problems(pairs)
+        if not problems:
+            continue
+        yield Finding(
+            rule="APX202", severity=ERROR,
+            location=f"{ctx.program.name}: %{inst.name} in "
+                     f"{inst.computation or 'entry'} "
+                     f"(line {inst.line_no + 1})",
+            message=f"malformed source_target_pairs {pairs}: "
+                    + "; ".join(problems),
+            remediation="each rank at most once as source and once as "
+                        "target; ring hops are [(i, (i±1) % n)]")
+
+
+@register("APX203", tier="hlo", title="conditional-survival",
+          catches="sentinel-guarded optimizer apply optimized away: no "
+                  "`conditional` left in the compiled program",
+          motivation="PR 3: 'a skipped step moves no collective bytes' "
+                     "— the lax.cond guard must survive as ONE compiled "
+                     "conditional (no host round-trip, params/state "
+                     "bit-unchanged on skip); previously one hand-rolled "
+                     "string assert per test")
+def conditional_survival(ctx: HloCtx):
+    if not ctx.program.expect_conditional:
+        return
+    n = hlo_op_counts(ctx.module)["conditional"]
+    if n < 1:
+        yield Finding(
+            rule="APX203", severity=ERROR,
+            location=f"{ctx.program.name}: optimized HLO",
+            message="no `conditional` survived optimization — the "
+                    "sentinel's lax.cond-guarded apply was flattened "
+                    "(both branches would execute, a skipped step would "
+                    "still move collective bytes) or hoisted to a host "
+                    "round-trip",
+            remediation="guard the WHOLE optimizer apply in one lax.cond "
+                        "on a traced predicate "
+                        "(resilience.guarded_optimizer_step); do not "
+                        "pre-evaluate the flag on host")
+
+
+@register("APX204", tier="hlo", title="donation-aliasing",
+          catches="donated inputs (ZeRO flat buckets, optimizer state) "
+                  "that lost input-output aliasing — a silent 2x HBM "
+                  "cost",
+          motivation="PR 1: the flat-bucket ZeRO state and master "
+                     "weights are the largest buffers in the job; "
+                     "losing donation doubles their footprint without "
+                     "any failing test (cf. tests/test_wgrad_accum.py)")
+def donation_aliasing(ctx: HloCtx):
+    expect = ctx.program.expect_donation
+    if not expect:
+        return
+    aliased = ctx.module.aliased_parameters()
+    if len(aliased) >= expect:
+        return
+    yield Finding(
+        rule="APX204", severity=ERROR,
+        location=f"{ctx.program.name}: optimized HLO module header",
+        message=f"only {len(aliased)} input parameter(s) aliased to "
+                f"outputs, expected >= {expect}; donated buffers are "
+                "being copied (silent 2x HBM for params/optimizer "
+                "state)",
+        remediation="pass donate_argnums for params/opt-state, keep "
+                    "donated shapes/dtypes matching their outputs, and "
+                    "do not wrap an already-donating jitted step in a "
+                    "fresh jax.jit (that drops donation)")

@@ -1,0 +1,67 @@
+"""The unit of analysis: one staged program plus its declared contract.
+
+A :class:`Program` bundles what the analyzer needs to inspect a function
+without running it — the callable and example arguments (for tracing and
+lowering) or a pre-compiled HLO text — together with the *expectations*
+that parameterize the contract rules: does the sentinel guard this step
+(``expect_conditional``), is it an ``overlap_comm`` ring of a given tp
+size (``expect_ring`` / ``forbid_ops``), how many donated buffers must
+stay aliased (``expect_donation``), and will the caller differentiate
+across its ``shard_map`` boundaries (``differentiated`` — the old-jax
+rank-0 rule APX101 only applies to programs that declare this intent;
+a train step that takes its gradients *inside* the boundary never
+transposes the boundary and is exempt).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Tuple
+
+__all__ = ["Program"]
+
+
+@dataclasses.dataclass
+class Program:
+    """One lintable program.
+
+    ``fn``/``args``/``kwargs`` — the callable at concrete example
+    arguments.  Tracing (jaxpr tier) uses ``jax.make_jaxpr``; lowering
+    (HLO tier) uses ``fn.lower`` when ``fn`` is already jitted (which
+    preserves ``donate_argnums``) and ``jax.jit(fn).lower`` otherwise.
+    Neither executes the program.
+
+    ``hlo_text`` — alternatively (or additionally), a pre-compiled
+    optimized-HLO text to run the HLO tier on directly.
+
+    Tier selection: the jaxpr tier runs when ``fn`` is set and ``jaxpr_tier``
+    is true; the HLO tier runs when ``hlo_text`` is set or (``fn`` set and
+    ``hlo_tier`` true).
+    """
+
+    name: str
+    fn: Any = None
+    args: Tuple = ()
+    kwargs: Optional[dict] = None
+    hlo_text: Optional[str] = None
+    jaxpr_tier: bool = True
+    hlo_tier: bool = True
+
+    # --- declared contract -------------------------------------------
+    # APX101: the caller will differentiate across this program's
+    # shard_map boundaries (loss functions; NOT already-guarded steps).
+    differentiated: bool = False
+    # APX203: sentinel-guarded apply must survive as >= 1 `conditional`.
+    expect_conditional: bool = False
+    # APX201: overlap_comm ring of this tp size must survive as
+    # >= tp-1 collective-permutes ...
+    expect_ring: Optional[int] = None
+    # ... with zero occurrences of these monolithic opcodes.
+    forbid_ops: Tuple[str, ...] = ()
+    # APX204: at least this many donated input buffers must appear in
+    # input_output_alias (0/None = rule skipped).
+    expect_donation: Optional[int] = None
+
+    def __post_init__(self):
+        if self.kwargs is None:
+            self.kwargs = {}

@@ -1,0 +1,58 @@
+"""The rulebook registry — one place that knows every check.
+
+Rules register themselves with an id, tier, one-line "what it catches",
+and the postmortem that motivated them (docs/analysis.md renders this
+table; the CLI's ``--list-rules`` prints it).  A rule is a function from
+an analysis context to an iterable of findings:
+
+- jaxpr-tier rules receive a :class:`~apex_tpu.analysis.jaxpr_tier.JaxprCtx`
+  (closed jaxpr + the program's declared intent);
+- HLO-tier rules receive an :class:`~apex_tpu.analysis.hlo_rules.HloCtx`
+  (parsed :class:`~apex_tpu.analysis.hlo.HloModule` + expectations).
+
+Rules must be *total*: they skip silently (no findings) when their
+precondition is absent — e.g. the conditional-survival rule only applies
+to programs that declare ``expect_conditional`` — so the full rulebook
+can always run over every program.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List
+
+__all__ = ["Rule", "RULEBOOK", "register", "rules_for"]
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    id: str
+    tier: str          # "jaxpr" | "hlo"
+    title: str         # short name (kebab-case)
+    catches: str       # one line: what bug class this detects
+    motivation: str    # which PR's postmortem mechanized into this rule
+    fn: Callable       # ctx -> Iterable[Finding]
+
+
+RULEBOOK: Dict[str, Rule] = {}
+
+
+def register(rule_id: str, *, tier: str, title: str, catches: str,
+             motivation: str):
+    """Decorator: add a rule function to the rulebook."""
+    if tier not in ("jaxpr", "hlo"):
+        raise ValueError(f"unknown tier {tier!r}")
+
+    def deco(fn):
+        if rule_id in RULEBOOK:
+            raise ValueError(f"duplicate rule id {rule_id}")
+        RULEBOOK[rule_id] = Rule(id=rule_id, tier=tier, title=title,
+                                 catches=catches, motivation=motivation,
+                                 fn=fn)
+        return fn
+
+    return deco
+
+
+def rules_for(tier: str) -> List[Rule]:
+    return [r for r in RULEBOOK.values() if r.tier == tier]

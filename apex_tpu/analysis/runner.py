@@ -1,0 +1,61 @@
+"""Run the rulebook over programs — the one analyze pipeline.
+
+``analyze_program`` is the single code path behind the CLI, the pytest
+fixture, and the ``lint_traced``/``lint_hlo`` helpers tests call
+directly, so "the contract is checked by one shared implementation"
+holds all the way down: a test asserting conditional-survival and
+``python -m apex_tpu.analysis`` run the identical rule function.
+
+Nothing here executes the analyzed program: the jaxpr tier stages with
+``jax.make_jaxpr`` (abstract evaluation), the HLO tier stops at
+``lower().compile().as_text()``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from apex_tpu.analysis.findings import Report
+from apex_tpu.analysis.hlo import compiled_hlo, parse_hlo
+from apex_tpu.analysis.hlo_rules import HloCtx, run_hlo_rules
+from apex_tpu.analysis.jaxpr_tier import JaxprCtx, run_jaxpr_rules, trace
+from apex_tpu.analysis.program import Program
+
+__all__ = ["analyze_program", "lint_traced", "lint_hlo"]
+
+
+def analyze_program(program: Program) -> Report:
+    """Run every applicable rule over one program; returns a Report."""
+    report = Report()
+    if program.fn is not None and program.jaxpr_tier:
+        closed, findings = trace(program.fn, *program.args,
+                                 **program.kwargs)
+        report.extend(findings)
+        if closed is not None:
+            report.extend(run_jaxpr_rules(JaxprCtx(program, closed)))
+    hlo_text = program.hlo_text
+    if hlo_text is None and program.fn is not None and program.hlo_tier:
+        hlo_text = compiled_hlo(program.fn, *program.args,
+                                **program.kwargs)
+    if hlo_text is not None:
+        report.extend(run_hlo_rules(HloCtx(program, parse_hlo(hlo_text))))
+    return report
+
+
+def lint_traced(fn, *args, name: Optional[str] = None,
+                differentiated: bool = False, hlo: bool = False,
+                **expect) -> Report:
+    """Jaxpr-tier lint of ``fn`` at example ``args`` (``hlo=True`` also
+    compiles and runs the HLO tier).  ``expect`` forwards Program
+    expectation fields (``expect_conditional=...``, ``expect_ring=...``,
+    ``forbid_ops=...``, ``expect_donation=...``)."""
+    return analyze_program(Program(
+        name=name or getattr(fn, "__name__", "traced"),
+        fn=fn, args=args, differentiated=differentiated,
+        hlo_tier=hlo, **expect))
+
+
+def lint_hlo(hlo_text: str, name: str = "hlo", **expect) -> Report:
+    """HLO-tier lint of pre-compiled optimized-HLO text."""
+    return analyze_program(Program(
+        name=name, hlo_text=hlo_text, jaxpr_tier=False, **expect))

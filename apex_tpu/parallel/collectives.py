@@ -174,7 +174,12 @@ def hierarchical_all_gather(x, inner_axis: AxisName, *, concat_axis: int = 0,
 
 def ppermute(x, axis: AxisName, perm):
     """Point-to-point permutation — the p2p send/recv analog
-    (``apex/transformer/pipeline_parallel/p2p_communication.py:48-166``)."""
+    (``apex/transformer/pipeline_parallel/p2p_communication.py:48-166``).
+
+    ``perm`` must be a valid partial permutation (each rank at most once
+    as source and once as target) — jax does NOT validate this at trace
+    time, and a mismatched ring deadlocks real ICI; analyzer rules
+    APX104/APX202 (:mod:`apex_tpu.analysis`) check it statically."""
     return lax.ppermute(x, axis, perm)
 
 
@@ -254,6 +259,14 @@ def shard_over(
     where the collectives above are legal.  Pipeline schedules and the
     distributed tests use this; most library code instead relies on sharding
     annotations and lets XLA infer collectives.
+
+    Old-jax contract: if the wrapped function will be differentiated
+    (``jax.grad`` *across* this boundary), no rank-0 inexact value may
+    cross it — 0.4.x shard_map cannot name-check scalar residuals in the
+    transposed program (``_SpecError``).  Keep such scalars ``(1,)``-shaped
+    inside and squeeze outside; analyzer rule APX101
+    (:mod:`apex_tpu.analysis`, ``lint_traced(fn, ...,
+    differentiated=True)``) enforces this mechanically.
     """
     if mesh is None:
         mesh = mesh_lib.get_mesh()

@@ -18,15 +18,19 @@ one guard all of them share:
   single ``lax.cond``: on a non-finite step *nothing* runs — no
   reduce-scatter, no Adam math, no all-gather; params and state pass
   through bit-unchanged.  The predicate is a traced scalar, so the guard
-  stays inside the one compiled program (no host round-trip — assert via
-  :mod:`apex_tpu.testing.hlo` that ``conditional`` survives jit).
+  stays inside the one compiled program (no host round-trip — analyzer
+  rule APX203 in :mod:`apex_tpu.analysis` checks that ``conditional``
+  survives jit, for the sentinel tests and ``scripts/graph_lint.sh``
+  alike).
 
 Collective-safety: inside ``shard_map`` the local grads differ per rank,
 so a rank-local finite flag could diverge and deadlock the collectives
 inside the guarded branch.  ``sentinel_update(axes=...)`` therefore
 ``pmin``-reduces the flag over the data axes first — every rank takes the
 same branch (the reference all-reduces its overflow flag for the same
-reason, ``apex/amp/scaler.py:usage in DDP``).
+reason, ``apex/amp/scaler.py:usage in DDP``).  Analyzer rule APX102
+mechanizes this contract: a collective under a ``lax.cond`` whose
+predicate is not agreed over its axes is a red finding.
 """
 
 from __future__ import annotations

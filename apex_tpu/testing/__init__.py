@@ -1,4 +1,5 @@
-"""Test/validation harnesses (L1 stored-baseline traces, compiled-HLO
-inspection, fault injection, crash/resume smoke trainer)."""
+"""Test/validation harnesses (L1 stored-baseline traces, fault
+injection, crash/resume smoke trainer).  Compiled-HLO inspection moved
+to :mod:`apex_tpu.analysis` (ISSUE 4); ``testing.hlo`` re-exports it."""
 
 from apex_tpu.testing import faults, hlo, l1  # noqa: F401

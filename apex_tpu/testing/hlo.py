@@ -1,72 +1,16 @@
-"""Compiled-HLO inspection: prove an optimization survived jit.
-
-The collective-matmul rings
-(:mod:`apex_tpu.transformer.tensor_parallel.overlap`) are only worth their
-code if the compiled program still contains the decomposed
-``collective-permute`` chain — XLA is free to pattern-match a ring back
-into one monolithic ``all-gather`` (its own collective-matmul pass works in
-the opposite direction), and a silent re-fusion would make the overlap
-tests vacuously pass on values while measuring nothing.  These helpers
-compile a function exactly as the tests run it and count opcodes in the
-optimized HLO text, so assertions hold on every jax version the shims
-support (the ``lower().compile().as_text()`` pipeline is stable across
-0.4.x–0.7.x).
-
-Async collective pairs (``all-gather-start``/``-done``,
-``collective-permute-start``/``-done``) count as ONE op under their base
-opcode: the start/done split is a backend scheduling detail, not an extra
-collective on the wire.
+"""Back-compat shim: the compiled-HLO helpers moved to
+:mod:`apex_tpu.analysis.hlo` (ISSUE 4 hoisted them into the static-
+analysis subsystem, where the opcode counting gained a structured
+per-computation parse and the rule-based checks live).  Existing
+imports keep working; new code should import from
+``apex_tpu.analysis``.
 """
 
-from __future__ import annotations
+from apex_tpu.analysis.hlo import (  # noqa: F401
+    compiled_hlo,
+    count_hlo_ops,
+    hlo_op_counts,
+    parse_hlo,
+)
 
-import collections
-import re
-
-__all__ = ["compiled_hlo", "hlo_op_counts", "count_hlo_ops"]
-
-# `%name = shape opcode(operands...)` — the opcode is the first
-# bare-word-followed-by-paren after the `=` (the shape, even a tuple shape
-# like `(f32[4], u32[])`, never puts a letter token directly against an
-# opening paren).
-_OPCODE = re.compile(r"([a-z][a-z0-9-]*)\(")
-
-
-def compiled_hlo(fn, *args, **kwargs) -> str:
-    """Optimized HLO text of ``jit(fn)`` at these arguments.
-
-    ``fn`` is compiled exactly as it would execute (same shapes, same
-    shardings if the arguments carry them); pass an already-``jit``-ed or
-    ``shard_over``-ed callable freely — ``jax.jit`` of a jitted function
-    is the same cache entry.
-    """
-    import jax
-
-    return jax.jit(fn).lower(*args, **kwargs).compile().as_text()
-
-
-def hlo_op_counts(hlo_text: str) -> "collections.Counter[str]":
-    """Opcode -> occurrence count over every instruction in ``hlo_text``,
-    with ``-start``/``-done`` async halves folded into their base opcode
-    (the pair is one collective; counting both would double it)."""
-    counts: collections.Counter = collections.Counter()
-    for line in hlo_text.splitlines():
-        _, eq, rhs = line.partition(" = ")
-        if not eq:
-            continue
-        m = _OPCODE.search(rhs)
-        if m is None:
-            continue
-        op = m.group(1)
-        if op.endswith("-done"):
-            continue
-        if op.endswith("-start"):
-            op = op[: -len("-start")]
-        counts[op] += 1
-    return counts
-
-
-def count_hlo_ops(hlo_text: str, opcode: str) -> int:
-    """Occurrences of ``opcode`` (e.g. ``"collective-permute"``,
-    ``"all-gather"``) in compiled HLO, async pairs counted once."""
-    return hlo_op_counts(hlo_text)[opcode]
+__all__ = ["compiled_hlo", "hlo_op_counts", "count_hlo_ops", "parse_hlo"]

@@ -33,9 +33,12 @@ from rank ``r+1``, so after ``t`` hops rank ``r`` holds chunk ``(r+t) % n``
 (:func:`apex_tpu.parallel.collectives.ring_chunks` is the matching split).
 The rings are Python-unrolled — ``tp`` is small and static — so the
 compiled HLO carries ``n-1`` distinct ``collective-permute`` ops for XLA's
-latency-hiding scheduler to sink under the neighboring dots
-(:mod:`apex_tpu.testing.hlo` counts them; ``tests/test_tensor_parallel.py``
-asserts the decomposition survives jit).
+latency-hiding scheduler to sink under the neighboring dots.  Analyzer
+rule APX201 (:mod:`apex_tpu.analysis`) asserts the decomposition survives
+jit — ``tests/test_overlap_matmul.py``/``test_tensor_parallel.py`` and
+``scripts/graph_lint.sh``'s ``overlap`` entry run the same check — and
+APX202/APX104 validate the ring's ``ppermute`` permutations (a mismatch
+is an ICI deadlock).
 
 All functions run inside ``shard_map`` with ``axis`` bound, like the rest of
 :mod:`~apex_tpu.transformer.tensor_parallel.mappings`.

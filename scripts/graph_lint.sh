@@ -1,0 +1,24 @@
+#!/usr/bin/env bash
+# Graph lint (ISSUE 4): run the static-analysis rulebook over every
+# registered entry config (3D GPT trainer, ZeRO train steps, dryrun MoE
+# config, overlap rings) on the CPU mesh.  Exit 0 = no ERROR finding.
+#
+# This is the CI face of apex_tpu.analysis: the rules that mechanize the
+# repo's mesh-correctness invariants (docs/analysis.md has the rulebook).
+# The fast tier runs the identical check in-process
+# (tests/test_analysis.py::test_graph_lint_all_entries_exits_zero), so a
+# red finding fails the suite; this script is for shells, pre-push hooks
+# and bench boxes.
+#
+# Usage: scripts/graph_lint.sh [extra apex_tpu.analysis args]
+#   e.g. scripts/graph_lint.sh --entries overlap,zero_flat
+#        scripts/graph_lint.sh --list-rules
+# Env: PYTHON (default python).
+set -u -o pipefail
+
+cd "$(dirname "$0")/.."
+args=("$@")
+if [ ${#args[@]} -eq 0 ]; then
+    args=(--all-entries)
+fi
+exec "${PYTHON:-python}" -m apex_tpu.analysis "${args[@]}"

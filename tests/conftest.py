@@ -52,3 +52,9 @@ def pytest_configure(config):
         "slow: long-running distributed/model tests (deselect with "
         "-m 'not slow' for the fast tier)",
     )
+
+
+# The graph-lint fixture (apex_tpu.analysis): importing it here registers
+# it for every test module, so suites can lint any model they already
+# trace against the shared rulebook (docs/analysis.md).
+from apex_tpu.analysis.fixtures import graph_lint  # noqa: E402,F401

@@ -1,0 +1,399 @@
+"""The static-analysis subsystem (ISSUE 4).
+
+Three layers of coverage:
+
+- **Parser pins**: the structured HLO parse attributes ops to their
+  computation (fusion bodies, reduction combiners, conditional branches)
+  and ignores comment/metadata text — the exact miscounts the old
+  line-regex ``_OPCODE`` counter was prone to.
+- **Adversarial fixtures**: deliberately-broken graphs — a rank-0 scalar
+  across a shard_map grad path, a ring with a mismatched ppermute
+  permutation, a collective under an unagreed ``lax.cond``, a dropped
+  donation — each must trip *exactly* its rule with a structured finding
+  naming the location, and the clean twin of each graph must stay
+  silent.  Nothing here executes the traced programs: the jaxpr tier
+  stages abstractly and the HLO tier stops at ``compile().as_text()``.
+- **The suite gate**: ``cli.main(["--all-entries"])`` — the same
+  invocation as ``scripts/graph_lint.sh`` — must exit 0 on HEAD, so any
+  red finding over the registered entry configs (3D GPT trainer, ZeRO
+  steps, dryrun MoE config, overlap rings) fails the fast tier.
+"""
+
+import os
+import subprocess
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from apex_tpu import analysis
+from apex_tpu import parallel
+from apex_tpu.analysis import hlo as hlo_lib
+from apex_tpu.parallel import collectives as cc
+
+
+def _only_rule(report, rule_id):
+    """Every finding in the report belongs to ``rule_id`` and there is at
+    least one — 'trips exactly that rule'."""
+    assert report.findings, f"expected {rule_id} findings, got none"
+    rules = {f.rule for f in report.findings}
+    assert rules == {rule_id}, (
+        f"expected only {rule_id}, got {rules}:\n{report.format()}")
+    return report.findings
+
+
+# ---------------------------------------------------------------------------
+# structured HLO parse — the fixed opcode counting (ISSUE 4 satellite)
+# ---------------------------------------------------------------------------
+
+
+_HLO_FIXTURE = """\
+HloModule jit_step, is_scheduled=true, input_output_alias={ {0}: (1, {}, may-alias) }, entry_computation_layout={(f32[4]{0}, f32[4]{0})->f32[4]{0}}
+
+// a comment: %ghost = f32[4]{0} add(%a, %b) must never count
+
+%fused_computation (param_0: f32[4], param_1: f32[4]) -> f32[4] {
+  %param_0 = f32[4]{0} parameter(0)
+  %param_1 = f32[4]{0} parameter(1)
+  %multiply.1 = f32[4]{0} multiply(f32[4]{0} %param_0, f32[4]{0} %param_1)
+  ROOT %subtract.1 = f32[4]{0} subtract(f32[4]{0} %multiply.1, f32[4]{0} %param_1)
+}
+
+%region_0.24 (Arg_0.25: f32[], Arg_1.26: f32[]) -> f32[] {
+  %Arg_0.25 = f32[] parameter(0)
+  %Arg_1.26 = f32[] parameter(1)
+  ROOT %add.27 = f32[] add(f32[] %Arg_0.25, f32[] %Arg_1.26)
+}
+
+ENTRY %main.29 (p0.1: f32[4], p1.2: f32[4]) -> f32[4] {
+  %p0.1 = f32[4]{0} parameter(0)
+  %p1.2 = f32[4]{0} parameter(1), metadata={op_name="jit(step)/jit(main)/mul(x)" source_file="a.py"}
+  %fusion = f32[4]{0} fusion(f32[4]{0} %p0.1, f32[4]{0} %p1.2), kind=kLoop, calls=%fused_computation
+  %ag = (f32[4]{0}, f32[8]{0}) all-gather-start(f32[4]{0} %fusion), dimensions={0}
+  %agd = f32[8]{0} all-gather-done((f32[4]{0}, f32[8]{0}) %ag)
+  %ar = f32[8]{0} all-reduce(f32[8]{0} %agd), replica_groups={}, to_apply=%region_0.24
+  %cp = f32[8]{0} collective-permute(f32[8]{0} %ar), source_target_pairs={{0,1},{1,0}}
+  ROOT %slice.1 = f32[4]{0} slice(f32[8]{0} %cp), slice={[0:4]}
+}
+"""
+
+
+class TestHloParse:
+    def test_per_computation_attribution(self):
+        mod = hlo_lib.parse_hlo(_HLO_FIXTURE)
+        assert set(mod.computations) == {
+            "fused_computation", "region_0.24", "main.29"}
+        assert mod.entry.name == "main.29"
+        # fusion-body ops attributed to the fusion computation, not entry
+        entry_counts = hlo_lib.hlo_op_counts(_HLO_FIXTURE, "entry")
+        assert entry_counts["multiply"] == 0
+        assert entry_counts["subtract"] == 0
+        assert entry_counts["fusion"] == 1
+        # the all-reduce combiner's add lives in its region
+        assert entry_counts["add"] == 0
+        assert hlo_lib.hlo_op_counts(
+            _HLO_FIXTURE, "region_0.24")["add"] == 1
+
+    def test_comments_and_metadata_never_count(self):
+        counts = hlo_lib.hlo_op_counts(_HLO_FIXTURE)
+        # the commented-out add does not count; the combiner add does
+        assert counts["add"] == 1
+        # metadata op_name="jit(step)/..." does not produce a "jit" op
+        assert counts["jit"] == 0
+        assert counts["mul"] == 0
+
+    def test_async_pairs_fold_once(self):
+        counts = hlo_lib.hlo_op_counts(_HLO_FIXTURE)
+        assert counts["all-gather"] == 1
+        assert hlo_lib.count_hlo_ops(_HLO_FIXTURE, "all-gather-done") == 0
+        assert counts["collective-permute"] == 1
+
+    def test_bare_fragment_still_parses(self):
+        # back-compat: test snippets without module/computation headers
+        text = """
+  %cp.1 = f32[4]{0} collective-permute(%p0), source_target_pairs={{0,1}}
+  %ag = (f32[4]{0}, f32[8]{0}) all-gather-start(%p1), dimensions={0}
+  %agd = f32[8]{0} all-gather-done(%ag)
+  %d = f32[4]{0} add(%p0, %p0)
+"""
+        counts = hlo_lib.hlo_op_counts(text)
+        assert counts["collective-permute"] == 1
+        assert counts["all-gather"] == 1
+        assert counts["add"] == 1
+
+    def test_alias_and_pair_extraction(self):
+        mod = hlo_lib.parse_hlo(_HLO_FIXTURE)
+        assert mod.aliased_parameters() == {1}
+        (cp,) = [i for i in mod.instructions()
+                 if i.base_opcode == "collective-permute"]
+        assert cp.source_target_pairs() == [(0, 1), (1, 0)]
+
+
+# ---------------------------------------------------------------------------
+# adversarial jaxpr fixtures — each trips exactly its rule
+# ---------------------------------------------------------------------------
+
+
+class TestRank0AcrossShardMap:
+    """APX101 — the PR 2 ``_SpecError`` footgun, mechanized."""
+
+    def _loss(self, squeeze_inside):
+        mesh = parallel.initialize_model_parallel()
+        params = jnp.ones((4, 4))
+        x = jnp.ones((8, 4))
+
+        def body(p, xs):
+            loss = jnp.mean((xs @ p) ** 2).reshape(1)
+            loss = cc.all_reduce(loss, ("dcn", "dp"), op="mean")
+            return loss[0] if squeeze_inside else loss
+
+        inner = cc.shard_over(
+            body, mesh=mesh,
+            in_specs=(P(), P(("dcn", "dp"))),
+            out_specs=P() if squeeze_inside else P(None))
+        if squeeze_inside:
+            return inner, (params, x)
+        return (lambda p, xs: jnp.squeeze(inner(p, xs), 0)), (params, x)
+
+    def test_rank0_grad_path_flagged(self):
+        fn, args = self._loss(squeeze_inside=True)
+        report = analysis.lint_traced(fn, *args, differentiated=True)
+        (finding,) = _only_rule(report, "APX101")
+        assert finding.severity == analysis.ERROR
+        assert "shard_map outvar" in finding.location
+        assert "(1,)" in finding.remediation
+
+    def test_one_shaped_inside_is_silent(self):
+        fn, args = self._loss(squeeze_inside=False)
+        report = analysis.lint_traced(fn, *args, differentiated=True)
+        assert report.ok and not report.findings, report.format()
+
+    def test_not_differentiated_is_exempt(self):
+        """A step taking grads INSIDE the boundary never transposes it —
+        its scalar loss output is legal (the ZeRO entries rely on this)."""
+        fn, args = self._loss(squeeze_inside=True)
+        report = analysis.lint_traced(fn, *args, differentiated=False)
+        assert not report.findings, report.format()
+
+
+class TestCollectiveUnderCond:
+    """APX102 — the sentinel's agreed-predicate contract."""
+
+    def _step(self, agree):
+        mesh = parallel.initialize_model_parallel()
+        g = jnp.ones((8, 4))
+
+        def body(gs):
+            finite = jnp.all(jnp.isfinite(gs))
+            if agree:
+                finite = jax.lax.pmin(
+                    finite.astype(jnp.int32), ("dcn", "dp")) > 0
+
+            def apply(v):
+                return cc.all_reduce(v, ("dcn", "dp"), op="sum")
+
+            return jax.lax.cond(finite, apply, lambda v: v, gs)
+
+        return cc.shard_over(
+            body, mesh=mesh, in_specs=(P(("dcn", "dp")),),
+            out_specs=P(("dcn", "dp"))), (g,)
+
+    def test_rank_local_predicate_flagged(self):
+        fn, args = self._step(agree=False)
+        report = analysis.lint_traced(fn, *args)
+        (finding,) = _only_rule(report, "APX102")
+        assert finding.severity == analysis.ERROR
+        assert "dp" in finding.message
+        assert "sentinel_update" in finding.remediation
+
+    def test_pmin_agreed_predicate_silent(self):
+        fn, args = self._step(agree=True)
+        report = analysis.lint_traced(fn, *args)
+        assert not report.findings, report.format()
+
+    def test_replicated_input_predicate_silent(self):
+        """A predicate passed IN fully replicated (the 3D trainer's
+        global-grads pattern) is mesh-uniform by construction."""
+        mesh = parallel.initialize_model_parallel()
+        g = jnp.ones((8, 4))
+        flag = jnp.bool_(True)
+
+        def body(finite, gs):
+            return jax.lax.cond(
+                finite,
+                lambda v: cc.all_reduce(v, ("dcn", "dp"), op="sum"),
+                lambda v: v, gs)
+
+        fn = cc.shard_over(
+            body, mesh=mesh, in_specs=(P(), P(("dcn", "dp"))),
+            out_specs=P(("dcn", "dp")))
+        report = analysis.lint_traced(fn, flag, g)
+        assert not report.findings, report.format()
+
+
+class TestAxisNotInMesh:
+    """APX103 — collectives over axes the enclosing mesh lacks."""
+
+    def test_unbound_axis_becomes_finding_not_crash(self):
+        devices = np.array(jax.devices("cpu")[:2])
+        mesh = Mesh(devices, ("dp",))
+        fn = cc.shard_over(
+            lambda x: jax.lax.psum(x, "tp"), mesh=mesh,
+            in_specs=(P("dp"),), out_specs=P("dp"))
+        report = analysis.lint_traced(fn, jnp.ones((4,)))
+        (finding,) = _only_rule(report, "APX103")
+        assert "unbound axis" in finding.message
+
+
+class TestPpermutePermutation:
+    """APX104 — mismatched ring permutations (jax does not validate)."""
+
+    def _ring(self, perm_fn):
+        mesh = parallel.initialize_model_parallel(
+            tensor_model_parallel_size=4)
+
+        def body(x):
+            return jax.lax.ppermute(x, "tp", perm_fn(4))
+
+        return cc.shard_over(
+            body, mesh=mesh, in_specs=(P("tp"),), out_specs=P("tp"))
+
+    def test_duplicate_target_flagged(self):
+        fn = self._ring(lambda n: [(0, 1), (1, 1), (2, 3), (3, 0)])
+        report = analysis.lint_traced(fn, jnp.ones((8,)))
+        (finding,) = _only_rule(report, "APX104")
+        assert "duplicate targets [1]" in finding.message
+        assert "send_recv_next" in finding.remediation
+
+    def test_out_of_range_rank_flagged(self):
+        fn = self._ring(lambda n: [(0, 1), (1, 7)])
+        report = analysis.lint_traced(fn, jnp.ones((8,)))
+        (finding,) = _only_rule(report, "APX104")
+        assert "outside axis size 4" in finding.message
+
+    def test_valid_ring_silent(self):
+        fn = self._ring(lambda n: [(i, (i + 1) % n) for i in range(n)])
+        report = analysis.lint_traced(fn, jnp.ones((8,)))
+        assert not report.findings, report.format()
+
+
+# ---------------------------------------------------------------------------
+# adversarial HLO fixtures
+# ---------------------------------------------------------------------------
+
+
+def _ring_hlo(pairs, extra=""):
+    body = ",".join("{%d,%d}" % p for p in pairs)
+    return f"""\
+ENTRY %main (p0: f32[4]) -> f32[4] {{
+  %p0 = f32[4]{{0}} parameter(0)
+  %cp = f32[4]{{0}} collective-permute(f32[4]{{0}} %p0), source_target_pairs={{{body}}}
+{extra}  ROOT %out = f32[4]{{0}} add(f32[4]{{0}} %cp, f32[4]{{0}} %p0)
+}}
+"""
+
+
+class TestHloRules:
+    def test_refused_ring_flagged(self):
+        """A 'ring' whose collective-permutes were re-fused into one
+        monolithic all-gather: both APX201 conditions fire."""
+        text = """\
+ENTRY %main (p0: f32[4]) -> f32[16] {
+  %p0 = f32[4]{0} parameter(0)
+  ROOT %ag = f32[16]{0} all-gather(f32[4]{0} %p0), dimensions={0}
+}
+"""
+        report = analysis.lint_hlo(text, expect_ring=4,
+                                   forbid_ops=("all-gather",))
+        findings = _only_rule(report, "APX201")
+        msgs = " | ".join(f.message for f in findings)
+        assert "0 collective-permute(s) < tp-1 = 3" in msgs
+        assert "monolithic all-gather reappeared" in msgs
+
+    def test_intact_ring_silent(self):
+        text = _ring_hlo([(0, 1), (1, 2), (2, 3), (3, 0)])
+        report = analysis.lint_hlo(text, expect_ring=2,
+                                   forbid_ops=("all-gather",))
+        assert not report.findings, report.format()
+
+    def test_mismatched_permutation_flagged(self):
+        text = _ring_hlo([(0, 1), (1, 1), (2, 0)])
+        report = analysis.lint_hlo(text)
+        (finding,) = _only_rule(report, "APX202")
+        assert "duplicate targets [1]" in finding.message
+        assert "%cp" in finding.location
+
+    def test_conditional_survival(self):
+        gone = "ENTRY %main (p: f32[4]) -> f32[4] {\n" \
+               "  ROOT %r = f32[4]{0} add(f32[4]{0} %p, f32[4]{0} %p)\n}\n"
+        report = analysis.lint_hlo(gone, expect_conditional=True)
+        (finding,) = _only_rule(report, "APX203")
+        assert "no `conditional` survived" in finding.message
+        kept = "ENTRY %main (p: pred[]) -> f32[4] {\n" \
+               "  ROOT %c = f32[4]{0} conditional(pred[] %p, f32[4]{0} " \
+               "%a, f32[4]{0} %b), true_computation=%t, " \
+               "false_computation=%f\n}\n"
+        assert analysis.lint_hlo(kept, expect_conditional=True).ok
+
+    def test_dropped_donation_flagged(self):
+        """The real thing, compiled (not executed): the same update step
+        with and without donate_argnums."""
+        def step(p, g):
+            return jax.tree_util.tree_map(lambda a, b: a - 0.1 * b, p, g)
+
+        p = {"w": jnp.ones((8, 8)), "b": jnp.ones((8,))}
+        g = {"w": jnp.ones((8, 8)), "b": jnp.ones((8,))}
+
+        donated = jax.jit(step, donate_argnums=(0,))
+        assert analysis.lint_traced(donated, p, g, hlo=True,
+                                    expect_donation=2).ok
+
+        dropped = jax.jit(step)
+        report = analysis.lint_traced(dropped, p, g, hlo=True,
+                                      expect_donation=2)
+        (finding,) = _only_rule(report, "APX204")
+        assert "only 0 input parameter(s) aliased" in finding.message
+        assert "2x HBM" in finding.message
+
+
+# ---------------------------------------------------------------------------
+# the pytest fixture + the suite gate
+# ---------------------------------------------------------------------------
+
+
+class TestGraphLintFixture:
+    def test_clean_program_passes_and_returns_report(self, graph_lint):
+        report = graph_lint(lambda x: x * 2, jnp.ones((4,)))
+        assert report.ok
+
+    def test_errors_raise_with_findings(self, graph_lint):
+        mesh = parallel.initialize_model_parallel(
+            tensor_model_parallel_size=4)
+        fn = cc.shard_over(
+            lambda x: jax.lax.ppermute(x, "tp", [(0, 1), (1, 1)]),
+            mesh=mesh, in_specs=(P("tp"),), out_specs=P("tp"))
+        with pytest.raises(AssertionError, match="APX104"):
+            graph_lint(fn, jnp.ones((8,)))
+
+
+def test_graph_lint_all_entries_exits_zero():
+    """The suite gate (ISSUE 4 acceptance): the full rulebook over every
+    registered entry config — the same invocation as
+    ``scripts/graph_lint.sh`` — must be green on HEAD.  Any ERROR
+    finding fails the fast tier right here."""
+    from apex_tpu.analysis import cli
+
+    assert cli.main(["--all-entries"]) == 0
+
+
+def test_graph_lint_script_lists_rules():
+    """The CI script is runnable and wired to the same CLI (cheap path:
+    --list-rules does not build entries)."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        ["bash", "scripts/graph_lint.sh", "--list-rules"],
+        capture_output=True, timeout=120, cwd=repo)
+    assert proc.returncode == 0, proc.stderr.decode(errors="replace")
+    assert b"APX101" in proc.stdout and b"APX204" in proc.stdout

@@ -5,8 +5,8 @@ path, the ZeRO flat-bucket AND per-leaf paths, and the 3D GPT trainer
 alike — params and optimizer state bit-unchanged across the skipped
 step, ``skipped_steps`` increments, and the guard adds no host round
 trip (the ``lax.cond``-guarded apply survives as a ``conditional`` in
-ONE compiled program, proven on optimized HLO via
-``apex_tpu.testing.hlo``).
+ONE compiled program, checked by the shared analyzer rule APX203 —
+``apex_tpu.analysis``, ISSUE 4 — instead of per-test string asserts).
 """
 
 import functools
@@ -24,8 +24,17 @@ from apex_tpu.resilience import (
     sentinel_init,
     sentinel_update,
 )
+from apex_tpu.analysis import compiled_hlo, lint_hlo
 from apex_tpu.testing import faults
-from apex_tpu.testing.hlo import compiled_hlo, hlo_op_counts
+
+
+def _assert_guard_survives(hlo_text):
+    """The sentinel contract, checked by the ONE shared implementation
+    (analyzer rule APX203) every consumer uses — tests, the CLI over the
+    registered entries, and ``scripts/graph_lint.sh``."""
+    report = lint_hlo(hlo_text, name="sentinel-step",
+                      expect_conditional=True)
+    assert report.ok, report.format()
 
 
 def _leaves_equal(a, b):
@@ -95,9 +104,7 @@ class TestAmpPath:
             return p, s, z
 
         g = {"w": jnp.ones((6, 3))}
-        hlo = compiled_hlo(step, params, state, sent, g)
-        counts = hlo_op_counts(hlo)
-        assert counts["conditional"] >= 1, counts
+        _assert_guard_survives(compiled_hlo(step, params, state, sent, g))
 
 
 # ---------------------------------------------------------------------------
@@ -145,9 +152,8 @@ class TestZeroPath:
         compiled program whose ``conditional`` survives optimization
         (one build per layout keeps this in the fast tier)."""
         params, state, sent, step, batch, bad = self._build(flat_bucket)
-        hlo = compiled_hlo(step, params, state, batch, sent)
-        counts = hlo_op_counts(hlo)
-        assert counts["conditional"] >= 1, counts
+        _assert_guard_survives(compiled_hlo(step, params, state, batch,
+                                            sent))
 
         p1, s1, sent1, loss1 = step(params, state, batch, sent)
         assert int(sent1.skipped_steps) == 0
@@ -208,9 +214,8 @@ class Test3DTrainerPath:
         poisoned_step = jax.jit(
             make_step(opt, specs, scaler=scaler, grad_tap=poison))
 
-        hlo = compiled_hlo(step, params, state, tokens, sent)
-        counts = hlo_op_counts(hlo)
-        assert counts["conditional"] >= 1, counts
+        _assert_guard_survives(compiled_hlo(step, params, state, tokens,
+                                            sent))
 
         p1, s1, sent1, loss1 = step(params, state, tokens, sent)
         assert int(sent1.skipped_steps) == 0

@@ -16,8 +16,13 @@ import pytest
 from jax.sharding import PartitionSpec as P
 
 from apex_tpu import parallel
+from apex_tpu.analysis import (
+    compiled_hlo,
+    count_hlo_ops,
+    hlo_op_counts,
+    lint_hlo,
+)
 from apex_tpu.parallel import collectives as cc
-from apex_tpu.testing.hlo import compiled_hlo, count_hlo_ops, hlo_op_counts
 from apex_tpu.transformer.tensor_parallel.overlap import (
     gather_matmul,
     matmul_scatter,
@@ -160,7 +165,9 @@ def test_gather_matmul_fp8_composes(tp_mesh):
 def test_ring_survives_jit_as_collective_permutes(tp_mesh):
     """Compiled forward HLO: >= tp-1 collective-permutes, zero all-gathers
     (gather ring) / zero reduce-scatters (scatter ring) — the acceptance
-    check that XLA did not re-fuse the decomposition."""
+    check that XLA did not re-fuse the decomposition, enforced by the
+    shared analyzer rule APX201 (with APX202 riding along on the ring's
+    source_target_pairs) rather than per-test opcode counts."""
     _, tp_size = tp_mesh
     x, w = _data(jax.random.PRNGKey(4))
 
@@ -169,18 +176,18 @@ def test_ring_survives_jit_as_collective_permutes(tp_mesh):
         in_specs=(P("tp", None, None), P("tp", None)),
         out_specs=P(None, None, "tp"),
     )
-    txt = compiled_hlo(gm, x, w)
-    assert count_hlo_ops(txt, "collective-permute") >= tp_size - 1
-    assert count_hlo_ops(txt, "all-gather") == 0
+    report = lint_hlo(compiled_hlo(gm, x, w), name="gather_matmul",
+                      expect_ring=tp_size, forbid_ops=("all-gather",))
+    assert report.ok, report.format()
 
     ms = cc.shard_over(
         lambda xs, ws: matmul_scatter(xs, ws, "tp"),
         in_specs=(P(None, None, "tp"), P(None, "tp")),
         out_specs=P("tp", None, None),
     )
-    txt = compiled_hlo(ms, x, w)
-    assert count_hlo_ops(txt, "collective-permute") >= tp_size - 1
-    assert count_hlo_ops(txt, "reduce-scatter") == 0
+    report = lint_hlo(compiled_hlo(ms, x, w), name="matmul_scatter",
+                      expect_ring=tp_size, forbid_ops=("reduce-scatter",))
+    assert report.ok, report.format()
 
 
 def test_hlo_op_counts_folds_async_pairs():
