@@ -41,6 +41,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from apex_tpu.observability.spans import named_span
 from apex_tpu.parallel import collectives as cc
 from apex_tpu.utils.tree import (
     chunked_meta,
@@ -245,13 +246,17 @@ def local_leaf_ids(group: GroupLayout, n_buckets: int, rank):
 def bucket_reduce_scatter(buf, group: GroupLayout, cfg: AxisConfig,
                           n_buckets: int, *, outer_reduce_dtype=None):
     """ONE (hierarchical) reduce-scatter per bucket: full group buffer in,
-    K summed local shards out."""
-    return [
-        cc.hierarchical_reduce_scatter(
-            b, cfg.scatter_axes, cfg.outer_axis, scatter_axis=0,
-            outer_reduce_dtype=outer_reduce_dtype)
-        for b in bucket_slices(buf, group, n_buckets)
-    ]
+    K summed local shards out.  Per-bucket profiler scopes
+    (``apex/zero/reduce_scatter/bucket<k>``) make the bucketed-overlap
+    schedule — gather of bucket k under the update tail of k+1 —
+    readable in an xprof capture."""
+    out = []
+    for k, b in enumerate(bucket_slices(buf, group, n_buckets)):
+        with named_span(f"zero/reduce_scatter/bucket{k}"):
+            out.append(cc.hierarchical_reduce_scatter(
+                b, cfg.scatter_axes, cfg.outer_axis, scatter_axis=0,
+                outer_reduce_dtype=outer_reduce_dtype))
+    return out
 
 
 def bucket_all_gather(local_bufs, group: GroupLayout, cfg: AxisConfig,
@@ -261,11 +266,12 @@ def bucket_all_gather(local_bufs, group: GroupLayout, cfg: AxisConfig,
     full group buffer.  ``dtype`` casts *before* the gather so
     half-precision params move half the bytes."""
     gathered = []
-    for b in local_bufs:
-        if dtype is not None:
-            b = jnp.asarray(b, dtype)
-        gathered.append(
-            cc.hierarchical_all_gather(b, cfg.scatter_axes, concat_axis=0))
+    for k, b in enumerate(local_bufs):
+        with named_span(f"zero/all_gather/bucket{k}"):
+            if dtype is not None:
+                b = jnp.asarray(b, dtype)
+            gathered.append(cc.hierarchical_all_gather(
+                b, cfg.scatter_axes, concat_axis=0))
     return jnp.concatenate(gathered, axis=0)
 
 

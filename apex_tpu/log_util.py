@@ -1,5 +1,6 @@
 """Per-rank logging accessors — ``apex/transformer/log_util.py:5-18``
-parity (``get_transformer_logger``, ``set_logging_level``).
+parity (``get_transformer_logger``, ``set_logging_level``), with the
+handler-level propagation fix (``tests/test_log_util.py``).
 
 The rank-stamped root handler itself lives in ``apex_tpu/__init__.py``
 (``RankInfoFormatter`` — the ``apex/__init__.py:31-43`` analog, with
@@ -33,5 +34,24 @@ def get_transformer_logger(name: str) -> logging.Logger:
 
 
 def set_logging_level(verbosity) -> None:
-    """Reference ``set_logging_level`` (``log_util.py:12-18``)."""
-    get_logger().setLevel(verbosity)
+    """Reference ``set_logging_level`` (``log_util.py:12-18``), fixed to
+    also set the **handler** level: the rank-stamped ``StreamHandler``
+    installed by ``apex_tpu/__init__.py`` is the single emission point
+    for the whole ``apex_tpu.*`` tree, and a handler left at a higher
+    level than the logger silently filters records a child logger was
+    explicitly configured to emit (set the library to INFO, set one
+    child to DEBUG while debugging it — the child's DEBUG records must
+    actually print).  Handlers therefore follow the logger DOWN and are
+    reset to NOTSET (pass-through) when the logger is *loosened*, so the
+    logger level remains the one knob (``tests/test_log_util.py``)."""
+    logger = get_logger()
+    logger.setLevel(verbosity)
+    # Resolve "DEBUG"/10/logging.DEBUG uniformly for the comparison.
+    resolved = logger.getEffectiveLevel()
+    for handler in logger.handlers:
+        if handler.level > resolved:
+            # Tightening the logger: the handler must not keep filtering
+            # below the old threshold...
+            handler.setLevel(logging.NOTSET)
+        # ...and a handler at/below the logger level already passes
+        # everything the logger does (incl. louder child loggers).

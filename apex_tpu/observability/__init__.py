@@ -1,0 +1,86 @@
+"""Training telemetry — in-graph stats, profiler spans, crash-safe metrics.
+
+A training run on preemptible hardware is flying blind without three
+layers the production JAX/PyTorch trainers treat as first-class
+(TorchTitan arxiv 2410.06511; veScale arxiv 2509.07003):
+
+- :mod:`.trainstats` — the **device** layer: a jit-safe
+  :class:`TrainStats` pytree (loss, grad/param global norms, non-finite
+  leaf count, loss scale, cumulative sentinel skips, per-microbatch MoE
+  aux) computed inside the step with zero extra host syncs and **at
+  most the collectives already on the path** (cross-rank stats ride the
+  trainer's existing loss reduction, widened — never added; pinned by
+  an HLO compare in ``tests/test_observability.py``).  Threaded through
+  ``zero_data_parallel_train_step``, ``build_gpt_3d``
+  (``collect_stats=True``) and the driver dryrun.
+- :mod:`.spans` — the **profiler** layer: ``named_span`` op-metadata
+  scopes on the hot traced paths (collective-matmul rings, ZeRO bucket
+  exchange, pipeline ticks), host ``span`` wall-clock timers
+  (checkpoint save/verify/restore), ``step_trace`` step annotations,
+  and :class:`TraceWindow` windowed programmatic xprof capture — the
+  evidence channel the real-TPU ``overlap_comm`` A/B needs (ROADMAP).
+- :mod:`.metrics` + :mod:`.writers` — the **host** layer: rank-aware
+  :class:`MetricRegistry` (counters/gauges/histograms, flushed on rank
+  0 only), MFU from ``compiled.cost_analysis()``, a
+  :class:`HeartbeatMonitor` that flags hung steps to
+  ``resilience.PreemptionGuard``, and an append-only fsync'd
+  :class:`JsonlWriter` whose reader tolerates torn tails (the PR 3
+  crash-safety contract, applied to metrics).
+
+Catalog, span map, and the profiler-capture cookbook:
+``docs/observability.md``.
+"""
+
+from apex_tpu.observability.metrics import (
+    HeartbeatMonitor,
+    MetricRegistry,
+    compiled_flops,
+    default_registry,
+    mfu,
+    peak_flops_for,
+)
+from apex_tpu.observability.spans import (
+    TraceWindow,
+    named_span,
+    span,
+    step_trace,
+)
+from apex_tpu.observability.trainstats import (
+    PartialTrainStats,
+    TrainStats,
+    TrainStatsLogger,
+    device_partial_norms,
+    local_grad_stats,
+    pack_local_stats,
+    partial_train_stats,
+    stats_from_reduced,
+    stats_partition_specs,
+    train_stats,
+)
+from apex_tpu.observability.writers import JsonlWriter, iter_jsonl, read_jsonl
+
+__all__ = [
+    "TrainStats",
+    "PartialTrainStats",
+    "TrainStatsLogger",
+    "train_stats",
+    "partial_train_stats",
+    "device_partial_norms",
+    "local_grad_stats",
+    "pack_local_stats",
+    "stats_from_reduced",
+    "stats_partition_specs",
+    "named_span",
+    "span",
+    "step_trace",
+    "TraceWindow",
+    "MetricRegistry",
+    "default_registry",
+    "HeartbeatMonitor",
+    "compiled_flops",
+    "peak_flops_for",
+    "mfu",
+    "JsonlWriter",
+    "read_jsonl",
+    "iter_jsonl",
+]

@@ -1,0 +1,375 @@
+"""Host-side metrics pipeline: registry, MFU, heartbeat.
+
+The device side (:mod:`.trainstats`) produces numbers; this module owns
+what the *host* does with them between steps:
+
+- :class:`MetricRegistry` — rank-aware counters/gauges/histograms.
+  Every process may record (recording is cheap, lock-guarded dict math),
+  but ``flush`` writes only on the writer rank (process 0 by default) —
+  the multi-host discipline of the PR 3 checkpoint manifest: exactly one
+  process owns the durable artifact.
+- :func:`mfu` / :func:`compiled_flops` — model FLOPs utilization derived
+  from ``compiled.cost_analysis()`` (the partitioner's own FLOP count
+  for the program that actually ran, not an analytic formula that drifts
+  from the model) over the device's peak.
+- :class:`HeartbeatMonitor` — records the last-completed-step timestamp
+  and, when no beat arrives within ``timeout_s``, flags the hang to
+  :class:`apex_tpu.resilience.PreemptionGuard` (duck-typed: anything
+  with ``.trigger()``, or a plain callable) so the training loop's
+  existing drain-and-checkpoint path runs instead of the job burning its
+  window wedged on a dead collective or a hung filesystem
+  (``testing/faults.hung_writes`` drives the test).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Any, Callable, Dict, Optional
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricRegistry",
+    "default_registry",
+    "compiled_flops",
+    "peak_flops_for",
+    "mfu",
+    "HeartbeatMonitor",
+]
+
+logger = logging.getLogger(__name__)
+
+
+def _safe_rank_world():
+    """(process_index, process_count) without forcing backend init —
+    mirrors ``RankInfoFormatter``'s guard (``apex_tpu/__init__.py``): a
+    metrics registry constructed before jax.distributed.initialize must
+    not initialize a backend as a side effect."""
+    try:
+        from jax._src import xla_bridge
+
+        if xla_bridge._backends:
+            import jax
+
+            return jax.process_index(), jax.process_count()
+    except Exception:  # pragma: no cover - private API moved
+        pass
+    return 0, 1
+
+
+class Counter:
+    """Monotonic counter (``inc``-only)."""
+
+    def __init__(self, lock: Optional[threading.Lock] = None):
+        self._lock = lock if lock is not None else threading.Lock()
+        self.value = 0.0
+
+    def inc(self, n: float = 1.0) -> None:
+        with self._lock:
+            self.value += n
+
+
+class Gauge:
+    """Last-write-wins scalar."""
+
+    def __init__(self, lock: Optional[threading.Lock] = None):
+        self._lock = lock if lock is not None else threading.Lock()
+        self.value: Optional[float] = None
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self.value = float(v)
+
+
+class Histogram:
+    """Streaming summary (count/total/min/max/last) — enough for span
+    timings and rates without holding samples."""
+
+    def __init__(self, lock: Optional[threading.Lock] = None):
+        self._lock = lock if lock is not None else threading.Lock()
+        self.count = 0
+        self.total = 0.0
+        self.min: Optional[float] = None
+        self.max: Optional[float] = None
+        self.last: Optional[float] = None
+
+    def observe(self, v: float) -> None:
+        v = float(v)
+        with self._lock:
+            self.count += 1
+            self.total += v
+            self.min = v if self.min is None else min(self.min, v)
+            self.max = v if self.max is None else max(self.max, v)
+            self.last = v
+
+    @property
+    def mean(self) -> Optional[float]:
+        return self.total / self.count if self.count else None
+
+    def summary(self) -> dict:
+        with self._lock:
+            return {"count": self.count, "total": self.total,
+                    "mean": self.mean, "min": self.min, "max": self.max,
+                    "last": self.last}
+
+
+class MetricRegistry:
+    """Named metric store with rank-aware flushing.
+
+    ``rank``/``world`` default to ``jax.process_index()``/``count`` when
+    a backend exists, else ``0``/``1`` — so the registry works in
+    host-only unit tests and before distributed init alike.  Thread-safe
+    (async checkpoint writers and the heartbeat thread record too).
+    """
+
+    def __init__(self, *, rank: Optional[int] = None,
+                 world: Optional[int] = None):
+        auto_rank, auto_world = _safe_rank_world()
+        self.rank = auto_rank if rank is None else rank
+        self.world = auto_world if world is None else world
+        # RLock, shared with every metric this registry creates: metric
+        # mutation is atomic against snapshot(), and snapshot() can call
+        # Histogram.summary() (which re-acquires) without deadlocking.
+        self._lock = threading.RLock()
+        self._counters: Dict[str, Counter] = {}
+        self._gauges: Dict[str, Gauge] = {}
+        self._histograms: Dict[str, Histogram] = {}
+
+    @property
+    def is_writer(self) -> bool:
+        """Exactly one process owns the durable metrics artifact."""
+        return self.rank == 0
+
+    def _get(self, store: dict, name: str, cls):
+        with self._lock:
+            if name not in store:
+                store[name] = cls(self._lock)
+            return store[name]
+
+    def counter(self, name: str) -> Counter:
+        return self._get(self._counters, name, Counter)
+
+    def gauge(self, name: str) -> Gauge:
+        return self._get(self._gauges, name, Gauge)
+
+    def histogram(self, name: str) -> Histogram:
+        return self._get(self._histograms, name, Histogram)
+
+    def snapshot(self) -> dict:
+        """Flat ``{name: value}`` view (histograms as summary dicts)."""
+        with self._lock:
+            out: Dict[str, Any] = {
+                name: c.value for name, c in self._counters.items()}
+            out.update({name: g.value for name, g in self._gauges.items()})
+            out.update({name: h.summary()
+                        for name, h in self._histograms.items()})
+            return out
+
+    def flush(self, writer, *, step: Optional[int] = None,
+              extra: Optional[dict] = None) -> Optional[dict]:
+        """Write one record ``{ts, step, rank, metrics, **extra}`` via
+        ``writer.write`` — **only on the writer rank** (other ranks
+        return ``None`` without touching storage).  ``writer=None`` is a
+        no-op, so callers thread an optional writer without branching."""
+        if writer is None or not self.is_writer:
+            return None
+        record: Dict[str, Any] = {"ts": time.time(), "rank": self.rank}
+        if step is not None:
+            record["step"] = step
+        record["metrics"] = self.snapshot()
+        if extra:
+            record.update(extra)
+        writer.write(record)
+        return record
+
+
+_DEFAULT: Optional[MetricRegistry] = None
+_DEFAULT_LOCK = threading.Lock()
+
+
+def default_registry() -> MetricRegistry:
+    """Process-wide registry (what :func:`~apex_tpu.observability.spans.
+    span` and the checkpoint-manager spans record into by default)."""
+    global _DEFAULT
+    with _DEFAULT_LOCK:
+        if _DEFAULT is None:
+            _DEFAULT = MetricRegistry()
+        return _DEFAULT
+
+
+# --- MFU -----------------------------------------------------------------
+
+# bf16 peak FLOP/s per chip by device kind (public TPU specs — the same
+# table bench.py uses for its MFU rows).
+_PEAK_FLOPS = (
+    ("v6", 918e12),
+    ("v5p", 459e12),
+    ("v5", 197e12),
+    ("v4", 275e12),
+)
+
+
+def peak_flops_for(device) -> Optional[float]:
+    """Peak bf16 FLOP/s of a jax device, ``None`` when unknown (CPU —
+    MFU against an undefined peak would be noise, not a metric)."""
+    kind = getattr(device, "device_kind", "").lower()
+    platform = getattr(device, "platform", "")
+    if platform != "tpu":
+        return None
+    for tag, peak in _PEAK_FLOPS:
+        if tag in kind:
+            return peak
+    return 197e12  # conservative default (v5e)
+
+
+def compiled_flops(compiled) -> Optional[float]:
+    """Total FLOPs of one execution from ``compiled.cost_analysis()``.
+
+    Handles both historical return shapes (a per-device list of dicts on
+    jax 0.4.x, a plain dict later); returns ``None`` when the backend
+    reports no estimate — callers must treat MFU as optional."""
+    try:
+        ca = compiled.cost_analysis()
+    except Exception as e:
+        logger.debug("cost_analysis unavailable: %r", e)
+        return None
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else None
+    if not isinstance(ca, dict):
+        return None
+    flops = ca.get("flops")
+    return float(flops) if flops else None
+
+
+def mfu(flops_per_step: Optional[float], step_time_s: float, *,
+        peak_flops: Optional[float] = None,
+        device=None, n_devices: int = 1) -> Optional[float]:
+    """Model FLOPs utilization: ``flops / time / (peak * n_devices)``.
+
+    ``flops_per_step`` is the whole-program FLOP count (e.g.
+    :func:`compiled_flops` of the jitted step — under SPMD that is the
+    global program, hence ``n_devices`` scales the denominator).
+    Returns ``None`` when either the FLOP count or the peak is unknown
+    (CPU) rather than a made-up number."""
+    if flops_per_step is None or step_time_s <= 0:
+        return None
+    if peak_flops is None:
+        peak_flops = peak_flops_for(device) if device is not None else None
+    if peak_flops is None:
+        return None
+    return flops_per_step / step_time_s / (peak_flops * max(n_devices, 1))
+
+
+# --- heartbeat -----------------------------------------------------------
+
+
+class HeartbeatMonitor:
+    """Hung-step detector: ``beat(step)`` after every completed step; a
+    background thread flags ``hung`` (and fires ``on_hang``) when no
+    beat lands within ``timeout_s``.
+
+    ``on_hang`` duck-types :class:`apex_tpu.resilience.PreemptionGuard`
+    (``.trigger()`` preferred, else called directly): a hang is handled
+    exactly like a preemption notice — the loop's next alive moment
+    drains async saves and checkpoints, instead of the job dying wedged
+    with hours of unsaved progress.  The flag fires once per hang
+    episode (re-armed by the next beat) so a slow-but-alive step cannot
+    machine-gun the guard.
+
+    ``check_now()`` runs one poll synchronously — deterministic tests
+    (``tests/test_observability.py`` with ``faults.hung_writes``) use it
+    instead of racing the thread.
+    """
+
+    def __init__(self, *, timeout_s: float, on_hang: Optional[Any] = None,
+                 registry: Optional[MetricRegistry] = None,
+                 poll_s: Optional[float] = None):
+        if timeout_s <= 0:
+            raise ValueError(f"timeout_s must be > 0, got {timeout_s}")
+        self.timeout_s = timeout_s
+        self.on_hang = on_hang
+        self.registry = registry if registry is not None else \
+            default_registry()
+        self.poll_s = poll_s if poll_s is not None else \
+            max(timeout_s / 4.0, 0.01)
+        self.last_step: Optional[int] = None
+        self.last_beat_time: Optional[float] = None
+        self.hung = False
+        self.hang_count = 0
+        self._armed = False  # a beat arrived since the last hang flag
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+
+    def beat(self, step: int) -> None:
+        """Record step completion (call from the training loop, after
+        the step's results are materialized)."""
+        with self._lock:
+            self.last_step = step
+            self.last_beat_time = time.monotonic()
+            self.hung = False
+            self._armed = True
+        self.registry.gauge("heartbeat/last_step").set(step)
+        self.registry.gauge("heartbeat/last_beat_ts").set(time.time())
+
+    def check_now(self) -> bool:
+        """One poll: returns (and latches) the hung verdict."""
+        fire: Optional[Callable] = None
+        with self._lock:
+            if not self._armed or self.last_beat_time is None:
+                return self.hung
+            if time.monotonic() - self.last_beat_time > self.timeout_s:
+                self.hung = True
+                self.hang_count += 1
+                self._armed = False  # once per episode
+                on_hang = self.on_hang
+                if on_hang is not None:
+                    fire = getattr(on_hang, "trigger", on_hang)
+        if fire is not None:
+            logger.warning(
+                "heartbeat: no step completed in %.1fs (last step %s) — "
+                "flagging hang", self.timeout_s, self.last_step)
+            self.registry.counter("heartbeat/hangs").inc()
+            try:
+                fire()
+            except Exception as e:  # telemetry never kills training
+                logger.warning("heartbeat on_hang raised: %r", e)
+        elif self.hung:
+            self.registry.counter("heartbeat/hangs").inc()
+        return self.hung
+
+    def start(self) -> "HeartbeatMonitor":
+        if self._thread is not None:
+            return self
+        # Arm from "now" so a wedge BEFORE the first completed step —
+        # the most common wedge shape (dead collective / compile hang on
+        # step 0) — is detected too, not only gaps between beats.
+        with self._lock:
+            if self.last_beat_time is None:
+                self.last_beat_time = time.monotonic()
+                self._armed = True
+        self._stop.clear()
+
+        def run():
+            while not self._stop.wait(self.poll_s):
+                self.check_now()
+
+        self._thread = threading.Thread(
+            target=run, name="apex-heartbeat", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    def __enter__(self) -> "HeartbeatMonitor":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

@@ -1,0 +1,142 @@
+"""Crash-safe metrics storage — append-only fsync'd JSONL.
+
+The PR 3 checkpoint layer's durability argument, applied to metrics: a
+run that dies must leave behind (a) every metric record that was
+acknowledged and (b) a file a reader can always parse.  The format that
+satisfies both with no recovery machinery is append-only JSONL with one
+``open → write → fsync → close`` cycle per record:
+
+- each record is a single ``os.write`` of one newline-terminated line to
+  an ``O_APPEND`` descriptor — concurrent writers interleave at line
+  granularity, never mid-line;
+- ``fsync`` before the call returns makes acknowledged records durable
+  (the same contract as the checkpoint temp-fsync-rename protocol,
+  without the rename: appends never replace committed bytes);
+- a crash mid-write can tear at most the *final* line;
+  :func:`read_jsonl` therefore treats an unparseable tail as the
+  expected torn-write artifact and returns the intact prefix (a torn
+  *interior* line — real corruption — is skipped with a warning, or
+  fatal under ``strict=True``);
+- transient ``OSError`` (the NFS/GCS-fuse blip the checkpoint manager
+  retries) gets the same bounded retry-with-backoff here
+  (``testing/faults.transient_os_errors`` drives the test).
+
+Rank-awareness lives one layer up (``MetricRegistry.flush`` writes only
+on rank 0); this module is deliberately a dumb, durable pipe.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+from typing import Iterator, List, Optional
+
+__all__ = ["JsonlWriter", "read_jsonl", "iter_jsonl"]
+
+logger = logging.getLogger(__name__)
+
+
+class JsonlWriter:
+    """Append-only fsync'd JSONL writer.
+
+    ``fsync=False`` trades durability of the last few records for write
+    latency (the OS still sees every byte; only a *power* loss can eat
+    buffered lines) — keep the default for rank-0 training telemetry,
+    where one fsync per ``log_every_n`` steps is noise.
+    """
+
+    def __init__(self, path: str, *, fsync: bool = True, retries: int = 3,
+                 backoff_s: float = 0.05):
+        self.path = path
+        self.fsync = fsync
+        self.retries = retries
+        self.backoff_s = backoff_s
+        self.records_written = 0
+        parent = os.path.dirname(path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+
+    def write(self, record: dict) -> None:
+        """Durably append one record.  Serialization errors propagate
+        immediately (a bug, not weather); ``OSError`` is retried with
+        exponential backoff and re-raised when the budget is spent.
+
+        The retry tracks how many bytes actually landed, so a blip
+        *after* the append (fsync, close) never re-appends the record as
+        a duplicate, and a short/torn write is completed from where it
+        stopped rather than restarted (O_APPEND continues the same
+        line)."""
+        data = (json.dumps(record, separators=(",", ":"),
+                           default=_json_fallback) + "\n").encode()
+        sent = 0
+        for attempt in range(self.retries + 1):
+            try:
+                # Open-per-record: no long-lived descriptor to leak
+                # across a fork/preemption, and the O_APPEND single-shot
+                # write keeps the line contiguous even with a concurrent
+                # writer.
+                fd = os.open(self.path,
+                             os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+                try:
+                    while sent < len(data):
+                        sent += os.write(fd, data[sent:])
+                    if self.fsync:
+                        os.fsync(fd)
+                finally:
+                    os.close(fd)
+                self.records_written += 1
+                return
+            except OSError as e:
+                if attempt == self.retries:
+                    raise
+                delay = self.backoff_s * (2.0 ** attempt)
+                logger.warning(
+                    "metrics append to %s failed (%r), retry %d/%d in "
+                    "%.2fs", self.path, e, attempt + 1, self.retries, delay)
+                time.sleep(delay)
+
+
+def _json_fallback(obj):
+    """Serialize the numpy/jax scalars metric records naturally carry."""
+    if hasattr(obj, "item"):
+        return obj.item()
+    if hasattr(obj, "tolist"):
+        return obj.tolist()
+    raise TypeError(f"not JSON serializable: {type(obj)!r}")
+
+
+def iter_jsonl(path: str, *, strict: bool = False) -> Iterator[dict]:
+    """Yield records, tolerating the crash artifacts the writer can
+    leave: a torn FINAL line (writer died mid-append) is silently
+    dropped — even under ``strict``, because it is the *expected* shape
+    of a crash, not corruption; a torn interior line IS storage
+    corruption — skipped with a warning, or raised under
+    ``strict=True``."""
+    with open(path, "rb") as f:
+        lines = f.read().split(b"\n")
+    n = len(lines)
+    for i, line in enumerate(lines):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            yield json.loads(line)
+        except json.JSONDecodeError as e:
+            # A tear happens mid-append, so the torn line is the file's
+            # very last content AND unterminated (no trailing newline —
+            # a terminated garbage line is interior corruption instead).
+            if i == n - 1:
+                logger.info("dropping torn JSONL tail in %s", path)
+                return
+            if strict:
+                raise ValueError(
+                    f"corrupt JSONL line {i} in {path}: {e}") from e
+            logger.warning(
+                "skipping corrupt JSONL line %d in %s (%s)", i, path, e)
+
+
+def read_jsonl(path: str, *, strict: bool = False) -> List[dict]:
+    """All intact records of a (possibly torn) metrics file."""
+    return list(iter_jsonl(path, strict=strict))

@@ -286,6 +286,7 @@ def zero_data_parallel_train_step(
     donate: bool = True,
     microbatches: int = 1,
     scaler=None,
+    collect_stats: bool = False,
 ):
     """The shard_map ZeRO path: per-replica local grads feed a
     ZeRO-sharded optimizer (``DistributedFusedAdam``/``LAMB``) whose
@@ -314,6 +315,19 @@ def zero_data_parallel_train_step(
     opt_state, sentinel, loss)`` (init with
     :func:`apex_tpu.resilience.sentinel_init`; ``sentinel.skipped_steps``
     counts skipped updates; the reported loss is unscaled).
+
+    ``collect_stats`` appends a jit-carried
+    :class:`apex_tpu.observability.TrainStats` as the step's LAST output
+    (after the loss).  The cross-rank fields (loss, grad sum-of-squares,
+    non-finite leaf count) ride the step's EXISTING loss all-reduce as a
+    widened ``(3,)`` payload — the instrumented step performs exactly the
+    collectives the bare step did (``tests/test_observability.py`` pins
+    the HLO opcode counts equal) and its params/optimizer state are
+    bit-identical; ``grad_norm`` is the L2 norm over the stacked
+    per-replica local grads (what actually rode the wire — see
+    docs/observability.md).  Fetch stats on a host schedule with
+    :class:`apex_tpu.observability.TrainStatsLogger` so steady-state
+    steps stay fully async.
     """
     if mesh is None:
         mesh = mesh_lib.get_mesh()
@@ -323,11 +337,12 @@ def zero_data_parallel_train_step(
     def batch_spec(x):
         return P(dp_axes, *([None] * (jnp.ndim(x) - 1)))
 
-    def jit_shard_step(per_shard):
+    def jit_shard_step(per_shard, tail_specs=()):
         """ONE copy of the spec/shard_over/jit/donate plumbing for both
         shapes: ``rest`` is ``(batch,)`` or ``(batch, sentinel)`` — the
         batch comes first, any carry-state after it is replicated and
-        mirrored into the outputs (before the loss)."""
+        mirrored into the outputs (before the loss).  ``tail_specs``:
+        extra replicated outputs AFTER the loss (the TrainStats tree)."""
         def step(params, opt_state, *rest, lr=None):
             batch, carry = rest[0], rest[1:]
             param_specs = jax.tree_util.tree_map(lambda _: P(), params)
@@ -337,7 +352,8 @@ def zero_data_parallel_train_step(
             in_specs = (param_specs, state_specs,
                         jax.tree_util.tree_map(batch_spec, batch),
                         *carry_specs, P())
-            out_specs = (param_specs, state_specs, *carry_specs, P())
+            out_specs = (param_specs, state_specs, *carry_specs, P(),
+                         *tail_specs)
             lr_in = jnp.float32(optimizer.lr if lr is None else lr)
             return cc.shard_over(
                 per_shard, mesh=mesh, in_specs=in_specs,
@@ -346,18 +362,36 @@ def zero_data_parallel_train_step(
 
         return jax.jit(step, donate_argnums=(0, 1) if donate else ())
 
+    if collect_stats:
+        from apex_tpu.observability import trainstats as ts
+
+        stats_tail = (ts.stats_partition_specs(),)
+        world = 1
+        for a in dp_axes:
+            world *= mesh.shape[a]
+
     if scaler is None:
         grad_fn = grad_accumulation(
             lambda p, b: jax.value_and_grad(loss_fn)(p, b), microbatches)
 
         def per_shard(params, opt_state, batch, lr):
             loss, grads = grad_fn(params, batch)
-            params, opt_state = optimizer.step(grads, opt_state, params,
-                                               lr=lr)
-            loss = cc.all_reduce(loss, dp_axes, op="mean")
-            return params, opt_state, loss
+            new_p, new_s = optimizer.step(grads, opt_state, params, lr=lr)
+            if not collect_stats:
+                loss = cc.all_reduce(loss, dp_axes, op="mean")
+                return new_p, new_s, loss
+            # The ONE collective of the bare loss path, widened: a sum
+            # over [loss, grad_sumsq, nonfinite_leaves] replaces the
+            # scalar pmean (pmean IS psum + the same static division, so
+            # the reported loss — and everything the optimizer consumed
+            # upstream of it — is bit-identical to the bare step).
+            red = cc.all_reduce(ts.pack_local_stats(loss, grads),
+                                dp_axes, op="sum")
+            loss, stats = ts.stats_from_reduced(red, world, params)
+            return new_p, new_s, loss, stats
 
-        return jit_shard_step(per_shard)
+        return jit_shard_step(per_shard,
+                              stats_tail if collect_stats else ())
 
     from apex_tpu.resilience.sentinel import sentinel_guarded_apply
 
@@ -373,10 +407,21 @@ def zero_data_parallel_train_step(
             lambda p, b: jax.value_and_grad(scaled_loss)(p, b),
             microbatches)
         loss_s, grads = grad_fn(params, batch)
-        params, opt_state, sent = sentinel_guarded_apply(
+        new_p, new_s, new_sent = sentinel_guarded_apply(
             scaler, optimizer, grads, opt_state, params, sent,
             axes=dp_axes, lr=lr, grad_scale=scale_used)
-        loss = cc.all_reduce(loss_s / scale_used, dp_axes, op="mean")
-        return params, opt_state, sent, loss
+        if not collect_stats:
+            loss = cc.all_reduce(loss_s / scale_used, dp_axes, op="mean")
+            return new_p, new_s, new_sent, loss
+        # Same widened-reduction trick; the loss element enters already
+        # unscaled so the psum+divide reproduces the bare pmean bitwise.
+        # grad_norm is reported unscaled via grad_scale.
+        red = cc.all_reduce(ts.pack_local_stats(loss_s / scale_used, grads),
+                            dp_axes, op="sum")
+        loss, stats = ts.stats_from_reduced(
+            red, world, params, grad_scale=scale_used,
+            loss_scale=scale_used, skipped_steps=new_sent.skipped_steps)
+        return new_p, new_s, new_sent, loss, stats
 
-    return jit_shard_step(per_shard_guarded)
+    return jit_shard_step(per_shard_guarded,
+                          stats_tail if collect_stats else ())

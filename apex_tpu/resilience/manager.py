@@ -38,6 +38,7 @@ import time
 from typing import Any, Optional
 
 from apex_tpu import checkpoint as ckpt
+from apex_tpu.observability.spans import span
 
 __all__ = ["CheckpointManager"]
 
@@ -141,14 +142,19 @@ class CheckpointManager:
         applies retention."""
         self.wait()
         path = self._path(step)
-        if self.sharded:
-            self._with_retries(
-                lambda: ckpt.save_checkpoint_sharded(path, tree, step=step),
-                f"sharded save step {step}")
-        else:
-            self._with_retries(
-                lambda: ckpt.save_checkpoint(path, tree, step=step),
-                f"save step {step}")
+        # Host span (wall clock + trace range, docs/observability.md):
+        # checkpoint stalls are a classic silent step-time thief — the
+        # span_ms/checkpoint/save histogram makes them a metric.
+        with span("checkpoint/save"):
+            if self.sharded:
+                self._with_retries(
+                    lambda: ckpt.save_checkpoint_sharded(path, tree,
+                                                         step=step),
+                    f"sharded save step {step}")
+            else:
+                self._with_retries(
+                    lambda: ckpt.save_checkpoint(path, tree, step=step),
+                    f"save step {step}")
         self._apply_retention()
         return path
 
@@ -162,15 +168,19 @@ class CheckpointManager:
         removes OTHER steps)."""
         self.wait()
         path = self._path(step)
-        if self.sharded:
-            handle = self._with_retries(
-                lambda: ckpt.save_checkpoint_sharded_async(
-                    path, tree, step=step),
-                f"async sharded save step {step}")
-        else:
-            handle = self._with_retries(
-                lambda: ckpt.save_checkpoint_async(path, tree, step=step),
-                f"async save step {step}")
+        # Only the snapshot+submission is on the training thread — the
+        # span bounds exactly the step-time cost of an async save.
+        with span("checkpoint/save_async_submit"):
+            if self.sharded:
+                handle = self._with_retries(
+                    lambda: ckpt.save_checkpoint_sharded_async(
+                        path, tree, step=step),
+                    f"async sharded save step {step}")
+            else:
+                handle = self._with_retries(
+                    lambda: ckpt.save_checkpoint_async(path, tree,
+                                                       step=step),
+                    f"async save step {step}")
         self._inflight = (step, handle)
         return handle
 
@@ -232,9 +242,10 @@ class CheckpointManager:
         """Integrity pass over one step's checkpoint (checksums, torn
         files).  Raises :class:`apex_tpu.checkpoint.CheckpointCorruptError`."""
         path = self._path(step)
-        if self.sharded:
-            return ckpt.verify_checkpoint_sharded(path)
-        return ckpt.verify_checkpoint(path)
+        with span("checkpoint/verify"):
+            if self.sharded:
+                return ckpt.verify_checkpoint_sharded(path)
+            return ckpt.verify_checkpoint(path)
 
     def restore_latest(self, like: Any, *, verify: bool = True):
         """Restore the newest intact checkpoint into the structure (and
@@ -259,10 +270,12 @@ class CheckpointManager:
             try:
                 if verify:
                     self.verify(step)
-                if self.sharded:
-                    tree, at = ckpt.restore_checkpoint_sharded(path, like)
-                else:
-                    tree, at = ckpt.restore_checkpoint(path, like)
+                with span("checkpoint/restore"):
+                    if self.sharded:
+                        tree, at = ckpt.restore_checkpoint_sharded(
+                            path, like)
+                    else:
+                        tree, at = ckpt.restore_checkpoint(path, like)
                 if failures:
                     logger.warning(
                         "restore_latest fell back to step %d past %s",

@@ -81,6 +81,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from apex_tpu.observability.spans import named_span
 from apex_tpu.parallel import collectives as cc
 
 from apex_tpu.parallel.mesh import PIPELINE_AXIS, get_mesh
@@ -358,13 +359,19 @@ def pipeline_apply(
                 lambda e, c_: jnp.where(is_entry, e, c_), entry_mb, state
             )
             c = jnp.clip(((t - s) // pp) % vpp, 0, vpp - 1)
-            y = fn(chunk_params(c), x_in)
-            shifted = jax.tree_util.tree_map(
-                lambda l: lax.ppermute(
-                    l, axis, [(i, (i + 1) % pp) for i in range(pp)]
-                ),
-                y,
-            )
+            # Profiler scopes on the tick body (scanned, so each name
+            # appears once in the program but tags every tick's ops in a
+            # capture): stage compute vs the rotation hop — the
+            # pipeline-bubble evidence of the capture runbook.
+            with named_span("pipeline/stage_compute"):
+                y = fn(chunk_params(c), x_in)
+            with named_span("pipeline/rotate_shift"):
+                shifted = jax.tree_util.tree_map(
+                    lambda l: lax.ppermute(
+                        l, axis, [(i, (i + 1) % pp) for i in range(pp)]
+                    ),
+                    y,
+                )
             return shifted, y
 
         def grouped_ticks():

@@ -53,6 +53,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from apex_tpu.observability.spans import named_span
 from apex_tpu.parallel import collectives as cc
 from apex_tpu.parallel.mesh import TENSOR_AXIS
 
@@ -100,9 +101,13 @@ def _gather_matmul_ring(x, w, metas, axis):
     r = lax.axis_index(axis)
     cur, parts = x, []
     for t in range(n):
-        nxt = cc.send_recv_prev(cur, axis) if t < n - 1 else None
-        parts.append(((r + t) % n, _mm(cur, w, metas)))
-        cur = nxt
+        # Chunk-step scope: in an xprof capture each ring step's hop +
+        # partial GEMM group under one name, so the overlap (permute
+        # sunk under the neighboring dot) is readable off the timeline.
+        with named_span(f"ring/gather_matmul/step{t}"):
+            nxt = cc.send_recv_prev(cur, axis) if t < n - 1 else None
+            parts.append(((r + t) % n, _mm(cur, w, metas)))
+            cur = nxt
     out = jnp.zeros((n,) + parts[0][1].shape, parts[0][1].dtype)
     for c, p in parts:
         out = lax.dynamic_update_index_in_dim(out, p, c, 0)
@@ -123,12 +128,13 @@ def _matmul_scatter_ring(x, w, metas, axis):
     xc = cc.ring_chunks(x, n, 0)
     acc = None
     for t in range(n):
-        if t:
-            acc = cc.send_recv_next(acc, axis)
-        d = (r + n - 1 - t) % n
-        part = _mm(lax.dynamic_index_in_dim(xc, d, 0, keepdims=False),
-                   w, metas)
-        acc = part if acc is None else acc + part
+        with named_span(f"ring/matmul_scatter/step{t}"):
+            if t:
+                acc = cc.send_recv_next(acc, axis)
+            d = (r + n - 1 - t) % n
+            part = _mm(lax.dynamic_index_in_dim(xc, d, 0, keepdims=False),
+                       w, metas)
+            acc = part if acc is None else acc + part
     return acc
 
 
@@ -160,22 +166,24 @@ def _gather_matmul_bwd(axis, res, dy):
 
     acc = None
     for t in range(n):
-        if t:
-            acc = cc.send_recv_next(acc, axis)
-        d = (r + n - 1 - t) % n
-        g_d = lax.dynamic_index_in_dim(dyc, d, 0, keepdims=False)
-        part = _mm_dx(g_d, x, w, metas)
-        acc = part if acc is None else acc + part
+        with named_span(f"ring/gather_matmul_bwd_dx/step{t}"):
+            if t:
+                acc = cc.send_recv_next(acc, axis)
+            d = (r + n - 1 - t) % n
+            g_d = lax.dynamic_index_in_dim(dyc, d, 0, keepdims=False)
+            part = _mm_dx(g_d, x, w, metas)
+            acc = part if acc is None else acc + part
     dx = acc
 
     cur, dw = x, None
     for t in range(n):
-        c = (r + t) % n
-        nxt = cc.send_recv_prev(cur, axis) if t < n - 1 else None
-        g_c = lax.dynamic_index_in_dim(dyc, c, 0, keepdims=False)
-        part = _mm_dw(g_c, cur, w, metas)
-        dw = part if dw is None else dw + part
-        cur = nxt
+        with named_span(f"ring/gather_matmul_bwd_dw/step{t}"):
+            c = (r + t) % n
+            nxt = cc.send_recv_prev(cur, axis) if t < n - 1 else None
+            g_c = lax.dynamic_index_in_dim(dyc, c, 0, keepdims=False)
+            part = _mm_dw(g_c, cur, w, metas)
+            dw = part if dw is None else dw + part
+            cur = nxt
     return dx, dw, None
 
 
@@ -224,16 +232,17 @@ def _matmul_scatter_bwd(axis, res, dy):
 
     cur, dx_parts, dw = dy, [], None
     for t in range(n):
-        c = (r + t) % n
-        nxt = cc.send_recv_prev(cur, axis) if t < n - 1 else None
-        x_c = lax.dynamic_index_in_dim(xc, c, 0, keepdims=False)
-        # One joint pullback per step: both cotangents of the same
-        # (chunk, weight) GEMM come from a single linearization.
-        _, pull = jax.vjp(lambda xx, ww: _mm(xx, ww, metas), x_c, w)
-        dx_c, dw_c = pull(cur)
-        dx_parts.append((c, dx_c))
-        dw = dw_c if dw is None else dw + dw_c
-        cur = nxt
+        with named_span(f"ring/matmul_scatter_bwd/step{t}"):
+            c = (r + t) % n
+            nxt = cc.send_recv_prev(cur, axis) if t < n - 1 else None
+            x_c = lax.dynamic_index_in_dim(xc, c, 0, keepdims=False)
+            # One joint pullback per step: both cotangents of the same
+            # (chunk, weight) GEMM come from a single linearization.
+            _, pull = jax.vjp(lambda xx, ww: _mm(xx, ww, metas), x_c, w)
+            dx_c, dw_c = pull(cur)
+            dx_parts.append((c, dx_c))
+            dw = dw_c if dw is None else dw + dw_c
+            cur = nxt
     dx = jnp.zeros((n,) + dx_parts[0][1].shape, dx_parts[0][1].dtype)
     for c, p in dx_parts:
         dx = lax.dynamic_update_index_in_dim(dx, p, c, 0)

@@ -154,7 +154,7 @@ def build_gpt_3d(
                             final_ln=ln_specs)
         return params, specs
 
-    def _local_loss(p: GPT3DParams, tokens):
+    def _local_loss(p: GPT3DParams, tokens, with_aux: bool = False):
         """Mean LM loss of the local dp shard; runs with dp/pp/tp bound.
 
         Returns a ``(1,)``-shaped array, NOT a scalar: jax 0.4.x's
@@ -162,7 +162,14 @@ def build_gpt_3d(
         shard_map boundary under ``value_and_grad`` (scalar residual
         out-names trip ``_check_names`` with a ``_SpecError``; the
         promotion pass misses forwarded scalars), so every scalar on the
-        loss tail keeps a singleton axis until outside the shard_map."""
+        loss tail keeps a singleton axis until outside the shard_map.
+
+        ``with_aux=True`` (telemetry): returns ``(1 + m,)`` — the loss
+        followed by the per-microbatch MoE aux vector, ``stop_gradient``
+        -cut so the backward program is byte-for-byte the bare one.  Any
+        collective the aux vector needs is the *widened* form of one the
+        bare path already performs (never an extra op — the
+        instrumented/bare HLO compare in tests/test_observability.py)."""
         mbs = split_into_microbatches(tokens, num_microbatches)
 
         def embed_one(t):
@@ -197,6 +204,21 @@ def build_gpt_3d(
 
         losses = jax.vmap(head_one)(out, mbs)
         ce = jnp.mean(losses).reshape(1)
+        # Telemetry rider: the per-microbatch aux vector is observational
+        # only — stop_gradient keeps the differentiated subgraph (and so
+        # the grads, bit for bit) identical to the bare path.  Dense
+        # configs have no MoE aux: report zeros WITHOUT reading the
+        # pipeline's aux carry — a dense bare step never consumes it, so
+        # XLA DCEs its rotation ppermute, and reading it here would
+        # resurrect a collective the bare step doesn't perform (the
+        # instrumented/bare HLO compare in tests/test_observability.py).
+        if not with_aux:
+            aux_mb = None
+        elif cfg.num_experts is not None:
+            aux_mb = jax.lax.stop_gradient(
+                aux_out.reshape(num_microbatches))
+        else:
+            aux_mb = jnp.zeros((num_microbatches,), jnp.float32)
         if cfg.num_experts is not None:
             aux_term = jnp.mean(aux_out).reshape(1)
             if cfg.tensor_axis is not None:
@@ -206,8 +228,19 @@ def build_gpt_3d(
                 # shard_map with a replicated out-spec — average aux over
                 # tp so the replication contract stays honest
                 # (tensor_parallel/partition.py docstring).
-                aux_term = cc.all_reduce(aux_term, tp_axis, "mean")
+                if with_aux:
+                    # ONE tp reduction either way: the aux telemetry rides
+                    # the existing (1,) pmean as extra payload (element 0
+                    # is the same value bitwise — pmean is elementwise).
+                    red = cc.all_reduce(
+                        jnp.concatenate([aux_term, aux_mb]),
+                        tp_axis, "mean")
+                    aux_term, aux_mb = red[:1], red[1:]
+                else:
+                    aux_term = cc.all_reduce(aux_term, tp_axis, "mean")
             ce = ce + moe_aux_coeff * aux_term
+        if with_aux:
+            return jnp.concatenate([ce, aux_mb])
         return ce
 
     def make_loss_fn(param_specs):
@@ -242,7 +275,33 @@ def build_gpt_3d(
 
         return loss_fn
 
-    def make_train_step(opt, param_specs, scaler=None, grad_tap=None):
+    def make_aux_loss_fn(param_specs):
+        """Telemetry variant of :func:`make_loss_fn`: returns
+        ``loss_fn(params, tokens) -> (loss, aux_mb)`` with ``aux_mb``
+        the dp-mean per-microbatch MoE aux vector ``[m]`` (zeros for
+        dense configs), for ``jax.value_and_grad(..., has_aux=True)``.
+
+        Same collective budget as the bare loss: the aux vector rides
+        the existing dp pmean of the ``(1,)`` loss as a widened
+        ``(1+m,)`` payload, and is ``stop_gradient``-cut inside — so the
+        differentiated program (and the grads, bit for bit) is the bare
+        one."""
+        inner = cc.shard_over(
+            lambda p, t: cc.all_reduce(
+                _local_loss(p, t, with_aux=True), dp_axis, "mean"),
+            mesh=mesh,
+            in_specs=(param_specs, P(dp_axis)),
+            out_specs=P(None),
+        )
+
+        def loss_fn(params, tokens):
+            vec = inner(params, tokens)
+            return vec[0], vec[1:]
+
+        return loss_fn
+
+    def make_train_step(opt, param_specs, scaler=None, grad_tap=None,
+                        collect_stats=False):
         """``scaler=None``: the plain step.  With an ``amp`` scaler
         algorithm the unified non-finite sentinel
         (:mod:`apex_tpu.resilience.sentinel`) is threaded through: the
@@ -257,35 +316,82 @@ def build_gpt_3d(
         ``grad_tap`` (sentinel path only): a ``grads -> grads`` hook
         applied between the backward and the sentinel check — the seam
         the fault harness (:mod:`apex_tpu.testing.faults`) uses to
-        inject non-finite gradients inside the compiled step."""
-        loss_fn = make_loss_fn(param_specs)
+        inject non-finite gradients inside the compiled step.
+
+        ``collect_stats`` appends a jit-carried
+        :class:`apex_tpu.observability.PartialTrainStats` as the LAST
+        output (loss, grad/param global-norm partials, non-finite leaf
+        flags, loss scale, sentinel skip count, per-microbatch MoE aux).
+        The params/grads here are SHARDED global arrays, so the norms
+        leave the step as per-device partial sums
+        (``ts.device_partial_norms`` — a shard_map whose output keeps
+        the device axis, hence ZERO extra collectives; the host
+        finalizes the tiny partials matrix at fetch time) and the aux
+        vector rides the existing loss reductions
+        (``make_aux_loss_fn``).  Zero host syncs; params and optimizer
+        state stay bit-identical to the uninstrumented step (pinned by
+        tests/test_observability.py)."""
+        from apex_tpu.observability import trainstats as ts
+
+        loss_fn = (make_aux_loss_fn(param_specs) if collect_stats
+                   else make_loss_fn(param_specs))
+        if collect_stats:
+            partial_norms = ts.device_partial_norms(mesh, param_specs)
 
         if scaler is None:
-            def step(params, state, tokens):
-                loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
-                new_p, new_state = opt.step(grads, state, params)
-                return new_p, new_state, loss
+            if not collect_stats:
+                def step(params, state, tokens):
+                    loss, grads = jax.value_and_grad(loss_fn)(
+                        params, tokens)
+                    new_p, new_state = opt.step(grads, state, params)
+                    return new_p, new_state, loss
 
-            return step
+                return step
+
+            def stats_step(params, state, tokens):
+                (loss, aux_mb), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True)(params, tokens)
+                new_p, new_state = opt.step(grads, state, params)
+                stats = ts.partial_train_stats(
+                    loss, partial_norms(grads, params), moe_aux=aux_mb)
+                return new_p, new_state, loss, stats
+
+            return stats_step
 
         from apex_tpu.resilience.sentinel import sentinel_guarded_apply
 
         def guarded_step(params, state, tokens, sent):
             scale_used = sent.scaler.scale
 
-            def scaled_loss(p, t):
-                return loss_fn(p, t) * scale_used
+            if collect_stats:
+                def scaled_loss(p, t):
+                    loss, aux_mb = loss_fn(p, t)
+                    return loss * scale_used, aux_mb
 
-            loss_s, grads = jax.value_and_grad(scaled_loss)(params, tokens)
+                (loss_s, aux_mb), grads = jax.value_and_grad(
+                    scaled_loss, has_aux=True)(params, tokens)
+            else:
+                def scaled_loss(p, t):
+                    return loss_fn(p, t) * scale_used
+
+                loss_s, grads = jax.value_and_grad(scaled_loss)(
+                    params, tokens)
             if grad_tap is not None:
                 grads = grad_tap(grads)
             # grads here are GLOBAL arrays (the shard_map lives inside
             # loss_fn), so no cross-rank flag agreement is needed:
             # axes=None.
-            new_p, new_state, sent = sentinel_guarded_apply(
+            new_p, new_state, new_sent = sentinel_guarded_apply(
                 scaler, opt, grads, state, params, sent,
                 grad_scale=scale_used)
-            return new_p, new_state, sent, loss_s / scale_used
+            loss = loss_s / scale_used
+            if not collect_stats:
+                return new_p, new_state, new_sent, loss
+            stats = ts.partial_train_stats(
+                loss, partial_norms(grads, params), grad_scale=scale_used,
+                loss_scale=scale_used,
+                skipped_steps=new_sent.skipped_steps, moe_aux=aux_mb)
+            return new_p, new_state, new_sent, loss, stats
 
         return guarded_step
 

@@ -1,0 +1,49 @@
+#!/usr/bin/env bash
+# Telemetry smoke (ISSUE 5 satellite): run the driver dryrun entry with
+# in-graph telemetry armed and a JSONL metrics sink, then assert the
+# output parses and carries the metric-catalog keys
+# (docs/observability.md).  This is the end-to-end proof that the
+# TrainStats device layer, the log_every_n host fetch, the rank-aware
+# MetricRegistry, and the crash-safe JsonlWriter compose on the full 3D
+# mesh — exactly the pipeline a real run logs through.
+#
+# Usage: scripts/telemetry_smoke.sh [N_DEVICES] [OUT_DIR]
+#   N_DEVICES  virtual CPU mesh size for dryrun_multichip (default 8;
+#              the fast-tier test uses 2 to keep the XLA compile small)
+#   OUT_DIR    where metrics.jsonl lands (default: a fresh mktemp dir)
+set -euo pipefail
+
+REPO="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+N_DEVICES="${1:-8}"
+OUT_DIR="${2:-$(mktemp -d /tmp/apex_tpu_telemetry.XXXXXX)}"
+mkdir -p "$OUT_DIR"
+
+echo "telemetry_smoke: dryrun_multichip(${N_DEVICES}) -> ${OUT_DIR}" >&2
+
+cd "$REPO"
+APEX_TPU_TELEMETRY_DIR="$OUT_DIR" python -c \
+  "import __graft_entry__ as g; g.dryrun_multichip(${N_DEVICES})"
+
+python - "$OUT_DIR/metrics.jsonl" <<'EOF'
+import sys
+
+from apex_tpu.observability import read_jsonl
+
+path = sys.argv[1]
+records = read_jsonl(path, strict=True)
+assert records, f"no telemetry records in {path}"
+rec = records[-1]
+# The metric-catalog keys every logged step must carry
+# (docs/observability.md; TrainStatsLogger.log flattens TrainStats into
+# the record and mirrors it under metrics/ as gauges).
+expected = ("loss", "grad_norm", "param_norm", "nonfinite_leaves",
+            "loss_scale", "skipped_steps", "moe_aux", "step_time_ms",
+            "step", "ts", "rank", "metrics")
+missing = [k for k in expected if k not in rec]
+assert not missing, f"telemetry record missing keys {missing}: {rec}"
+assert rec["nonfinite_leaves"] == 0, rec
+assert rec["metrics"]["train/loss"] == rec["loss"], rec
+print(f"telemetry_smoke OK: {len(records)} record(s), "
+      f"loss={rec['loss']:.4f} grad_norm={rec['grad_norm']:.4f} "
+      f"scale={rec['loss_scale']}")
+EOF

@@ -1,0 +1,104 @@
+"""log_util accessor surface (ISSUE 5 satellite).
+
+``set_logging_level`` used to set only the ``apex_tpu`` *logger* level;
+a handler sitting at a higher level kept filtering records the logger —
+or a louder child logger — was configured to emit.  These tests pin the
+fixed contract: the logger level is the one knob, handlers never
+out-filter it, and every record that reaches the stream carries the
+rank stamp.
+"""
+
+import io
+import logging
+
+import apex_tpu  # installs the rank-stamped handler
+from apex_tpu import log_util
+
+
+def _capture_handler():
+    """Swap the library handler's stream for a StringIO we can read."""
+    logger = logging.getLogger("apex_tpu")
+    assert logger.handlers, "apex_tpu import must install a handler"
+    handler = logger.handlers[0]
+    buf = io.StringIO()
+    old_stream = handler.stream
+    handler.stream = buf
+    return logger, handler, buf, old_stream
+
+
+def _restore(handler, old_stream, old_logger_level, old_handler_level):
+    handler.stream = old_stream
+    logging.getLogger("apex_tpu").setLevel(old_logger_level)
+    handler.setLevel(old_handler_level)
+
+
+def test_rank_stamped_formatting():
+    logger, handler, buf, old_stream = _capture_handler()
+    old_levels = (logger.level, handler.level)
+    try:
+        log_util.set_logging_level(logging.INFO)
+        log_util.get_logger().info("hello from the library")
+        out = buf.getvalue()
+        assert "hello from the library" in out
+        # Single-process test run: process 0 of 1 (RankInfoFormatter).
+        assert "[0/1]" in out
+        assert "apex_tpu" in out
+    finally:
+        _restore(handler, old_stream, *old_levels)
+
+
+def test_set_logging_level_propagates_to_handler():
+    """The regression this satellite fixes: a handler level left above
+    the logger level silently filtered everything below it."""
+    logger, handler, buf, old_stream = _capture_handler()
+    old_levels = (logger.level, handler.level)
+    try:
+        # Simulate the broken state: handler stuck at WARNING.
+        handler.setLevel(logging.WARNING)
+        log_util.set_logging_level(logging.DEBUG)
+        log_util.get_logger().debug("debug must now flow")
+        assert "debug must now flow" in buf.getvalue(), (
+            "set_logging_level must lower the handler gate too")
+        assert handler.level <= logging.DEBUG
+    finally:
+        _restore(handler, old_stream, *old_levels)
+
+
+def test_child_logger_louder_than_library_is_not_filtered():
+    """A child set to DEBUG while the library sits at INFO must emit:
+    the handler (the library's single emission point) may not re-filter
+    what the child logger explicitly allowed."""
+    logger, handler, buf, old_stream = _capture_handler()
+    old_levels = (logger.level, handler.level)
+    child = log_util.get_transformer_logger("apex_tpu.transformer.moe")
+    old_child_level = child.level
+    try:
+        handler.setLevel(logging.WARNING)  # stale tighter handler
+        log_util.set_logging_level(logging.INFO)
+        child.setLevel(logging.DEBUG)
+        child.debug("child debug record")
+        assert "child debug record" in buf.getvalue()
+        # And the library level still gates the non-overridden loggers.
+        buf.truncate(0), buf.seek(0)
+        log_util.get_logger().debug("library debug record")
+        assert "library debug record" not in buf.getvalue()
+    finally:
+        child.setLevel(old_child_level)
+        _restore(handler, old_stream, *old_levels)
+
+
+def test_get_transformer_logger_name_normalization():
+    # Filename form: the extension is stripped (reference
+    # ``log_util.py`` passes ``os.path.splitext(name)[0]``).
+    assert log_util.get_transformer_logger(
+        "my_module.py").name == "apex_tpu.my_module"
+    # Reference-parity quirk: splitext treats the last dotted component
+    # of a module path as an extension, so dotted names collapse to
+    # their parent — but never escape the apex_tpu tree.
+    assert log_util.get_transformer_logger(
+        "apex_tpu.my_module").name == "apex_tpu"
+    # A plain library name is not double-prefixed.
+    assert log_util.get_transformer_logger("apex_tpu").name == "apex_tpu"
+    # Children hang off the library root, so they inherit its handler.
+    assert log_util.get_transformer_logger(
+        "my_module.py").parent.name.startswith("apex_tpu")
