@@ -16,6 +16,14 @@ programs the test suite and the driver exercise, each built tiny on the
   × tp=2+sp, Switch-MoE experts on the dp axis).
 - ``overlap``   — the PR 2 ring-decomposed collective matmuls at tp=2:
   ring integrity (APX201) and permutation well-formedness (APX104/202).
+- ``reshard``   — the ISSUE 6 restore-anywhere path: a flat-bucket ZeRO
+  train state is SAVED under dp=4, reshard-restored onto the dp=8 mesh
+  (``resilience.reshard.restore_resharded`` — buffers re-chunked for
+  the new world), and the donated train step is linted over the
+  RESTORED arrays.  The APX204 donation audit is the point: restored
+  leaves arrive via ``make_array_from_callback``, and a layout/
+  committed-ness regression on that path would silently drop the
+  params+state aliasing that keeps ZeRO in its HBM budget.
 
 Builders construct params by *executing only initializers* — the linted
 train/loss/ring programs themselves are traced and lowered, never run.
@@ -205,6 +213,87 @@ def _overlap() -> List[Program]:
         Program(name="overlap/matmul_scatter", fn=ms, args=(x, w),
                 expect_ring=tp, forbid_ops=("reduce-scatter",)),
     ]
+
+
+@_entry("reshard")
+def _reshard() -> List[Program]:
+    """Restored-state train step (ISSUE 6 analyzer satellite): save a
+    flat-bucket ZeRO checkpoint under dp=4, reshard-restore it onto the
+    full dp=8 mesh, and lint the donated train step with the restored
+    arrays as inputs — so a resharded restore cannot silently drop
+    buffer donation (APX204) or the sentinel conditional (APX203)."""
+    import shutil
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from apex_tpu import parallel
+    from apex_tpu.amp.scaler import DynamicLossScale
+    from apex_tpu.contrib.optimizers import DistributedFusedAdam
+    from apex_tpu.parallel import mesh as mesh_lib
+    from apex_tpu.parallel.distributed import (
+        dp_shard_batch,
+        replicate,
+        zero_data_parallel_train_step,
+        zero_init,
+    )
+    from apex_tpu.resilience import (
+        CheckpointManager,
+        reshard,
+        sentinel_init,
+    )
+
+    host_params = {
+        "w": jax.random.normal(jax.random.PRNGKey(0), (13, 7)),
+        "b": jnp.zeros((7,)),
+    }
+    opt = DistributedFusedAdam(lr=1e-2, flat_bucket=True, n_buckets=2)
+    scaler = DynamicLossScale(init_scale=16.0)
+
+    def loss_fn(p, batch):
+        x, y = batch
+        return jnp.mean((x @ p["w"] + p["b"] - y) ** 2)
+
+    def build(mesh):
+        p = replicate(host_params, mesh)
+        pack = {"params": p, "opt": zero_init(opt, p, mesh),
+                "sent": replicate(sentinel_init(scaler), mesh)}
+        spec = reshard.build_spec(pack, mesh=mesh,
+                                  zero_states=[("opt", opt, p)])
+        return pack, spec
+
+    workdir = tempfile.mkdtemp(prefix="apex_reshard_entry_")
+    try:
+        # writer: dp=4 sub-mesh — its flat buckets are 4-way chunked
+        mesh = parallel.initialize_model_parallel(
+            devices=jax.devices("cpu")[:4])
+        pack, spec = build(mesh)
+        mgr = CheckpointManager(workdir, sharded=True, spec=spec)
+        mgr.save(pack, 0)
+        mesh_lib.destroy_model_parallel()
+
+        # reader: the full dp=8 mesh — restore_latest reshards
+        mesh = parallel.initialize_model_parallel()
+        like, spec8 = build(mesh)
+        restored, _ = CheckpointManager(
+            workdir, sharded=True, spec=spec8).restore_latest(like)
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+    step = zero_data_parallel_train_step(
+        loss_fn, opt, mesh=mesh, scaler=scaler, donate=True)
+    x = jax.random.normal(jax.random.PRNGKey(1), (16, 13))
+    y = jax.random.normal(jax.random.PRNGKey(2), (16, 7))
+    batch = dp_shard_batch((x, y), mesh)
+    return [Program(
+        name="reshard/restored_train_step",
+        fn=step,
+        args=(restored["params"], restored["opt"], batch,
+              restored["sent"]),
+        expect_conditional=True,
+        expect_donation=_leaves(restored["params"], restored["opt"]),
+    )]
 
 
 def run_entry(name: str) -> Tuple[Report, int]:

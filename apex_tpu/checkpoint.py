@@ -55,6 +55,33 @@ class CheckpointCorruptError(ValueError):
     it to fall back to the previous intact checkpoint."""
 
 
+# Manifest schema version.  1 = the PR 3 layout (leaves + checksums);
+# 2 adds the optional ``sharding_spec`` logical-state description
+# (``resilience/reshard.py``) consumed by the resharded restore path.
+# Readers accept anything <= MANIFEST_VERSION and treat a NEWER version
+# as corruption-class (an old binary must fall back, not misread).
+MANIFEST_VERSION = 2
+
+
+def _check_manifest_version(manifest: dict, path: str) -> None:
+    ver = manifest.get("version", 1)
+    if not isinstance(ver, int) or ver > MANIFEST_VERSION:
+        raise CheckpointCorruptError(
+            f"{path}: manifest version {ver!r} is newer than this reader "
+            f"supports ({MANIFEST_VERSION}) — upgrade before restoring")
+
+
+def _attach_spec(manifest: dict, spec) -> dict:
+    """Embed a :class:`~apex_tpu.resilience.reshard.ShardingSpec` (or an
+    already-serialized dict) into a manifest."""
+    if spec is None:
+        return manifest
+    manifest = dict(manifest)
+    manifest["sharding_spec"] = (spec if isinstance(spec, dict)
+                                 else spec.to_json())
+    return manifest
+
+
 def _checksum(arr: np.ndarray) -> int:
     """crc32 over a leaf's raw bytes (dtype/shape are checked separately
     via the manifest, so bytes alone pin the value).  Fed through the
@@ -113,7 +140,7 @@ def _snapshot(tree, step, copy_host_leaves=False):
 
     arrays = {f"leaf_{i}": to_host(x) for i, (_, x) in enumerate(flat)}
     manifest = {
-        "version": 1,
+        "version": MANIFEST_VERSION,
         "step": step,
         "leaves": [
             {"path": _path_str(p), "shape": list(arrays[f"leaf_{i}"].shape),
@@ -179,13 +206,17 @@ def _fsync_dir(dirpath: str) -> None:
         os.close(dfd)
 
 
-def save_checkpoint(path: str, tree: Any, step: Optional[int] = None) -> None:
+def save_checkpoint(path: str, tree: Any, step: Optional[int] = None,
+                    spec=None) -> None:
     """Write ``tree`` (any pytree of arrays/scalars) to ``path`` (.npz).
 
     Leaves are fetched to host (works on sharded global arrays — JAX
     assembles the full array; cross-process shards are all-gathered) and
     stored with a manifest of tree paths, shapes, and dtypes for
-    restore-time verification.
+    restore-time verification.  ``spec`` (a
+    :class:`~apex_tpu.resilience.reshard.ShardingSpec`) embeds the
+    logical-state description that lets the checkpoint restore onto a
+    different mesh shape (docs/resilience.md "restore-anywhere").
 
     Multi-host: call from **every** process (the gather is a collective);
     only process 0 writes the file, and a cross-process barrier orders the
@@ -195,6 +226,7 @@ def save_checkpoint(path: str, tree: Any, step: Optional[int] = None) -> None:
     """
     _reraise_pending_failure(path)  # surface dropped async failures too
     arrays, manifest = _snapshot(tree, step)
+    manifest = _attach_spec(manifest, spec)
     multi = jax.process_count() > 1
     if not multi or jax.process_index() == 0:
         _write_npz(path, manifest, arrays)
@@ -206,7 +238,7 @@ def save_checkpoint(path: str, tree: Any, step: Optional[int] = None) -> None:
 
 
 def save_checkpoint_async(path: str, tree: Any,
-                          step: Optional[int] = None):
+                          step: Optional[int] = None, spec=None):
     """Overlapped checkpointing: fetch-to-host happens on the caller's
     thread (device buffers are released as soon as the copies land — the
     next train step can donate/overwrite them safely), while the
@@ -229,6 +261,7 @@ def save_checkpoint_async(path: str, tree: Any,
     _reraise_pending_failure(path)
     # sync D2H (host-numpy leaves copied), then async IO
     arrays, manifest = _snapshot(tree, step, copy_host_leaves=True)
+    manifest = _attach_spec(manifest, spec)
     return _submit_write(path, manifest, arrays, "async checkpoint")
 
 
@@ -371,6 +404,7 @@ def restore_checkpoint(path: str, like: Any):
     """
     with np.load(path, allow_pickle=False) as data:
         manifest = json.loads(str(data["__manifest__"]))
+        _check_manifest_version(manifest, path)
         leaves = [data[f"leaf_{i}"] for i in range(len(manifest["leaves"]))]
 
     like_flat, treedef, _ = _validate_template(manifest, like)
@@ -389,6 +423,7 @@ def _verify_npz(path: str) -> dict:
     try:
         with np.load(path, allow_pickle=False) as data:
             manifest = json.loads(str(data["__manifest__"]))
+            _check_manifest_version(manifest, path)
             sums = manifest.get("checksums")
             keys = [k for k in data.files if k != "__manifest__"]
             for key in keys:
@@ -445,7 +480,8 @@ def _shard_key(index, shape) -> str:
 
 
 def save_checkpoint_sharded(ckpt_dir: str, tree: Any,
-                            step: Optional[int] = None) -> None:
+                            step: Optional[int] = None,
+                            spec=None) -> None:
     """Pod-scale checkpoint: every process writes ONLY its own shards.
 
     The gather-free complement of :func:`save_checkpoint` — nothing ever
@@ -465,6 +501,7 @@ def save_checkpoint_sharded(ckpt_dir: str, tree: Any,
     _reraise_pending_failure(ckpt_dir)  # surface dropped async failures
     _clean_stale_shards(ckpt_dir)
     arrays, manifest, proc = _sharded_snapshot(tree, step)
+    manifest = _attach_spec(manifest, spec)
     _write_npz(os.path.join(ckpt_dir, f"shard_{proc}.npz"),
                manifest, arrays)
     _clear_write_failure(ckpt_dir)  # durable save supersedes old failures
@@ -522,7 +559,7 @@ def _sharded_snapshot(tree, step, copy_host_leaves=False):
         dtype = x.dtype if isinstance(x, jax.Array) else np.asarray(x).dtype
         leaf_meta.append({"path": _path_str(p), "shape": list(shape),
                           "dtype": str(dtype)})
-    manifest = {"version": 1, "step": step, "sharded": True,
+    manifest = {"version": MANIFEST_VERSION, "step": step, "sharded": True,
                 "process_count": jax.process_count(),
                 "leaves": leaf_meta}
     return arrays, manifest, proc
@@ -649,8 +686,8 @@ class ShardedSaveHandle:
 
 
 def save_checkpoint_sharded_async(ckpt_dir: str, tree: Any,
-                                  step: Optional[int] = None
-                                  ) -> ShardedSaveHandle:
+                                  step: Optional[int] = None,
+                                  spec=None) -> ShardedSaveHandle:
     """Overlapped pod-scale checkpoint: the local-shard D2H snapshot runs
     on the caller's thread (buffers may be donated immediately after the
     call), the per-process ``shard_{p}.npz`` write runs in the
@@ -664,6 +701,7 @@ def save_checkpoint_sharded_async(ckpt_dir: str, tree: Any,
     _clean_stale_shards(ckpt_dir)
     arrays, manifest, proc = _sharded_snapshot(
         tree, step, copy_host_leaves=True)
+    manifest = _attach_spec(manifest, spec)
     path = os.path.join(ckpt_dir, f"shard_{proc}.npz")
     return ShardedSaveHandle(
         _submit_write(path, manifest, arrays, "async sharded checkpoint",
@@ -758,6 +796,7 @@ def restore_checkpoint_sharded(ckpt_dir: str, like: Any):
             data = np.load(p, allow_pickle=False)
             files.append(data)
             m = json.loads(str(data["__manifest__"]))
+            _check_manifest_version(m, p)
             if manifest is None:
                 manifest = m
             elif (m.get("step") != manifest.get("step")

@@ -21,6 +21,13 @@ hits:
 - :mod:`.preemption` — :class:`PreemptionGuard`: SIGTERM-driven clean
   shutdown (drain in-flight async saves, final checkpoint, exit 0) — the
   ADLR autoresume idea at the signal layer.
+- :mod:`.reshard` — restore-anywhere: :class:`ShardingSpec` (the
+  logical-state description embedded in every spec-carrying manifest)
+  and :func:`restore_resharded`, mapping a committed checkpoint onto an
+  arbitrary target mesh — ZeRO flat buckets re-chunked, pipeline layer
+  stacks re-factored — so an elastic fleet losing/gaining slices resumes
+  bit-losslessly (the veScale / TorchTitan-DCP logical-state idea,
+  docs/resilience.md "restore-anywhere").
 
 The matching fault-injection harness lives in
 :mod:`apex_tpu.testing.faults`; the failure model and recovery matrix in
@@ -29,6 +36,12 @@ The matching fault-injection harness lives in
 
 from apex_tpu.resilience.manager import CheckpointManager
 from apex_tpu.resilience.preemption import PreemptionGuard
+from apex_tpu.resilience.reshard import (
+    ShardingSpec,
+    build_spec,
+    load_logical,
+    restore_resharded,
+)
 from apex_tpu.resilience.sentinel import (
     SentinelState,
     guarded_optimizer_step,
@@ -40,7 +53,11 @@ __all__ = [
     "CheckpointManager",
     "PreemptionGuard",
     "SentinelState",
+    "ShardingSpec",
+    "build_spec",
     "guarded_optimizer_step",
+    "load_logical",
+    "restore_resharded",
     "sentinel_init",
     "sentinel_update",
 ]

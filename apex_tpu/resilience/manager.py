@@ -50,12 +50,30 @@ _STEP_RE = re.compile(r"^step_(\d{8,})(\.npz)?$")  # :08d grows past 8
 class CheckpointManager:
     """Manage a directory of step-indexed checkpoints.
 
-    ``keep``      — retain at most this many newest checkpoints (older
-                    ones are deleted after a successful save; the save
-                    that just landed is never deleted).
+    ``keep``      — retain at most this many newest **committed**
+                    checkpoints (older ones are deleted after a
+                    successful save).  Only committed steps count toward
+                    the window: an uncommitted step dir (crashed or
+                    in-flight save) never displaces a durable checkpoint
+                    from it, so the last-committed step can never be
+                    retention-deleted while a crash artifact or an
+                    in-flight async save sits above it (ISSUE 6
+                    retention bugfix; pinned by fault-injection tests).
+                    The in-flight async step and any step a
+                    ``restore_latest`` is currently reading are pinned
+                    too.  Uncommitted dirs strictly older than the
+                    newest committed step are dead crash artifacts and
+                    are reaped (a live writer is never older than a
+                    later commit); newer ones are left to their writer.
     ``sharded``   — use the per-process ``save_checkpoint_sharded``
                     layout (one subdirectory per step) instead of the
                     flat single-file layout.
+    ``spec``      — optional :class:`~apex_tpu.resilience.reshard.
+                    ShardingSpec`: embedded into every save's manifest
+                    (the logical-state description that makes the
+                    checkpoint restorable onto a different mesh) and
+                    used as the default target spec for
+                    ``restore_latest``.
     ``retries`` / ``backoff_s`` — transient-I/O policy for SYNC saves
                     (and the snapshot/submission part of async ones): an
                     ``OSError`` is retried up to ``retries`` times with
@@ -72,7 +90,7 @@ class CheckpointManager:
 
     def __init__(self, directory: str, *, keep: int = 3,
                  sharded: bool = False, retries: int = 3,
-                 backoff_s: float = 0.25):
+                 backoff_s: float = 0.25, spec=None):
         if keep < 1:
             raise ValueError(f"keep must be >= 1, got {keep}")
         self.directory = directory
@@ -80,7 +98,9 @@ class CheckpointManager:
         self.sharded = sharded
         self.retries = retries
         self.backoff_s = backoff_s
+        self.spec = spec
         self._inflight = None  # (step, handle) of the pending async save
+        self._pinned: set = set()  # steps a restore is currently reading
         os.makedirs(directory, exist_ok=True)
 
     # -- paths ---------------------------------------------------------
@@ -148,12 +168,13 @@ class CheckpointManager:
         with span("checkpoint/save"):
             if self.sharded:
                 self._with_retries(
-                    lambda: ckpt.save_checkpoint_sharded(path, tree,
-                                                         step=step),
+                    lambda: ckpt.save_checkpoint_sharded(
+                        path, tree, step=step, spec=self.spec),
                     f"sharded save step {step}")
             else:
                 self._with_retries(
-                    lambda: ckpt.save_checkpoint(path, tree, step=step),
+                    lambda: ckpt.save_checkpoint(path, tree, step=step,
+                                                 spec=self.spec),
                     f"save step {step}")
         self._apply_retention()
         return path
@@ -174,12 +195,12 @@ class CheckpointManager:
             if self.sharded:
                 handle = self._with_retries(
                     lambda: ckpt.save_checkpoint_sharded_async(
-                        path, tree, step=step),
+                        path, tree, step=step, spec=self.spec),
                     f"async sharded save step {step}")
             else:
                 handle = self._with_retries(
-                    lambda: ckpt.save_checkpoint_async(path, tree,
-                                                       step=step),
+                    lambda: ckpt.save_checkpoint_async(
+                        path, tree, step=step, spec=self.spec),
                     f"async save step {step}")
         self._inflight = (step, handle)
         return handle
@@ -230,11 +251,56 @@ class CheckpointManager:
         except OSError:
             pass
 
+    def _is_committed(self, step: int) -> bool:
+        """A step is committed when its durable artifact exists: the
+        ``.npz`` file (flat — the atomic rename IS the commit) or the
+        step dir's ``manifest.json`` (sharded — written last, after the
+        shard barrier).  Crashed/in-flight saves fail this check."""
+        path = self._path(step)
+        if not self.sharded:
+            return os.path.exists(path)
+        return os.path.exists(os.path.join(path, "manifest.json"))
+
     def _apply_retention(self) -> None:
-        steps = self.all_steps()
-        for step in steps[:-self.keep]:  # keep >= 1 enforced in __init__
+        """Drop committed checkpoints beyond the ``keep`` newest
+        COMMITTED ones.  Uncommitted step dirs (a crashed or in-flight
+        save) never count against ``keep`` — otherwise two crash
+        artifacts above the last durable save would push it out of the
+        window and retention would delete the only restorable state
+        (the ISSUE 6 retention bug).  The in-flight async step and any
+        step a concurrent ``restore_latest`` is reading are pinned;
+        uncommitted dirs older than the newest committed step are dead
+        artifacts and are reaped."""
+        all_steps = self.all_steps()
+        committed = [s for s in all_steps if self._is_committed(s)]
+        pinned = set(self._pinned)
+        if self._inflight is not None:
+            pinned.add(self._inflight[0])
+        for step in committed[:-self.keep]:  # keep >= 1 (__init__)
+            if step in pinned:
+                logger.info(
+                    "retention: step %d is referenced (in-flight save or "
+                    "active restore), not dropping", step)
+                continue
             logger.info("retention: dropping checkpoint step %d", step)
             self._discard(self._path(step))
+        # Reap DEAD crash artifacts so repeated SIGKILLs cannot grow the
+        # directory without bound: an uncommitted step dir strictly OLDER
+        # than the newest committed step cannot belong to a live writer
+        # (saves are step-monotonic; the in-flight/pinned steps are
+        # exempt anyway) — the same older-than-the-commit rule as
+        # checkpoint._clean_stale_shards.  Uncommitted dirs at or above
+        # the newest committed step are left alone: they may be a writer
+        # still in flight.
+        if committed:
+            for step in all_steps:
+                if (step >= committed[-1] or step in pinned
+                        or self._is_committed(step)):
+                    continue
+                logger.info(
+                    "retention: reaping dead uncommitted artifact "
+                    "step %d", step)
+                self._discard(self._path(step))
 
     # -- restore -------------------------------------------------------
 
@@ -247,7 +313,44 @@ class CheckpointManager:
                 return ckpt.verify_checkpoint_sharded(path)
             return ckpt.verify_checkpoint(path)
 
-    def restore_latest(self, like: Any, *, verify: bool = True):
+    def _template_matches(self, step: int, like: Any) -> bool:
+        """True when the stored leaf shapes equal the template's — the
+        same-mesh case, restored through the plain (lazy) path.  Any
+        read problem returns True so the plain restore raises the real,
+        more informative error."""
+        try:
+            manifest = self._manifest(step)
+            import jax
+            import numpy as np
+
+            like_flat = jax.tree_util.tree_leaves(like)
+            leaves = manifest.get("leaves", [])
+            if len(leaves) != len(like_flat):
+                return True
+            return all(tuple(rec["shape"]) == tuple(np.shape(x))
+                       for rec, x in zip(leaves, like_flat))
+        except Exception:
+            return True
+
+    def _manifest(self, step: int) -> dict:
+        """One step's shard/flat manifest without a checksum pass."""
+        import json
+
+        import numpy as np
+
+        path = self._path(step)
+        if not self.sharded:
+            with np.load(path, allow_pickle=False) as data:
+                return json.loads(str(data["__manifest__"]))
+        shard_paths = ckpt._shard_paths(path)
+        if not shard_paths:
+            raise ckpt.CheckpointCorruptError(
+                f"{path}: no shard files")
+        with np.load(shard_paths[0], allow_pickle=False) as data:
+            return json.loads(str(data["__manifest__"]))
+
+    def restore_latest(self, like: Any, *, verify: bool = True,
+                       spec=None, mesh=None):
         """Restore the newest intact checkpoint into the structure (and
         shardings) of ``like``; returns ``(tree, step)``.
 
@@ -258,35 +361,84 @@ class CheckpointManager:
         rename and manifest commit) recovers automatically.  Raises
         ``FileNotFoundError`` when no intact checkpoint exists.
 
+        **Restore-anywhere**: with a target ``spec`` (a
+        :class:`~apex_tpu.resilience.reshard.ShardingSpec` built over
+        ``like`` for the CURRENT mesh; defaults to the manager's
+        ``spec``) — or a ``mesh`` from which a bare spec is built; the
+        mesh-independent structure markers (flat-bucket group layouts,
+        ``fold``/``ravel_of``) are then inherited from the SOURCE
+        checkpoint's spec, so ZeRO state reshards under a bare spec
+        too — a candidate whose stored shapes disagree with the
+        template is
+        restored through :func:`apex_tpu.resilience.reshard.
+        restore_resharded`: logical leaves are reassembled from the
+        committed shards and re-laid-out for the target dp/tp/pp
+        counts, ZeRO flat buckets re-chunked.  Verification and
+        corrupt-fallback behave identically on both paths.  A candidate
+        written without a sharding spec (pre-reshard manifest) still
+        restores when its shapes match the template; a shape-mismatched
+        spec-less candidate fails (and is fallen back past) with an
+        error naming the missing spec.
+
         The verify pass deliberately reads every array a second time
         (restore reads them again): complete integrity is established
         BEFORE any restore side effects, including for slices a sharded
         restore would lazily skip.  ``verify=False`` trades that for
         one-pass speed when the storage is trusted.
+
+        Observability: the whole attempt runs under a host
+        ``span_ms/checkpoint/restore_latest`` histogram, and the number
+        of candidates skipped as corrupt before success is counted into
+        the ``ckpt/fallback_depth`` metric — both land in the rank-aware
+        default :class:`~apex_tpu.observability.metrics.MetricRegistry`
+        (flushed by rank 0 only, docs/observability.md).
         """
+        if spec is None:
+            spec = self.spec
+        if spec is None and mesh is not None:
+            from apex_tpu.resilience import reshard
+
+            spec = reshard.build_spec(like, mesh=mesh)
         failures = []
-        for step in reversed(self.all_steps()):
-            path = self._path(step)
-            try:
-                if verify:
-                    self.verify(step)
-                with span("checkpoint/restore"):
-                    if self.sharded:
-                        tree, at = ckpt.restore_checkpoint_sharded(
-                            path, like)
-                    else:
-                        tree, at = ckpt.restore_checkpoint(path, like)
-                if failures:
+        with span("checkpoint/restore_latest"):
+            for step in reversed(self.all_steps()):
+                path = self._path(step)
+                self._pinned.add(step)
+                try:
+                    if verify:
+                        self.verify(step)
+                    resharded = (spec is not None
+                                 and not self._template_matches(step, like))
+                    with span("checkpoint/restore"):
+                        if resharded:
+                            from apex_tpu.resilience import reshard
+
+                            tree, at = reshard.restore_resharded(
+                                path, like, spec)
+                        elif self.sharded:
+                            tree, at = ckpt.restore_checkpoint_sharded(
+                                path, like)
+                        else:
+                            tree, at = ckpt.restore_checkpoint(path, like)
+                    if failures:
+                        logger.warning(
+                            "restore_latest fell back to step %d past %s",
+                            step, "; ".join(failures))
+                    from apex_tpu.observability.metrics import (
+                        default_registry,
+                    )
+
+                    default_registry().counter(
+                        "ckpt/fallback_depth").inc(len(failures))
+                    return tree, at
+                except (ckpt.CheckpointCorruptError, ValueError, OSError,
+                        KeyError) as e:
+                    failures.append(f"step {step}: {e!r}")
                     logger.warning(
-                        "restore_latest fell back to step %d past %s",
-                        step, "; ".join(failures))
-                return tree, at
-            except (ckpt.CheckpointCorruptError, ValueError, OSError,
-                    KeyError) as e:
-                failures.append(f"step {step}: {e!r}")
-                logger.warning(
-                    "checkpoint step %d unusable (%r); falling back",
-                    step, e)
+                        "checkpoint step %d unusable (%r); falling back",
+                        step, e)
+                finally:
+                    self._pinned.discard(step)
         raise FileNotFoundError(
             f"no intact checkpoint under {self.directory!r}"
             + (f" (tried: {'; '.join(failures)})" if failures else ""))

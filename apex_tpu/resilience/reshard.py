@@ -1,0 +1,692 @@
+"""Mesh-agnostic checkpoint resharding — restore-anywhere (ISSUE 6).
+
+PR 3's crash-safe checkpoints restore bit-identically, but only onto the
+mesh shape that wrote them: a sharded save records *placed* arrays, and
+three of our state families are placement-DEPENDENT in shape, not just
+in slicing —
+
+- ZeRO flat-bucket buffers (``contrib/optimizers/_flat_bucket.py``):
+  ``(rows, chunk)`` per dtype-group bucket, rows padded to a multiple of
+  ``world * n_buckets`` — a different dp world size is a different
+  *global shape*;
+- ZeRO per-leaf chunked state: rank-major padded ravels, padded to the
+  world size;
+- pipeline layer stacks (``gpt_parallel_train.GPT3DParams.layers``):
+  ``[vpp, pp, ...]`` whose leading dims re-factor when the pipeline
+  depth changes (``tp=2,pp=2`` -> ``tp=4,pp=1`` turns ``[1, 2, ...]``
+  into ``[2, 1, ...]``).
+
+The fix is the veScale / TorchTitan-DCP idea (PAPERS.md,
+arxiv 2509.07003 / 2410.06511): describe state *logically* —
+independent of placement — and reshard on load.  This module owns that
+logical layer:
+
+- :class:`ShardingSpec` / :func:`build_spec` — the JSON-serializable
+  logical description of a checkpointed tree: per-leaf partition axis
+  names, fold counts (leading dims that are a reshape of one logical
+  axis), padded-ravel markers, and the ``chunked_meta`` bucket layout of
+  every ZeRO flat-bucket dtype-group.  The save path embeds it in the
+  manifest next to the crc32 entries (``checkpoint.py``, manifest
+  version 2).
+- :func:`restore_resharded` — map a committed checkpoint (flat file or
+  sharded dir) onto an *arbitrary* target template: leaves whose global
+  shape is unchanged restore through the existing lazy slice-assembly
+  path; shape-changed leaves are assembled to their logical form on host
+  (pure reshape/concat/truncate — **no arithmetic**, so the round trip
+  is fp32-bit-lossless) and re-laid-out for the target mesh, including
+  unflattening and re-chunking flat buckets for a different dp world.
+- :func:`load_logical` — the canonical mesh-independent fingerprint of
+  a checkpoint (every leaf in logical form, on host): what the elastic
+  fault harness (``testing/crash_resume.py`` /
+  ``scripts/elastic_resume_smoke.sh``) compares bitwise across mesh
+  shapes.
+
+``CheckpointManager.restore_latest(like, spec=...)`` dispatches here
+when a candidate's stored shapes disagree with the template, preserving
+verification and corrupt-fallback (``resilience/manager.py``).  The
+failure model and supported transitions are documented in
+``docs/resilience.md`` ("restore-anywhere") and ``docs/checkpoint.md``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from apex_tpu.checkpoint import CheckpointCorruptError
+
+__all__ = [
+    "ShardingSpec",
+    "build_spec",
+    "restore_resharded",
+    "load_logical",
+]
+
+SPEC_VERSION = 1
+
+
+def _spec_error(msg: str) -> CheckpointCorruptError:
+    """Spec problems are corruption-class: ``restore_latest`` must be
+    able to fall back past a checkpoint whose logical description is
+    missing or inconsistent, exactly like a failed checksum."""
+    return CheckpointCorruptError(msg)
+
+
+@dataclasses.dataclass
+class ShardingSpec:
+    """Logical sharding description of one checkpointed tree.
+
+    ``leaves``: checkpoint leaf path -> record with
+        ``axes``      per-dim mesh axis names (``None`` = replicated) —
+                      recorded from the live shardings for audit;
+        ``fold``      N > 0: the leading N dims are a reshape of ONE
+                      logical axis (row-major, so merging them by plain
+                      reshape recovers the logical stack — the
+                      ``[vpp, pp]`` -> ``[L]`` virtual-stage-major map);
+        ``ravel_of``  logical shape whose zero-padded ravel this leaf
+                      stores (ZeRO per-leaf chunked state);
+        ``group`` / ``bucket``  membership of a flat-bucket group.
+    ``groups``: group key -> record with the ordered bucket leaf
+        ``paths``, the ``chunk`` width, ``n_buckets``, and the logical
+        ``shapes`` of the member leaves (``chunked_meta`` layout inputs:
+        concat(buckets) unflattens to exactly these leaves).
+    ``mesh``: axis name -> size at build time (audit/debug only: the
+        restore math needs no source world size — the buffer rows encode
+        it).
+    """
+
+    mesh: Dict[str, int] = dataclasses.field(default_factory=dict)
+    leaves: Dict[str, dict] = dataclasses.field(default_factory=dict)
+    groups: Dict[str, dict] = dataclasses.field(default_factory=dict)
+
+    def to_json(self) -> dict:
+        return {"version": SPEC_VERSION, "mesh": dict(self.mesh),
+                "leaves": self.leaves, "groups": self.groups}
+
+    @classmethod
+    def from_json(cls, doc: Any, *, where: str = "checkpoint"
+                  ) -> "ShardingSpec":
+        if not isinstance(doc, dict):
+            raise _spec_error(
+                f"{where}: sharding_spec is not an object ({type(doc)})")
+        ver = doc.get("version")
+        if ver != SPEC_VERSION:
+            raise _spec_error(
+                f"{where}: sharding_spec.version is {ver!r}, this reader "
+                f"supports {SPEC_VERSION}")
+        for field in ("leaves", "groups"):
+            if not isinstance(doc.get(field), dict):
+                raise _spec_error(
+                    f"{where}: sharding_spec.{field} missing or invalid")
+        return cls(mesh=dict(doc.get("mesh") or {}),
+                   leaves=doc["leaves"], groups=doc["groups"])
+
+    def leaf(self, path: str) -> dict:
+        return self.leaves.get(path) or {}
+
+
+def _leaf_axes(x) -> Optional[List[Optional[List[str]]]]:
+    """Per-dim mesh axis names from a leaf's NamedSharding (None when
+    the leaf is not a committed named-sharded array)."""
+    import jax
+
+    if not isinstance(x, jax.Array):
+        return None
+    spec = getattr(getattr(x, "sharding", None), "spec", None)
+    if spec is None:
+        return None
+    ndim = np.ndim(x)
+    out: List[Optional[List[str]]] = []
+    for d in range(ndim):
+        entry = spec[d] if d < len(spec) else None
+        if entry is None:
+            out.append(None)
+        elif isinstance(entry, (tuple, list)):
+            out.append([str(a) for a in entry])
+        else:
+            out.append([str(entry)])
+    return out
+
+
+def _tree_paths(tree) -> List[Tuple[str, Any]]:
+    import jax
+
+    from apex_tpu.checkpoint import _path_str
+
+    return [(_path_str(p), x)
+            for p, x in jax.tree_util.tree_leaves_with_path(tree)]
+
+
+def build_spec(tree, *, mesh=None, folds=None,
+               zero_states: Sequence[Tuple[str, Any, Any]] = ()
+               ) -> ShardingSpec:
+    """Build the :class:`ShardingSpec` for ``tree`` as it will be saved.
+
+    ``mesh``   — the live :class:`jax.sharding.Mesh` (axis sizes are
+                 recorded for audit).
+    ``folds``  — optional pytree of ints, same structure as ``tree``
+                 (0 = plain leaf): number of leading dims that fold into
+                 one logical axis (see
+                 ``gpt_parallel_train.gpt3d_logical_folds``).
+    ``zero_states`` — ``(path_prefix, optimizer, params)`` triples for
+                 every ZeRO-sharded ``OptState`` inside ``tree`` (e.g.
+                 ``("opt", opt, params)`` when the saved tree is
+                 ``{"opt": state, ...}``): flat-bucket optimizers get
+                 per-dtype-group bucket layouts, per-leaf optimizers get
+                 padded-ravel markers.
+    """
+    import jax
+
+    flat = _tree_paths(tree)
+    paths = [p for p, _ in flat]
+    leaves: Dict[str, dict] = {}
+
+    fold_by_path: Dict[str, int] = {}
+    if folds is not None:
+        fflat = jax.tree_util.tree_leaves(folds)
+        if len(fflat) != len(flat):
+            raise ValueError(
+                f"folds tree has {len(fflat)} leaves, tree has "
+                f"{len(flat)} — structures must match")
+        fold_by_path = {p: int(f) for (p, _), f in zip(flat, fflat) if f}
+
+    for path, x in flat:
+        rec: dict = {}
+        axes = _leaf_axes(x)
+        if axes is not None:
+            rec["axes"] = axes
+        fold = fold_by_path.get(path, 0)
+        if fold:
+            shape = tuple(np.shape(x))
+            if fold >= len(shape) + 1:
+                raise ValueError(
+                    f"{path}: fold={fold} exceeds rank {len(shape)}")
+            rec["fold"] = fold
+        if rec:
+            leaves[path] = rec
+
+    groups: Dict[str, dict] = {}
+    for prefix, opt, params in zero_states:
+        _add_zero_state(leaves, groups, paths, prefix, opt, params)
+
+    mesh_sizes = dict(mesh.shape) if mesh is not None else {}
+    return ShardingSpec(mesh=mesh_sizes, leaves=leaves, groups=groups)
+
+
+def _add_zero_state(leaves, groups, paths, prefix, opt, params) -> None:
+    """Annotate one ZeRO ``OptState``'s leaves under ``prefix``."""
+    from apex_tpu.checkpoint import _path_str  # noqa: F401  (doc link)
+    import jax
+
+    param_flat = _tree_paths(params)
+    if getattr(opt, "flat_bucket", False):
+        from apex_tpu.contrib.optimizers import _flat_bucket as fbk
+
+        _, leaves_list, raw_groups = fbk.host_groups(params)
+        n_buckets = int(opt.n_buckets)
+        chunk = int(opt.chunk)
+        for slot in _zero_slot_names(paths, prefix):
+            for g, (_, idx) in enumerate(raw_groups):
+                key = f"{prefix}/{slot}/{g}"
+                bucket_paths = [
+                    f"{prefix}/.{slot_path(slot)}/{g}/{k}"
+                    for k in range(n_buckets)
+                ]
+                missing = [p for p in bucket_paths if p not in paths]
+                if missing:
+                    raise ValueError(
+                        f"zero_states[{prefix!r}]: expected bucket leaves "
+                        f"{missing} not found in the saved tree — is the "
+                        "OptState stored under a different key?")
+                groups[key] = {
+                    "paths": bucket_paths,
+                    "chunk": chunk,
+                    "n_buckets": n_buckets,
+                    "shapes": [list(np.shape(leaves_list[i])) for i in idx],
+                }
+                for k, p in enumerate(bucket_paths):
+                    rec = leaves.setdefault(p, {})
+                    rec["group"] = key
+                    rec["bucket"] = k
+    else:
+        # per-leaf layout: every slot/master leaf is the zero-padded
+        # rank-major ravel of the same-suffixed param leaf
+        by_suffix = {p: tuple(np.shape(x)) for p, x in param_flat}
+        for path in paths:
+            suffix = _zero_leaf_suffix(path, prefix)
+            if suffix is None or suffix not in by_suffix:
+                continue
+            leaves.setdefault(path, {})["ravel_of"] = \
+                list(by_suffix[suffix])
+
+
+def slot_path(slot: str) -> str:
+    """Tree-path component of one state family: ``slots/<name>`` leaves
+    live under ``.slots/<name>``, the master copy under ``.master``."""
+    return "master" if slot == "master" else f"slots/{slot}"
+
+
+def _zero_slot_names(paths, prefix) -> List[str]:
+    """Slot names present in the saved tree (plus ``master`` when the
+    optimizer keeps a master copy)."""
+    names = []
+    slots_prefix = f"{prefix}/.slots/"
+    for p in paths:
+        if p.startswith(slots_prefix):
+            name = p[len(slots_prefix):].split("/", 1)[0]
+            if name not in names:
+                names.append(name)
+    if any(p.startswith(f"{prefix}/.master/") for p in paths):
+        names.append("master")
+    return names
+
+
+def _zero_leaf_suffix(path, prefix) -> Optional[str]:
+    slots_prefix = f"{prefix}/.slots/"
+    if path.startswith(slots_prefix):
+        rest = path[len(slots_prefix):]
+        parts = rest.split("/", 1)
+        return parts[1] if len(parts) == 2 else None
+    master_prefix = f"{prefix}/.master/"
+    if path.startswith(master_prefix):
+        return path[len(master_prefix):]
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Source-side: committed checkpoint -> full/logical host arrays
+# ---------------------------------------------------------------------------
+
+
+class _Source:
+    """Read-side view of a committed checkpoint (flat ``.npz`` file or
+    sharded dir): manifest + ``full(i)`` assembling leaf ``i``'s whole
+    global value on host.  Keeps the npz handles open (lazy decompress)
+    until ``close``."""
+
+    def __init__(self, path: str):
+        from apex_tpu import checkpoint as ckpt
+
+        self._files = []
+        self._shards: Dict[str, Any] = {}
+        self._flat = None
+        if os.path.isdir(path):
+            shard_paths = ckpt._shard_paths(path)
+            if not shard_paths:
+                raise FileNotFoundError(f"no shard_*.npz under {path!r}")
+            manifest = None
+            for p in shard_paths:
+                data = np.load(p, allow_pickle=False)
+                self._files.append(data)
+                m = json.loads(str(data["__manifest__"]))
+                ckpt._check_manifest_version(m, p)
+                if manifest is None:
+                    manifest = m
+                elif (m.get("step") != manifest.get("step")
+                      or m.get("process_count")
+                      != manifest.get("process_count")):
+                    # same torn/mixed-checkpoint guard as the plain
+                    # sharded restore: without it a legacy (manifest-
+                    # less) dir holding shards of two different steps
+                    # would silently assemble a chimera state
+                    raise CheckpointCorruptError(
+                        f"inconsistent shard files under {path!r}: "
+                        f"{os.path.basename(p)} has step={m.get('step')} "
+                        f"process_count={m.get('process_count')} vs "
+                        f"step={manifest.get('step')} process_count="
+                        f"{manifest.get('process_count')} — torn or "
+                        "mixed checkpoint")
+                for key in data.files:
+                    if key != "__manifest__":
+                        self._shards[key] = data
+        else:
+            data = np.load(path, allow_pickle=False)
+            self._files.append(data)
+            manifest = json.loads(str(data["__manifest__"]))
+            ckpt._check_manifest_version(manifest, path)
+            self._flat = data
+        self.path = path
+        self.manifest = manifest
+        self.leaves = manifest["leaves"]
+
+    def spec(self) -> ShardingSpec:
+        doc = self.manifest.get("sharding_spec")
+        if doc is None:
+            raise _spec_error(
+                f"{self.path}: manifest (version "
+                f"{self.manifest.get('version', 1)}) has no sharding_spec "
+                "— it predates the logical-spec layer, so it can only be "
+                "restored onto the mesh shape that wrote it (use the "
+                "plain restore path / a matching template)")
+        return ShardingSpec.from_json(doc, where=self.path)
+
+    def full(self, i: int) -> np.ndarray:
+        """Leaf ``i``'s complete global value as a host array."""
+        from apex_tpu import checkpoint as ckpt
+
+        shape = tuple(self.leaves[i]["shape"])
+        if self._flat is not None:
+            return np.asarray(self._flat[f"leaf_{i}"])
+        key_full = f"leaf_{i}|full"
+        if key_full in self._shards:
+            return np.asarray(self._shards[key_full][key_full])
+        index = tuple(slice(0, d) for d in shape)
+        return np.asarray(
+            ckpt._assemble_slice(self._shards, i, index, shape))
+
+    def close(self) -> None:
+        for f in self._files:
+            f.close()
+        self._files, self._shards, self._flat = [], {}, None
+
+    def __enter__(self) -> "_Source":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def _chunk_rows(size: int, chunk: int) -> int:
+    """Rows one leaf occupies in a ``(rows, chunk)`` buffer — matches
+    ``utils.tree.chunked_meta`` (zero-size leaves occupy zero rows)."""
+    return -(-size // chunk)
+
+
+def _unflatten_np(buffer: np.ndarray, shapes, chunk: int
+                  ) -> List[np.ndarray]:
+    """Host-side inverse of ``utils.tree.flatten_to_chunked``: slice each
+    logical leaf's rows back out of the ``(rows, chunk)`` buffer (pure
+    indexing — bit-exact)."""
+    flat = np.ascontiguousarray(buffer).reshape(-1)
+    out, row = [], 0
+    for shape in shapes:
+        size = int(np.prod(shape)) if shape else 1
+        rows = _chunk_rows(size, chunk)
+        start = row * chunk
+        if start + size > flat.size:
+            raise _spec_error(
+                "flat-bucket buffer too small for its sharding_spec "
+                f"shapes (need {start + size} elements, buffer has "
+                f"{flat.size})")
+        out.append(flat[start:start + size].reshape(shape))
+        row += rows
+    return out
+
+
+def _flatten_np(leaves, chunk: int, rows_total: int, dtype) -> np.ndarray:
+    """Host-side ``flatten_to_chunked``: pack logical leaves into a
+    ``(rows_total, chunk)`` zero-padded buffer (pure indexing)."""
+    flat = np.zeros((rows_total * chunk,), dtype=dtype)
+    row = 0
+    for leaf in leaves:
+        size = int(leaf.size)
+        rows = _chunk_rows(size, chunk)
+        start = row * chunk
+        if start + size > flat.size:
+            raise _spec_error(
+                "target flat-bucket layout too small for the logical "
+                f"leaves (need {start + size} elements, buffer has "
+                f"{flat.size} = {rows_total} x {chunk} rows)")
+        flat[start:start + size] = np.ascontiguousarray(leaf).reshape(-1)
+        row += rows
+    return flat.reshape(rows_total, chunk)
+
+
+def _leaf_logical(full: np.ndarray, rec: dict, path: str) -> np.ndarray:
+    """Apply a leaf's inverse transform: stored full value -> logical."""
+    fold = int(rec.get("fold", 0) or 0)
+    ravel_of = rec.get("ravel_of")
+    if fold:
+        shape = full.shape
+        if fold > len(shape):
+            raise _spec_error(
+                f"{path}: sharding_spec fold={fold} exceeds stored rank "
+                f"{len(shape)}")
+        return full.reshape((-1,) + tuple(shape[fold:]))
+    if ravel_of is not None:
+        target = tuple(int(d) for d in ravel_of)
+        n = int(np.prod(target)) if target else 1
+        flat = full.reshape(-1)
+        if flat.size < n:
+            raise _spec_error(
+                f"{path}: stored padded ravel has {flat.size} elements, "
+                f"sharding_spec.ravel_of {list(target)} needs {n}")
+        return flat[:n].reshape(target)
+    return full
+
+
+def _leaf_placed(logical: np.ndarray, rec: dict, target_shape, path: str
+                 ) -> np.ndarray:
+    """Apply a leaf's forward transform: logical -> target layout."""
+    target_shape = tuple(int(d) for d in target_shape)
+    fold = int(rec.get("fold", 0) or 0)
+    ravel_of = rec.get("ravel_of")
+    if ravel_of is not None:
+        n = int(np.prod(target_shape)) if target_shape else 1
+        flat = np.ascontiguousarray(logical).reshape(-1)
+        if flat.size > n:
+            raise _spec_error(
+                f"{path}: logical leaf has {flat.size} elements, target "
+                f"padded ravel {list(target_shape)} holds only {n}")
+        out = np.zeros((n,), dtype=logical.dtype)
+        out[:flat.size] = flat
+        return out.reshape(target_shape)
+    if logical.size != int(np.prod(target_shape) if target_shape else 1):
+        raise _spec_error(
+            f"{path}: logical element count {logical.size} does not "
+            f"match target shape {list(target_shape)}"
+            + (f" (fold={fold})" if fold else ""))
+    return logical.reshape(target_shape)
+
+
+# ---------------------------------------------------------------------------
+# The restore-anywhere entry points
+# ---------------------------------------------------------------------------
+
+
+def load_logical(path: str) -> Tuple[Dict[str, np.ndarray], Optional[int]]:
+    """Canonical mesh-independent view of a committed checkpoint: every
+    leaf assembled on host and mapped to its logical form.  Flat-bucket
+    groups expand to their member leaves (keyed ``<group>[<j>]``); the
+    bucket leaves themselves are omitted.  Returns ``(leaves, step)``.
+
+    This is the fingerprint the elastic fault harness compares bitwise
+    across mesh shapes: two checkpoints of the same training state saved
+    under different dp/tp/pp layouts must load_logical identically.
+    Spec-less (pre-reshard) checkpoints load as plain full leaves.
+    """
+    with _Source(path) as src:
+        # Only a truly ABSENT spec falls back to the plain-leaf view; a
+        # malformed or newer-version spec must propagate (fingerprinting
+        # placed buffers instead would blame "state divergence" on what
+        # is actually a corrupt spec).
+        doc = src.manifest.get("sharding_spec")
+        spec = (ShardingSpec() if doc is None
+                else ShardingSpec.from_json(doc, where=src.path))
+        index = {rec["path"]: i for i, rec in enumerate(src.leaves)}
+        out: Dict[str, np.ndarray] = {}
+        done_groups = set()
+        for i, rec in enumerate(src.leaves):
+            p = rec["path"]
+            lrec = spec.leaf(p)
+            key = lrec.get("group")
+            if key is not None:
+                if key in done_groups:
+                    continue
+                done_groups.add(key)
+                for j, leaf in enumerate(
+                        _group_logical(src, spec, key, index)):
+                    out[f"{key}[{j}]"] = leaf
+                continue
+            out[p] = _leaf_logical(src.full(i), lrec, p)
+        return out, src.manifest.get("step")
+
+
+def _group_logical(src: "_Source", spec: ShardingSpec, key: str,
+                   index: Dict[str, int]) -> List[np.ndarray]:
+    """Assemble one flat-bucket group's logical leaves from its stored
+    bucket buffers (concat rows, then positional unflatten)."""
+    grp = spec.groups.get(key)
+    if grp is None:
+        raise _spec_error(
+            f"{src.path}: leaf references sharding_spec group {key!r} "
+            "which is not in sharding_spec.groups")
+    for field in ("paths", "chunk", "shapes"):
+        if field not in grp:
+            raise _spec_error(
+                f"{src.path}: sharding_spec.groups[{key!r}] missing "
+                f"{field!r}")
+    bufs = []
+    for p in grp["paths"]:
+        if p not in index:
+            raise _spec_error(
+                f"{src.path}: sharding_spec.groups[{key!r}] references "
+                f"leaf {p!r} absent from the manifest")
+        bufs.append(src.full(index[p]))
+    buffer = np.concatenate(bufs, axis=0) if len(bufs) > 1 else bufs[0]
+    shapes = [tuple(int(d) for d in s) for s in grp["shapes"]]
+    return _unflatten_np(buffer, shapes, int(grp["chunk"]))
+
+
+def restore_resharded(path: str, like: Any, spec: ShardingSpec):
+    """Restore a committed checkpoint onto an **arbitrary** target mesh.
+
+    ``like`` supplies the target structure, shapes, dtypes, and
+    shardings (as for the plain restores); ``spec`` is the TARGET's
+    logical spec (:func:`build_spec` over ``like`` with the target mesh
+    and the same folds / ``zero_states``).  The source's spec is read
+    from the manifest; leaves are matched by tree path, groups by key.
+    Returns ``(tree, step)``.
+
+    Shape-preserved leaves restore through lazy per-shard slice assembly
+    (no full materialization); shape-changed leaves go through the
+    logical form on host.  Every transform is a reshape/concat/pad/
+    truncate — no arithmetic — so restored values are bit-identical to
+    the saved logical state.
+    """
+    import jax
+
+    from apex_tpu import checkpoint as ckpt
+
+    with _Source(path) as src:
+        src_spec = src.spec()
+        like_flat = _tree_paths(like)
+        _, treedef = jax.tree_util.tree_flatten(like)
+        if len(like_flat) != len(src.leaves):
+            raise _spec_error(
+                f"{path}: checkpoint has {len(src.leaves)} leaves, "
+                f"template has {len(like_flat)}")
+        index = {rec["path"]: i for i, rec in enumerate(src.leaves)}
+
+        # Materialize every target flat-bucket group once: logical
+        # leaves from the source layout, re-chunked into the target's.
+        # Group layout (paths/chunk/n_buckets/logical shapes) is
+        # mesh-INDEPENDENT — every target-dependent size comes from the
+        # template's leaf shapes — so where the target spec lacks a
+        # group record (a bare spec from ``restore_latest(mesh=...)``)
+        # the source's is authoritative; an optimizer-config mismatch
+        # (different chunk/n_buckets) fails loudly on the template's
+        # leaf paths/shapes below.
+        tgt_groups = dict(src_spec.groups)
+        tgt_groups.update(spec.groups)
+        group_out: Dict[str, np.ndarray] = {}
+        for key, tgt in tgt_groups.items():
+            logical = _group_logical(src, src_spec, key, index)
+            shapes = [tuple(int(d) for d in s) for s in tgt["shapes"]]
+            if [tuple(l.shape) for l in logical] != shapes:
+                raise _spec_error(
+                    f"{path}: group {key!r} logical shapes "
+                    f"{[list(l.shape) for l in logical]} do not match "
+                    f"the target sharding_spec shapes "
+                    f"{[list(s) for s in shapes]}")
+            by_path = {p: x for p, x in like_flat}
+            tgt_rows = []
+            for p in tgt["paths"]:
+                if p not in by_path:
+                    raise _spec_error(
+                        f"target sharding_spec group {key!r} references "
+                        f"template leaf {p!r} absent from the template")
+                tgt_rows.append(int(np.shape(by_path[p])[0]))
+            chunk_t = int(tgt["chunk"])
+            buffer = _flatten_np(logical, chunk_t, sum(tgt_rows),
+                                 logical[0].dtype if logical
+                                 else np.float32)
+            off = 0
+            for p, rows in zip(tgt["paths"], tgt_rows):
+                group_out[p] = buffer[off:off + rows]
+                off += rows
+
+        out = []
+        for i, ((tpath, tleaf), rec) in enumerate(
+                zip(like_flat, src.leaves)):
+            if rec["path"] != tpath:
+                raise _spec_error(
+                    f"{path}: leaf {i} path mismatch: checkpoint "
+                    f"{rec['path']!r} vs template {tpath!r}")
+            src_shape = tuple(rec["shape"])
+            tgt_shape = tuple(np.shape(tleaf))
+            dtype = ckpt._template_dtype(tleaf)
+            src_rec = src_spec.leaf(tpath)
+            # fold / ravel_of are mesh-independent structure markers, so
+            # a bare target spec inherits them from the source — the
+            # target SHAPE always comes from the template
+            tgt_rec = spec.leaf(tpath) or src_rec
+
+            if tpath in group_out:
+                host = np.asarray(group_out[tpath], dtype=dtype)
+            elif src_shape == tgt_shape:
+                # layout-preserved: lazy per-shard assembly, or for a
+                # flat source simply the stored array
+                host = None
+                if src._flat is None and isinstance(tleaf, jax.Array) \
+                        and getattr(tleaf, "sharding", None) is not None:
+                    out.append(_lazy_shard_leaf(src, i, tgt_shape, dtype,
+                                                tleaf.sharding))
+                    continue
+                host = np.asarray(src.full(i), dtype=dtype)
+            else:
+                if src_rec.get("group") is not None:
+                    raise _spec_error(
+                        f"{path}: {tpath} belongs to source group "
+                        f"{src_rec['group']!r} but the target spec maps "
+                        "it to no group — flat-bucket state cannot "
+                        "restore into a non-bucketed layout here (use "
+                        "checkpoint.gather_zero_state's portable form)")
+                logical = _leaf_logical(src.full(i), src_rec, tpath)
+                host = np.asarray(
+                    _leaf_placed(logical, tgt_rec, tgt_shape, tpath),
+                    dtype=dtype)
+
+            if isinstance(tleaf, jax.Array):
+                out.append(jax.make_array_from_callback(
+                    tgt_shape, tleaf.sharding,
+                    lambda idx, h=host: h[idx]))
+            else:
+                out.append(host)
+        return (jax.tree_util.tree_unflatten(treedef, out),
+                src.manifest.get("step"))
+
+
+def _lazy_shard_leaf(src: "_Source", i: int, shape, dtype, sharding):
+    """Shape-preserved sharded leaf: materialize only the slices the
+    target sharding asks for (the existing restore_checkpoint_sharded
+    behavior, kept for the common leaves so resharding a huge model
+    never assembles its unsharded tensors)."""
+    import jax
+
+    from apex_tpu import checkpoint as ckpt
+
+    def cb(index):
+        key = f"leaf_{i}|{ckpt._shard_key(index, shape)}"
+        got = src._shards.get(key)
+        if got is not None:
+            return np.asarray(got[key], dtype=dtype)
+        return np.asarray(
+            ckpt._assemble_slice(src._shards, i, index, shape),
+            dtype=dtype)
+
+    return jax.make_array_from_callback(tuple(shape), sharding, cb)

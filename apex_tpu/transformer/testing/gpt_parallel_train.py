@@ -46,13 +46,42 @@ from apex_tpu.transformer.testing.standalone_transformer_lm import (
     parallel_lm_logits,
 )
 
-__all__ = ["GPT3DParams", "build_gpt_3d"]
+__all__ = ["GPT3DParams", "build_gpt_3d", "gpt3d_logical_folds"]
 
 
 class GPT3DParams(NamedTuple):
     embedding: dict
     layers: dict      # stacked [vpp, pp, ...]
     final_ln: dict
+
+
+def gpt3d_logical_folds(tree):
+    """Fold-count pytree for :func:`apex_tpu.resilience.reshard.
+    build_spec`: same structure as ``tree``, ``2`` on every leaf of a
+    :class:`GPT3DParams` ``layers`` stack, ``0`` elsewhere.
+
+    The layer stack is ``[vpp, pp, ...]`` — a plain reshape of the
+    virtual-stage-major ``[L, ...]`` logical stack (chunk ``c`` of stage
+    ``s`` is virtual stage ``c*pp + s``, so row-major merge/split IS the
+    interleaved schedule's chunk mapping).  Annotating the two leading
+    dims as one folded logical axis lets a checkpoint written at
+    ``(vpp, pp) = (1, 2)`` restore onto ``(2, 1)`` — the tp/pp
+    elastic-resume transition — by merging to ``[L]`` and re-splitting.
+    Works on any pytree *containing* GPT3DParams nodes (the packed
+    train state: params, a mirroring ``OptState``, sentinel state).
+    """
+    def mark(node):
+        if isinstance(node, GPT3DParams):
+            def const(sub, v):
+                return jax.tree_util.tree_map(lambda _: v, sub)
+
+            return GPT3DParams(embedding=const(node.embedding, 0),
+                               layers=const(node.layers, 2),
+                               final_ln=const(node.final_ln, 0))
+        return 0
+
+    return jax.tree_util.tree_map(
+        mark, tree, is_leaf=lambda x: isinstance(x, GPT3DParams))
 
 
 def _prepend(spec_tree, *dims):

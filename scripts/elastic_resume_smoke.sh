@@ -1,0 +1,167 @@
+#!/usr/bin/env bash
+# Elastic resume smoke (ISSUE 6): kill the trainer at mesh shape N,
+# resume it at mesh shape M, end to end through the crash_resume trainer
+# (apex_tpu.testing.crash_resume) and the restore-anywhere path
+# (apex_tpu.resilience.reshard — docs/resilience.md).
+#
+#   1. an uninterrupted N-step run on the SOURCE mesh records its loss
+#      curve, keeping every checkpoint;
+#   2. a second SOURCE-mesh run is SIGKILLed mid-async-sharded-save
+#      (after >= KILL_AFTER checkpoints landed);
+#   3. the killed run is resumed on the TARGET mesh: restore_latest
+#      reshards the newest intact checkpoint (layer stacks re-factored,
+#      ZeRO flat buckets re-chunked) and the run continues to the end.
+#      The pre-kill prefix of its loss curve must equal the
+#      uninterrupted reference's BIT-EXACTLY (losses are raw fp32 bits);
+#   4. a clean-reshard reference: the SAME step's checkpoint from the
+#      UNINTERRUPTED run (no kill, no torn files) is resumed on the
+#      target mesh.  The killed run's post-resume curve must equal this
+#      clean continuation bit-exactly;
+#   5. both target-mesh runs write the canonical mesh-independent state
+#      digest of their final checkpoint
+#      (reshard.load_logical — per-leaf sha256 of the logical bytes);
+#      the digests must be identical: fp32-bit-consistent parameters
+#      and optimizer state through SIGKILL + reshard.
+#
+# Step arithmetic re-associates across a mesh change (dp reduction
+# widths, tp matmul splits), so a single-mesh curve cannot be the
+# post-resume reference — the clean N->M continuation is, and the PR 3
+# smoke (crash_resume_smoke.sh) separately pins clean-resume ==
+# uninterrupted on a fixed mesh.  Together: kill + reshard == clean
+# reshard == uninterrupted, bit for bit.
+#
+# Usage: scripts/elastic_resume_smoke.sh [workdir]
+# Env: MODE (gpt|zero, default gpt), SRC_ARGS / DST_ARGS (mesh flags,
+#      default "--devices 4" -> "--devices 2": save at dp=4, resume at
+#      dp=2), STEPS (default 6), KILL_AFTER (default 2), GLOBAL_BATCH
+#      (default 8 — fixed so the input stream is mesh-independent),
+#      PYTHON (default python).
+# Examples:
+#   scripts/elastic_resume_smoke.sh                      # gpt dp 4 -> 2
+#   SRC_ARGS="--devices 2" DST_ARGS="--devices 4" \
+#     scripts/elastic_resume_smoke.sh                    # gpt dp 2 -> 4
+#   SRC_ARGS="--tp 2 --pp 2 --devices 4" \
+#     DST_ARGS="--tp 4 --pp 1 --devices 4" \
+#     scripts/elastic_resume_smoke.sh                    # tp/pp refactor
+#   MODE=zero SRC_ARGS="--devices 4" DST_ARGS="--devices 2" \
+#     scripts/elastic_resume_smoke.sh                    # ZeRO flat bucket
+# Exit 0 = bit-exact elastic resume; non-zero otherwise.
+set -u -o pipefail
+
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+WORK="${1:-$(mktemp -d)}"
+MODE="${MODE:-gpt}"
+SRC_ARGS="${SRC_ARGS:---devices 4}"
+DST_ARGS="${DST_ARGS:---devices 2}"
+STEPS="${STEPS:-6}"
+KILL_AFTER="${KILL_AFTER:-2}"
+GLOBAL_BATCH="${GLOBAL_BATCH:-8}"
+PYTHON="${PYTHON:-python}"
+mkdir -p "$WORK"
+cd "$REPO"
+
+COMMON=(--steps "$STEPS" --global-batch "$GLOBAL_BATCH")
+if [ "$MODE" = "zero" ]; then COMMON+=(--zero); fi
+
+echo "elastic_resume_smoke: [1/5] uninterrupted reference on source" \
+     "mesh ($SRC_ARGS)" >&2
+rm -f "$WORK/losses_ref.txt"
+# keep every checkpoint: leg 4 needs the same step the kill resumes from
+# shellcheck disable=SC2086
+"$PYTHON" -m apex_tpu.testing.crash_resume \
+  --ckpt-dir "$WORK/ckpt_ref" --losses "$WORK/losses_ref.txt" \
+  --keep "$STEPS" "${COMMON[@]}" $SRC_ARGS || exit 1
+[ "$(wc -l < "$WORK/losses_ref.txt")" -eq "$STEPS" ] || {
+  echo "reference run logged wrong number of steps" >&2; exit 1; }
+
+echo "elastic_resume_smoke: [2/5] interrupted run (SIGKILL mid-save," \
+     "source mesh)" >&2
+rm -rf "$WORK/ckpt_crash"; rm -f "$WORK/losses_crash.txt"
+# background the python DIRECTLY (no function/subshell wrapper): $! must
+# be the trainer's own PID or the SIGKILL hits a wrapper and the trainer
+# survives to completion, making the resume vacuous.  --step-delay
+# throttles ONLY this run (cache is warm from leg 1) so the kill window
+# is deterministic.
+# shellcheck disable=SC2086
+"$PYTHON" -m apex_tpu.testing.crash_resume \
+  --ckpt-dir "$WORK/ckpt_crash" --losses "$WORK/losses_crash.txt" \
+  "${COMMON[@]}" $SRC_ARGS --step-delay 0.6 &
+PID=$!
+# KILL_WAIT_S bounds how long we poll for the kill point — generous,
+# because the model-parallel legs (tp/pp > 1) recompile a larger program
+# and a loaded CI host can take minutes to log the first loss line.
+n=0
+for _ in $(seq 1 "$((${KILL_WAIT_S:-420} * 10))"); do
+  n=0
+  [ -f "$WORK/losses_crash.txt" ] && n=$(wc -l < "$WORK/losses_crash.txt")
+  if [ "$n" -ge "$KILL_AFTER" ]; then break; fi
+  if ! kill -0 "$PID" 2>/dev/null; then
+    echo "trainer exited before the kill point" >&2; wait "$PID"; exit 1
+  fi
+  sleep 0.1
+done
+[ "$n" -ge "$KILL_AFTER" ] || {
+  kill -9 "$PID" 2>/dev/null; wait "$PID" 2>/dev/null
+  echo "trainer never reached the kill point ($n/$KILL_AFTER steps in" \
+       "${KILL_WAIT_S:-420}s) — raise KILL_WAIT_S" >&2; exit 1; }
+kill -9 "$PID" 2>/dev/null
+wait "$PID" 2>/dev/null
+KILLED_AT=$(wc -l < "$WORK/losses_crash.txt")
+echo "elastic_resume_smoke: killed after $KILLED_AT steps" >&2
+[ "$KILLED_AT" -lt "$STEPS" ] || {
+  echo "trainer completed before SIGKILL landed — raise STEPS" >&2; exit 1; }
+
+echo "elastic_resume_smoke: [3/5] resume on target mesh ($DST_ARGS)" >&2
+# shellcheck disable=SC2086
+"$PYTHON" -m apex_tpu.testing.crash_resume \
+  --ckpt-dir "$WORK/ckpt_crash" --losses "$WORK/losses_crash.txt" \
+  "${COMMON[@]}" $DST_ARGS --resume \
+  --fingerprint "$WORK/fp_elastic.txt" 2> "$WORK/resume.log" || {
+    cat "$WORK/resume.log" >&2; exit 1; }
+cat "$WORK/resume.log" >&2
+R=$(sed -n 's/.*resumed from step \([0-9]*\).*/\1/p' "$WORK/resume.log")
+[ -n "$R" ] || { echo "resume leg never restored a checkpoint" >&2; exit 1; }
+# pre-kill prefix: source-mesh steps must match the uninterrupted
+# source-mesh reference bit-exactly (0..R survived the kill + truncate)
+if ! cmp -s <(head -n "$((R + 1))" "$WORK/losses_ref.txt") \
+            <(head -n "$((R + 1))" "$WORK/losses_crash.txt"); then
+  echo "elastic_resume_smoke: FAIL — pre-kill loss prefix differs:" >&2
+  diff <(head -n "$((R + 1))" "$WORK/losses_ref.txt") \
+       <(head -n "$((R + 1))" "$WORK/losses_crash.txt") >&2 || true
+  exit 1
+fi
+
+echo "elastic_resume_smoke: [4/5] clean-reshard reference (step $R," \
+     "no kill) on target mesh" >&2
+STEP_DIR=$(printf 'step_%08d' "$R")
+rm -rf "$WORK/ckpt_clean"; mkdir -p "$WORK/ckpt_clean"
+cp -r "$WORK/ckpt_ref/$STEP_DIR" "$WORK/ckpt_clean/" || {
+  echo "reference checkpoint $STEP_DIR missing" >&2; exit 1; }
+cp "$WORK/losses_ref.txt" "$WORK/losses_clean.txt"
+# shellcheck disable=SC2086
+"$PYTHON" -m apex_tpu.testing.crash_resume \
+  --ckpt-dir "$WORK/ckpt_clean" --losses "$WORK/losses_clean.txt" \
+  "${COMMON[@]}" $DST_ARGS --resume \
+  --fingerprint "$WORK/fp_clean.txt" 2> "$WORK/clean.log" || {
+    cat "$WORK/clean.log" >&2; exit 1; }
+cat "$WORK/clean.log" >&2
+R2=$(sed -n 's/.*resumed from step \([0-9]*\).*/\1/p' "$WORK/clean.log")
+[ "$R2" = "$R" ] || {
+  echo "clean leg resumed from step ${R2:-none}, expected $R" >&2; exit 1; }
+
+echo "elastic_resume_smoke: [5/5] comparing curves + state digests" >&2
+[ "$(wc -l < "$WORK/losses_crash.txt")" -eq "$STEPS" ] || {
+  echo "resumed run logged wrong number of steps" >&2; exit 1; }
+if ! cmp -s "$WORK/losses_crash.txt" "$WORK/losses_clean.txt"; then
+  echo "elastic_resume_smoke: FAIL — post-resume loss curves differ:" >&2
+  diff "$WORK/losses_crash.txt" "$WORK/losses_clean.txt" >&2 || true
+  exit 1
+fi
+if ! cmp -s "$WORK/fp_elastic.txt" "$WORK/fp_clean.txt"; then
+  echo "elastic_resume_smoke: FAIL — final state digests differ:" >&2
+  diff "$WORK/fp_elastic.txt" "$WORK/fp_clean.txt" >&2 || true
+  exit 1
+fi
+echo "elastic_resume_smoke: PASS — killed-at-N / resumed-at-M run is" \
+     "bit-identical to the clean reshard continuation" >&2
+exit 0

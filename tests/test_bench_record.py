@@ -5,13 +5,13 @@ last JSON line.  Rounds 1-4 all delivered ``parsed: null`` because the
 full record line grew past the tail size.  These tests pin the fix: every
 emission ends with a compact line that (a) is <= 1500 bytes, (b) parses,
 (c) carries the driver contract fields, and (d) survives a simulated
-2000-byte tail even in the worst case (all thirteen BENCH_ORDER rows
+2000-byte tail even in the worst case (all fourteen BENCH_ORDER rows
 verbose — including ``real_data_rn50`` with its ``vs_synthetic``
 composition, ``zero_adam_step`` with ``vs_per_leaf``, ``tp_gpt``
 with its overlap_comm A/B fields (``overlap_tokens_per_sec`` /
-``vs_monolithic``), ``ckpt_save_restore`` with ``vs_sharded``, and
-``telemetry_overhead`` with ``vs_bare`` — + embedded prior TPU
-evidence).
+``vs_monolithic``), ``ckpt_save_restore`` with ``vs_sharded``,
+``ckpt_reshard`` with ``vs_same_mesh``, and ``telemetry_overhead``
+with ``vs_bare`` — + embedded prior TPU evidence).
 """
 
 import io
@@ -25,10 +25,10 @@ import bench  # noqa: E402
 
 
 def _worst_case_results():
-    """All thirteen BENCH_ORDER rows, each fattened with prose fields,
+    """All fourteen BENCH_ORDER rows, each fattened with prose fields,
     like a CPU-fallback day — the REAL worst case (the pre-fix nine-row
     set under-tested the <=1500-byte guarantee once ``real_data_rn50``,
-    ``zero_adam_step``, ``ckpt_save_restore``, and
+    ``zero_adam_step``, ``ckpt_save_restore``, ``ckpt_reshard``, and
     ``telemetry_overhead`` landed)."""
     rows = {
         "resnet50_o2": {"value": 8824.6, "unit": "images/sec/chip"},
@@ -47,6 +47,8 @@ def _worst_case_results():
         "ckpt_save_restore": {"value": 523.4,
                               "unit": "ms/save+verify+restore",
                               "vs_sharded": 1.113},
+        "ckpt_reshard": {"value": 188.2, "unit": "ms/reshard-restore",
+                         "vs_same_mesh": 1.74},
         "telemetry_overhead": {"value": 183451.2, "unit": "us/step",
                                "vs_bare": 1.012},
         "gpt_flash_fp8": {"value": 4112.3, "unit": "tokens/sec/chip"},
@@ -90,6 +92,7 @@ def test_compact_record_under_1500_bytes():
     assert compact["rows"]["zero_adam_step"]["vs_per_leaf"] == 0.655
     assert compact["rows"]["tp_gpt"]["vs_monolithic"] == 1.088
     assert compact["rows"]["ckpt_save_restore"]["vs_sharded"] == 1.113
+    assert compact["rows"]["ckpt_reshard"]["vs_same_mesh"] == 1.74
     assert compact["rows"]["telemetry_overhead"]["vs_bare"] == 1.012
 
 

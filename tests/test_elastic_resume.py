@@ -1,0 +1,79 @@
+"""Elastic resume smoke, fast tier (ISSUE 6 CI satellite).
+
+Runs ``scripts/elastic_resume_smoke.sh`` in a subprocess — the real
+kill-at-mesh-N / resume-at-mesh-M sequence: an async-sharded-saving
+trainer is SIGKILLed mid-save on the source mesh and resumed on a
+DIFFERENT mesh shape, where ``restore_latest`` reshards the newest
+intact checkpoint through the logical-spec layer
+(``apex_tpu.resilience.reshard``).  The script asserts the pre-kill
+loss prefix matches the uninterrupted source-mesh reference
+bit-exactly, the post-resume curve matches a clean (no-kill) reshard
+continuation bit-exactly, and the final mesh-independent state digests
+(``reshard.load_logical``, per-leaf sha256) are identical.
+
+The fast tier runs the flat-bucket ZeRO leg — save at dp=4, SIGKILL
+mid-save, resume at dp=2 — because it is the hard case of
+restore-anywhere (the ``(rows, chunk)`` optimizer buffers are
+mesh-shape-DEPENDENT and must be unflattened and re-chunked for the
+new world) and compiles in seconds.  The 3D GPT legs (dp 4->2 and the
+tp=2,pp=2 -> tp=4,pp=1 ``[vpp, pp]`` layer-stack re-factor) each cost
+two full trainer compiles, so they carry ``-m slow``; the remaining
+transitions (dp 2->4, reverses) run the same script with
+``SRC_ARGS``/``DST_ARGS`` — see docs/resilience.md "restore-anywhere".
+Subprocess for the same reason as ``tests/test_crash_resume.py``:
+device-count pinning must precede backend init, and a SIGKILL needs a
+process to kill.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_smoke(workdir, mode, src_args=None, dst_args=None):
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)  # the trainer pins its own device count
+    env["JAX_PLATFORMS"] = "cpu"
+    env["MODE"] = mode
+    env["PYTHON"] = sys.executable
+    if src_args:
+        env["SRC_ARGS"] = src_args
+    if dst_args:
+        env["DST_ARGS"] = dst_args
+    proc = subprocess.run(
+        ["bash", os.path.join(_REPO, "scripts",
+                              "elastic_resume_smoke.sh"), str(workdir)],
+        cwd=_REPO, env=env, capture_output=True, timeout=540,
+    )
+    assert proc.returncode == 0, (
+        f"elastic_resume_smoke.sh [{mode}] rc={proc.returncode}\n"
+        f"stderr tail:\n{proc.stderr.decode(errors='replace')[-3000:]}"
+    )
+    assert b"PASS" in proc.stderr
+
+
+def test_elastic_resume_zero_flat_bucket_dp4_to_dp2(tmp_path):
+    _run_smoke(tmp_path / "work", "zero")
+
+
+@pytest.mark.slow
+def test_elastic_resume_gpt_dp4_to_dp2(tmp_path):
+    """The 3D GPT dp 4->2 leg (layer placement + replicated FusedAdam
+    state through the spec layer).  Slow tier: two trainer compiles
+    (~107 s) — the fast-tier budget keeps the ZeRO leg, whose state is
+    the one that actually changes shape with the mesh."""
+    _run_smoke(tmp_path / "work", "gpt")
+
+
+@pytest.mark.slow
+def test_elastic_resume_gpt_tp2pp2_to_tp4pp1(tmp_path):
+    """The model-parallel re-factor leg: a tp=2,pp=2 checkpoint resumed
+    at tp=4,pp=1 (layer stacks merged [vpp, pp] -> [L] and re-split,
+    tp shardings re-placed).  Slow tier: two distinct 3D compiles."""
+    _run_smoke(tmp_path / "work", "gpt",
+               src_args="--tp 2 --pp 2 --devices 4",
+               dst_args="--tp 4 --pp 1 --devices 4")

@@ -1,0 +1,476 @@
+"""Restore-anywhere (ISSUE 6): logical sharding specs, resharded
+restores, manifest back-compat, and the retention pin.
+
+The kill-at-N/resume-at-M proof lives in
+``scripts/elastic_resume_smoke.sh`` (driven fast-tier by
+``tests/test_elastic_resume.py``); these tests pin the pieces in
+isolation with the fault-injection harness:
+
+- :func:`apex_tpu.resilience.reshard.build_spec` / ``ShardingSpec``
+  JSON round trip, and spec validation errors that NAME the
+  missing/invalid field (corruption-class, so ``restore_latest`` can
+  fall back past a bad spec);
+- ZeRO flat-bucket state saved at one dp world restores bit-exactly
+  onto another (buffers unflattened to logical leaves, re-chunked),
+  proven by comparing mesh-independent ``load_logical`` digests;
+- folded layer stacks (``[vpp, pp, ...]``) re-factor across pipeline
+  depth changes by pure reshape;
+- manifest back-compat: a pre-PR-6 (version-1, spec-less) manifest
+  still restores onto the same mesh shape, a NEWER manifest version is
+  corruption-class, and a shape-mismatched spec-less checkpoint fails
+  with an error naming the missing ``sharding_spec``;
+- retention (the ISSUE 6 bugfix): keep-last-k counts and deletes only
+  COMMITTED checkpoints, so crash artifacts or an in-flight async save
+  (parked provably mid-write with ``faults.hung_writes``) can neither
+  displace the last durable checkpoint out of the keep window nor be
+  deleted under the writer.
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu import checkpoint as ckpt
+from apex_tpu import parallel
+from apex_tpu.contrib.optimizers import DistributedFusedAdam
+from apex_tpu.parallel.distributed import replicate, zero_init
+from apex_tpu.resilience import CheckpointManager, reshard
+from apex_tpu.testing import faults
+
+
+def _zero_pack(mesh, opt, seed=0):
+    """A small flat-bucket ZeRO train state committed to ``mesh`` —
+    params replicated, optimizer buffers dp-chunked (mesh-shape-
+    dependent) — plus its logical spec."""
+    params = {
+        "w": jax.random.normal(jax.random.PRNGKey(seed), (13, 7)),
+        "b": jnp.arange(7.0) * 0.25,
+    }
+    p = replicate(params, mesh)
+    pack = {"params": p, "opt": zero_init(opt, p, mesh)}
+    spec = reshard.build_spec(pack, mesh=mesh,
+                              zero_states=[("opt", opt, p)])
+    return pack, spec
+
+
+# ---------------------------------------------------------------------------
+# ShardingSpec: build / serialize / validate
+# ---------------------------------------------------------------------------
+
+
+def test_spec_json_roundtrip(devices8):
+    mesh = parallel.initialize_model_parallel(devices=devices8[:4])
+    opt = DistributedFusedAdam(lr=1e-2, flat_bucket=True, n_buckets=2)
+    _, spec = _zero_pack(mesh, opt)
+    doc = json.loads(json.dumps(spec.to_json()))  # through real JSON
+    back = reshard.ShardingSpec.from_json(doc)
+    assert back.to_json() == spec.to_json()
+    assert spec.mesh["dp"] == 4
+    # every bucket leaf is annotated with its group membership
+    grouped = [p for p, rec in spec.leaves.items() if "group" in rec]
+    assert grouped and all(p.startswith("opt/.") for p in grouped)
+
+
+@pytest.mark.parametrize("doc, names", [
+    ("not-a-dict", ["not an object"]),
+    ({"version": 99, "leaves": {}, "groups": {}}, ["version", "99"]),
+    ({"version": 1, "groups": {}}, ["leaves", "missing"]),
+    ({"version": 1, "leaves": {}}, ["groups", "missing"]),
+])
+def test_spec_validation_names_the_field(doc, names):
+    """A missing/invalid spec field is corruption-class and the message
+    names it — the fallback log must say WHAT was wrong, not just that
+    a restore failed."""
+    with pytest.raises(ckpt.CheckpointCorruptError) as e:
+        reshard.ShardingSpec.from_json(doc)
+    for frag in names:
+        assert frag in str(e.value)
+
+
+def test_group_spec_validation_names_the_field(tmp_path, devices8):
+    """An embedded spec whose flat-bucket group record lost a required
+    field fails the resharded restore with the field named (and is
+    therefore fallback-eligible in ``restore_latest``)."""
+    mesh = parallel.initialize_model_parallel(devices=devices8[:4])
+    opt = DistributedFusedAdam(lr=1e-2, flat_bucket=True, n_buckets=2)
+    pack, spec = _zero_pack(mesh, opt)
+    path = str(tmp_path / "c.npz")
+    ckpt.save_checkpoint(path, pack, step=0, spec=spec)
+
+    with np.load(path, allow_pickle=False) as data:
+        manifest = json.loads(str(data["__manifest__"]))
+        arrays = {k: data[k] for k in data.files if k != "__manifest__"}
+    key = next(iter(manifest["sharding_spec"]["groups"]))
+    del manifest["sharding_spec"]["groups"][key]["chunk"]
+    with open(path, "wb") as f:
+        np.savez(f, __manifest__=json.dumps(manifest), **arrays)
+
+    parallel.destroy_model_parallel()
+    mesh = parallel.initialize_model_parallel(devices=devices8[:2])
+    like, spec2 = _zero_pack(mesh, opt)
+    with pytest.raises(ckpt.CheckpointCorruptError, match="chunk"):
+        reshard.restore_resharded(path, like, spec2)
+
+
+def test_manager_embeds_spec_in_manifest(tmp_path, devices8):
+    mesh = parallel.initialize_model_parallel(devices=devices8[:4])
+    opt = DistributedFusedAdam(lr=1e-2, flat_bucket=True, n_buckets=2)
+    pack, spec = _zero_pack(mesh, opt)
+    mgr = CheckpointManager(str(tmp_path / "m"), sharded=True, spec=spec)
+    mgr.save(pack, 0)
+    manifest = mgr.verify(0)
+    assert manifest["version"] == ckpt.MANIFEST_VERSION
+    assert manifest["sharding_spec"]["version"] == reshard.SPEC_VERSION
+    assert manifest["sharding_spec"]["mesh"]["dp"] == 4
+
+
+# ---------------------------------------------------------------------------
+# Resharded restores: ZeRO flat buckets + folded layer stacks
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("src_n, dst_n", [(4, 2), (2, 4)])
+def test_zero_flat_bucket_reshard_bit_exact(tmp_path, devices8,
+                                            src_n, dst_n):
+    """The hard case of restore-anywhere: flat-bucket buffers are
+    ``(rows, chunk)`` with rows padded to a multiple of
+    ``world * n_buckets`` — a different dp world is a different GLOBAL
+    shape.  Save at dp=src, restore_latest at dp=dst (shapes mismatch
+    -> resharded path), re-save, and compare the two checkpoints'
+    mesh-independent logical views bit for bit."""
+    opt = DistributedFusedAdam(lr=1e-2, flat_bucket=True, n_buckets=2)
+    d_src = str(tmp_path / "src")
+    d_dst = str(tmp_path / "dst")
+
+    mesh = parallel.initialize_model_parallel(devices=devices8[:src_n])
+    pack, spec = _zero_pack(mesh, opt)
+    src_mgr = CheckpointManager(d_src, sharded=True, spec=spec)
+    src_path = src_mgr.save(pack, 3)
+    parallel.destroy_model_parallel()
+
+    mesh = parallel.initialize_model_parallel(devices=devices8[:dst_n])
+    like, spec2 = _zero_pack(mesh, opt, seed=1)  # different values
+    dst_mgr = CheckpointManager(d_dst, sharded=True, spec=spec2)
+    restored, at = CheckpointManager(
+        d_src, sharded=True, spec=spec2).restore_latest(like)
+    assert at == 3
+    # buffers really are laid out for the NEW world
+    for (pth, a), b in zip(
+            jax.tree_util.tree_leaves_with_path(restored),
+            jax.tree_util.tree_leaves(like)):
+        assert np.shape(a) == np.shape(b), pth
+    dst_path = dst_mgr.save(restored, 3)
+
+    src_logical, _ = reshard.load_logical(src_path)
+    dst_logical, _ = reshard.load_logical(dst_path)
+    assert sorted(src_logical) == sorted(dst_logical)
+    for key in src_logical:
+        np.testing.assert_array_equal(src_logical[key],
+                                      dst_logical[key], err_msg=key)
+
+
+def test_bare_spec_mesh_kwarg_reshards_zero_state(tmp_path, devices8):
+    """``restore_latest(like, mesh=...)`` — no hand-built spec — must
+    still reshard ZeRO flat-bucket state: the group layouts and
+    ``fold``/``ravel_of`` markers are mesh-independent, so the bare
+    target spec inherits them from the SOURCE checkpoint's spec (every
+    target-dependent size comes from ``like``)."""
+    opt = DistributedFusedAdam(lr=1e-2, flat_bucket=True, n_buckets=2)
+    root = str(tmp_path / "m")
+
+    mesh = parallel.initialize_model_parallel(devices=devices8[:4])
+    pack, spec = _zero_pack(mesh, opt)
+    CheckpointManager(root, sharded=True, spec=spec).save(pack, 0)
+    src_logical, _ = reshard.load_logical(
+        CheckpointManager(root, sharded=True)._path(0))
+    parallel.destroy_model_parallel()
+
+    mesh = parallel.initialize_model_parallel(devices=devices8[:2])
+    like, _ = _zero_pack(mesh, opt, seed=1)
+    restored, at = CheckpointManager(root, sharded=True).restore_latest(
+        like, mesh=mesh)
+    assert at == 0
+    d2 = str(tmp_path / "m2")
+    spec2 = reshard.build_spec(like, mesh=mesh,
+                               zero_states=[("opt", opt, like["params"])])
+    CheckpointManager(d2, sharded=True, spec=spec2).save(restored, 0)
+    dst_logical, _ = reshard.load_logical(
+        CheckpointManager(d2, sharded=True)._path(0))
+    for key in src_logical:
+        np.testing.assert_array_equal(src_logical[key],
+                                      dst_logical[key], err_msg=key)
+
+
+def test_mixed_step_shard_dir_is_corruption(tmp_path, devices8):
+    """A legacy (manifest-less) shard dir holding shards of two
+    DIFFERENT steps must fail as corruption, not silently assemble a
+    chimera state — the same torn/mixed guard as the plain sharded
+    restore, on the reshard source reader."""
+    mesh = parallel.initialize_model_parallel(devices=devices8[:2])
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    d = str(tmp_path / "s")
+    w = jax.device_put(jnp.arange(16.0).reshape(8, 2),
+                       NamedSharding(mesh, P(("dcn", "dp"), None)))
+    ckpt.save_checkpoint_sharded(d, {"w": w}, step=0)
+    # simulate an overlapping save torn mid-flight: shard_1 from a
+    # LATER step survives next to shard_0 of the committed one
+    import shutil
+
+    shutil.copy(os.path.join(d, "shard_0.npz"),
+                os.path.join(d, "shard_1.npz"))
+    with np.load(os.path.join(d, "shard_1.npz"),
+                 allow_pickle=False) as data:
+        manifest = json.loads(str(data["__manifest__"]))
+        arrays = {k: data[k] for k in data.files if k != "__manifest__"}
+    manifest["step"] = 1
+    with open(os.path.join(d, "shard_1.npz"), "wb") as f:
+        np.savez(f, __manifest__=json.dumps(manifest), **arrays)
+    os.unlink(os.path.join(d, "manifest.json"))  # legacy layout
+
+    with pytest.raises(ckpt.CheckpointCorruptError, match="mixed"):
+        reshard.load_logical(d)
+
+
+def test_load_logical_propagates_malformed_spec(tmp_path):
+    """Only a truly ABSENT spec falls back to the plain-leaf
+    fingerprint; a malformed one must raise (naming the bad field), or
+    the harness would misread a corrupt spec as training-state
+    divergence."""
+    path = str(tmp_path / "c.npz")
+    ckpt.save_checkpoint(path, {"w": jnp.arange(6.0)}, step=0)
+    leaves, _ = reshard.load_logical(path)  # spec-less: plain leaves
+    assert list(leaves) == ["w"]
+
+    with np.load(path, allow_pickle=False) as data:
+        manifest = json.loads(str(data["__manifest__"]))
+        arrays = {k: data[k] for k in data.files if k != "__manifest__"}
+    manifest["sharding_spec"] = {"version": reshard.SPEC_VERSION,
+                                 "leaves": ["not", "a", "dict"],
+                                 "groups": {}}
+    with open(path, "wb") as f:
+        np.savez(f, __manifest__=json.dumps(manifest), **arrays)
+    with pytest.raises(ckpt.CheckpointCorruptError, match="leaves"):
+        reshard.load_logical(path)
+
+
+def test_folded_layer_stack_refactors(tmp_path, devices8):
+    """A ``[vpp, pp, ...]`` layer stack marked ``fold=2`` restores
+    across a pipeline-depth change by pure reshape: (vpp=1, pp=2) ->
+    (vpp=2, pp=1) — the tp/pp elastic transition — bit-exactly and in
+    the virtual-stage-major order the interleaved schedule assigns."""
+    mesh = parallel.initialize_model_parallel(devices=devices8[:2])
+    stack = jnp.arange(2 * 4 * 3.0).reshape(1, 2, 4, 3)  # [vpp=1, pp=2]
+    tree = {"layers": replicate(stack, mesh), "tail": jnp.ones((5,))}
+    spec = reshard.build_spec(tree, mesh=mesh,
+                              folds={"layers": 2, "tail": 0})
+    path = str(tmp_path / "c.npz")
+    ckpt.save_checkpoint(path, tree, step=0, spec=spec)
+    parallel.destroy_model_parallel()
+
+    mesh = parallel.initialize_model_parallel(devices=devices8[:2])
+    like = {"layers": replicate(jnp.zeros((2, 1, 4, 3)), mesh),
+            "tail": jnp.zeros((5,))}
+    spec2 = reshard.build_spec(like, mesh=mesh,
+                               folds={"layers": 2, "tail": 0})
+    restored, _ = reshard.restore_resharded(path, like, spec2)
+    np.testing.assert_array_equal(
+        np.asarray(restored["layers"]).reshape(2, 4, 3),
+        np.asarray(stack).reshape(2, 4, 3))
+    np.testing.assert_array_equal(np.asarray(restored["tail"]),
+                                  np.ones((5,)))
+
+
+# ---------------------------------------------------------------------------
+# Manifest back-compat
+# ---------------------------------------------------------------------------
+
+
+def _downgrade_to_v1(path):
+    """Rewrite a flat checkpoint as its pre-PR-6 self: manifest version
+    1, no ``sharding_spec``."""
+    with np.load(path, allow_pickle=False) as data:
+        manifest = json.loads(str(data["__manifest__"]))
+        arrays = {k: data[k] for k in data.files if k != "__manifest__"}
+    manifest["version"] = 1
+    manifest.pop("sharding_spec", None)
+    with open(path, "wb") as f:
+        np.savez(f, __manifest__=json.dumps(manifest), **arrays)
+
+
+def test_legacy_v1_manifest_restores_same_mesh(tmp_path):
+    """A pre-PR-6 manifest (version 1, spec-less) still restores onto
+    the mesh shape that wrote it — both through the raw reader and
+    through ``restore_latest`` WITH a target spec configured (the
+    same-shape check routes it down the plain path)."""
+    root = str(tmp_path / "m")
+    mgr = CheckpointManager(root, keep=3)
+    tree = {"w": jnp.arange(12.0).reshape(3, 4), "n": jnp.ones((2,))}
+    mgr.save(tree, 0)
+    _downgrade_to_v1(mgr._path(0))
+
+    restored, at = ckpt.restore_checkpoint(mgr._path(0), tree)
+    assert at == 0
+    mesh = parallel.initialize_model_parallel()
+    spec = reshard.build_spec(tree, mesh=mesh)
+    restored, at = CheckpointManager(
+        root, keep=3, spec=spec).restore_latest(tree)
+    assert at == 0
+    np.testing.assert_array_equal(np.asarray(restored["w"]),
+                                  np.asarray(tree["w"]))
+
+
+def test_legacy_manifest_shape_mismatch_names_missing_spec(tmp_path):
+    """A spec-less checkpoint CANNOT reshard: asking it to (template
+    shapes differ) fails with an error naming the missing
+    ``sharding_spec`` — and ``restore_latest`` reports it in the
+    no-checkpoint error after falling back past it."""
+    root = str(tmp_path / "m")
+    mgr = CheckpointManager(root, keep=3)
+    mgr.save({"w": jnp.arange(12.0).reshape(3, 4)}, 0)
+    _downgrade_to_v1(mgr._path(0))
+
+    like = {"w": jnp.zeros((4, 3))}  # a different layout
+    mesh = parallel.initialize_model_parallel()
+    spec = reshard.build_spec(like, mesh=mesh)
+    with pytest.raises(FileNotFoundError, match="sharding_spec"):
+        CheckpointManager(root, keep=3, spec=spec).restore_latest(like)
+
+
+def test_newer_manifest_version_is_corruption_class(tmp_path):
+    """A manifest NEWER than this reader supports must fail loudly (and
+    fallback-eligibly) rather than be misread."""
+    root = str(tmp_path / "m")
+    mgr = CheckpointManager(root, keep=3)
+    tree = {"w": jnp.arange(4.0)}
+    mgr.save(tree, 0)
+    mgr.save(tree, 1)
+    path = mgr._path(1)
+    with np.load(path, allow_pickle=False) as data:
+        manifest = json.loads(str(data["__manifest__"]))
+        arrays = {k: data[k] for k in data.files if k != "__manifest__"}
+    manifest["version"] = ckpt.MANIFEST_VERSION + 1
+    with open(path, "wb") as f:
+        np.savez(f, __manifest__=json.dumps(manifest), **arrays)
+
+    with pytest.raises(ckpt.CheckpointCorruptError, match="newer"):
+        ckpt.restore_checkpoint(path, tree)
+    # restore_latest falls back past it to the intact older step
+    restored, at = mgr.restore_latest(tree, verify=False)
+    assert at == 0
+
+
+# ---------------------------------------------------------------------------
+# Retention: committed-only counting + the hung-writer pin (ISSUE 6 fix)
+# ---------------------------------------------------------------------------
+
+
+def test_retention_ignores_uncommitted_crash_artifacts(tmp_path):
+    """Crash artifacts (step dirs with shards but no committed
+    ``manifest.json``) must never count toward ``keep``: two artifacts
+    above the last durable save used to push it out of the window and
+    retention deleted the only restorable state.  Artifacts NEWER than
+    the newest committed step are left alone (their writer may still be
+    in flight); artifacts strictly older are provably dead — saves are
+    step-monotonic — and are reaped so repeated crashes cannot grow the
+    directory without bound."""
+    root = str(tmp_path / "m")
+    mgr = CheckpointManager(root, keep=2, sharded=True)
+    tree = {"w": jnp.arange(8.0)}
+    mgr.save(tree, 0)
+    mgr.save(tree, 1)
+    # two uncommitted artifacts above every durable save, one below 4
+    for s in (2, 3, 5):
+        os.makedirs(mgr._path(s))
+        with open(os.path.join(mgr._path(s), "shard_0.npz"), "wb") as f:
+            f.write(b"torn")
+    mgr.save(tree, 4)  # triggers retention
+    # committed ledger is [0, 1, 4]: 0 dropped, 1 and 4 kept — the
+    # artifacts did NOT push 1 out of the keep=2 window
+    assert not os.path.exists(mgr._path(0))
+    assert mgr.verify(1) and mgr.verify(4)
+    # dead artifacts (older than committed step 4) reaped; the one
+    # above the newest commit may be a live writer — untouched
+    assert not os.path.exists(mgr._path(2))
+    assert not os.path.exists(mgr._path(3))
+    assert os.path.exists(mgr._path(5))
+    _, at = mgr.restore_latest(tree)
+    assert at == 4
+
+
+def test_retention_never_deletes_last_committed_under_hung_write(
+        tmp_path):
+    """The ISSUE 6 retention bug, pinned with ``faults.hung_writes``:
+    with ``keep=1`` and an async save provably parked mid-write (step
+    dir visible, zero bytes committed), a retention pass must NOT drop
+    the last-committed step — pre-fix, ``all_steps()`` counted the
+    in-flight dir, pushed the durable step out of the window, and a
+    crash at that moment lost the only restorable state."""
+    root = str(tmp_path / "m")
+    mgr = CheckpointManager(root, keep=1, sharded=True)
+    tree = {"w": jnp.arange(8.0)}
+    mgr.save(tree, 0)
+    with faults.hung_writes(path_prefix=root) as gate:
+        handle = mgr.save_async({"w": jnp.full((8,), 9.0)}, 1)
+        assert gate.entered.wait(timeout=30)
+        # the retention pass any concurrent save/wait would run
+        mgr._apply_retention()
+        assert mgr.verify(0)  # durable step survived
+        assert os.path.exists(mgr._path(1))  # in-flight dir untouched
+        gate.release()
+        handle.result(timeout=30)
+    mgr.wait()  # commits step 1; retention now drops step 0
+    _, at = mgr.restore_latest(tree)
+    assert at == 1
+    assert not os.path.exists(mgr._path(0))
+
+
+def test_retention_pins_step_a_restore_is_reading(tmp_path):
+    """The restore-side pin: a step a concurrent ``restore_latest`` is
+    reading is exempt from retention until the read finishes."""
+    root = str(tmp_path / "m")
+    mgr = CheckpointManager(root, keep=1, sharded=True)
+    tree = {"w": jnp.arange(8.0)}
+    mgr.save(tree, 0)
+    mgr._pinned.add(0)  # what restore_latest holds while reading step 0
+    try:
+        mgr.save(tree, 1)  # retention would otherwise drop step 0
+        assert mgr.verify(0)
+    finally:
+        mgr._pinned.discard(0)
+    mgr.save(tree, 2)  # unpinned: the normal window applies again
+    assert not os.path.exists(mgr._path(0))
+    assert not os.path.exists(mgr._path(1))
+
+
+# ---------------------------------------------------------------------------
+# Observability satellite: fallback-depth counter
+# ---------------------------------------------------------------------------
+
+
+def test_restore_latest_counts_fallback_depth(tmp_path):
+    """``restore_latest`` flushes a ``ckpt/fallback_depth`` counter (how
+    many corrupt candidates were skipped before success) and a
+    ``checkpoint/restore_latest`` span through the default rank-aware
+    registry."""
+    from apex_tpu.observability.metrics import default_registry
+
+    root = str(tmp_path / "m")
+    mgr = CheckpointManager(root, keep=3)
+    tree = {"w": jnp.arange(64.0)}
+    for s in range(3):
+        mgr.save({"w": jnp.full((64,), float(s))}, s)
+    faults.corrupt_checkpoint(mgr._path(2))
+    faults.corrupt_checkpoint(mgr._path(1))
+
+    reg = default_registry()
+    before = reg.counter("ckpt/fallback_depth").value
+    restored, at = mgr.restore_latest(tree)
+    assert at == 0
+    assert reg.counter("ckpt/fallback_depth").value - before == 2
+    assert any(k.startswith("span_ms/checkpoint/restore_latest")
+               for k in reg.snapshot())
