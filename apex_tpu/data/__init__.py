@@ -3,14 +3,27 @@
 The reference's examples consume ``torchvision.datasets.ImageFolder``
 through a ``DataLoader`` with ``fast_collate`` and a CUDA-side
 ``data_prefetcher`` (``examples/imagenet/main_amp.py:48-63,207-232,256``).
-This package is the TPU-native analog: a pure PIL/numpy ImageFolder, DP
-sharding through the Megatron samplers, threaded decode, and uint8 batches
-normalized on-device inside the jitted step.
+This package is the TPU-native analog, layered for production rate:
 
-For hosts whose decode rate cannot feed the chip (the DALI situation),
-:mod:`apex_tpu.data.packed` packs the dataset once into a memory-mapped
-uint8 shard; training then gathers batches decode-free and augments
-on-device.
+- **decode** — :class:`ImageFolderLoader` with a selectable worker
+  backend (``backend="process"`` — the true ``DataLoader(num_workers)``
+  analog — or ``"thread"``), per-host ``dp_ranks`` index sharding, and
+  uint8 batches normalized on-device inside the jitted step;
+- **decode-free** — :mod:`apex_tpu.data.packed` packs the dataset once
+  into a memory-mapped uint8 shard (the DALI/array_record role);
+  :mod:`apex_tpu.data.sequence` is the LM twin: pre-tokenized,
+  length-packed sequence shards with segment-id masks streamed into the
+  GPT trainers;
+- **transfer** — :func:`prefetch_to_device` double-buffers
+  ``device_put``/``dp_shard_batch`` on a dedicated thread (batch N+1's
+  transfer in flight while step N runs and decode fills N+2), recording
+  the residual ``data/stall_ms``;
+- **service** — :class:`DataService` moves the whole loader into a
+  dedicated process feeding the trainer over a local queue (the
+  tf.data-service split at single-host scope).
+
+All layers carry GLOBAL ``consumed_samples`` for exact mid-epoch resume
+through ``resilience.CheckpointManager``; see docs/data.md.
 """
 
 from apex_tpu.data.image_folder import (
@@ -27,18 +40,33 @@ from apex_tpu.data.packed import (
     PackedLoader,
     pack_image_folder,
 )
-from apex_tpu.data.prefetch import prefetch_to_device
+from apex_tpu.data.prefetch import DevicePrefetcher, prefetch_to_device
+from apex_tpu.data.sequence import (
+    PackedSequenceDataset,
+    PackedSequenceLoader,
+    pack_token_documents,
+    segment_loss_mask,
+    synthetic_token_documents,
+)
+from apex_tpu.data.service import DataService
 
 __all__ = [
+    "DataService",
+    "DevicePrefetcher",
     "ImageFolder",
     "ImageFolderLoader",
     "PackedImageDataset",
     "PackedLoader",
-    "pack_image_folder",
+    "PackedSequenceDataset",
+    "PackedSequenceLoader",
     "center_crop_resize",
     "normalize_on_device",
+    "pack_image_folder",
+    "pack_token_documents",
     "prefetch_to_device",
     "random_resized_crop",
     "sample_crop_box",
+    "segment_loss_mask",
     "synthetic_image_batches",
+    "synthetic_token_documents",
 ]

@@ -19,12 +19,23 @@ TPU-first differences:
   (:mod:`apex_tpu.transformer._data`) so ``consumed_samples`` checkpoint
   resume works for vision runs too (one sampler per dp rank, stacked into
   the global batch that ``dp_shard_batch`` lays onto the mesh);
-- decode parallelism is a thread pool (both decode paths release the
-  GIL), the analog of ``DataLoader(num_workers=...)`` without worker
-  processes; per-image decode prefers the native C kernel
+  ``dp_ranks`` restricts a loader to the dp shards THIS host's devices
+  own (``parallel.host_dp_ranks``) so a multi-process job decodes each
+  image exactly once instead of every host decoding the global batch —
+  the ``DataLoader``-per-process structure of the reference, with
+  placement through ``dp_shard_batch(..., local_ranks=dp_ranks)``;
+- decode parallelism is selectable (``backend=``): a **process pool**
+  (the true ``DataLoader(num_workers=...)`` analog — sidesteps the GIL
+  entirely, the production-rate default for JPEG-decode-bound hosts) or
+  a **thread pool** (both decode paths release the GIL for most of their
+  work; lower fixed cost, the fallback where spawning workers is
+  unwanted).  Per-image decode prefers the native C kernel
   (``_native/jpegdec.c`` — DCT-scaled libjpeg decode fused with the
   crop + bilinear resize, ~1.5-2x a PIL worker per core, the role of
   the reference recipe's DALI stage) and falls back to PIL per-image;
+  the decode core is a module-level pure function over an immutable
+  :class:`_DecodeSpec`, so both backends run byte-identical code and the
+  augmentation stream is backend-independent;
 - batches are decoded ``prefetch`` steps ahead: the loader keeps the
   decode futures for the next batches in flight while the caller's train
   step runs on device, so host decode overlaps device compute — the role
@@ -37,9 +48,9 @@ TPU-first differences:
 from __future__ import annotations
 
 import os
+import threading
 from collections import deque
-from concurrent.futures import ThreadPoolExecutor
-from typing import Iterator, Optional, Sequence, Tuple
+from typing import Iterator, NamedTuple, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -200,31 +211,176 @@ def normalize_on_device(x_uint8, mean=IMAGENET_MEAN, std=IMAGENET_STD,
     ``main_amp.py:268-276``; XLA fuses this into the consuming conv)."""
     import jax.numpy as jnp
 
+    from apex_tpu.observability.spans import named_span
+
     dtype = dtype or jnp.float32
-    x = x_uint8.astype(dtype) / jnp.asarray(255.0, dtype)
-    mean = jnp.asarray(mean, dtype)
-    std = jnp.asarray(std, dtype)
-    return (x - mean) / std
+    with named_span("data/normalize"):
+        x = x_uint8.astype(dtype) / jnp.asarray(255.0, dtype)
+        mean = jnp.asarray(mean, dtype)
+        std = jnp.asarray(std, dtype)
+        return (x - mean) / std
+
+
+# ---------------------------------------------------------------------------
+# Decode core — module-level pure functions over an immutable spec, so the
+# thread backend, the process backend (pickled to spawned workers), and the
+# data-service loader processes all run byte-identical decode code.
+# ---------------------------------------------------------------------------
+
+
+class _DecodeSpec(NamedTuple):
+    """Everything one decode needs, shipped once per worker process.
+
+    ``dataset`` is the ImageFolder (or any duck-type exposing
+    ``samples`` — the ``(path, label)`` list the native fast path and
+    the samplers index — and ``load(i) -> (PIL image, label)``, the
+    authoritative decode the PIL path calls, so custom datasets that
+    override ``load`` keep working on every backend).  The process
+    backend pickles it once per worker via the pool initializer, so a
+    custom dataset must be picklable there."""
+
+    dataset: object      # .samples + .load(i)
+    image_size: int
+    train: bool
+    seed: int
+    native: bool
+
+
+def _decode_native_one(spec: _DecodeSpec, index: int,
+                       rng: Optional[np.random.RandomState]
+                       ) -> Optional[Tuple[np.ndarray, int]]:
+    """One-call C decode+crop+resize (``_native/jpegdec.c``) — DCT
+    scaled decode fused with the transform, ~2x a PIL worker on the
+    same core.  Returns ``None`` (caller decodes via PIL) for
+    non-JPEG files or any per-image failure.  Draws the crop box
+    from the SAME :func:`sample_crop_box` stream as the PIL path, so
+    augmentation determinism is path-independent."""
+    from apex_tpu.data import _jpeg_native
+
+    path, label = spec.dataset.samples[index]
+    if not path.lower().endswith((".jpg", ".jpeg")):
+        return None
+    try:
+        with open(path, "rb") as f:
+            data = f.read()
+    except OSError:
+        return None
+    dims = _jpeg_native.jpeg_dims(data)
+    if dims is None:
+        return None
+    h, w = dims
+    size = spec.image_size
+    if rng is not None:  # train transform
+        x0, y0, cw, ch = sample_crop_box(rng, w, h)
+        flip = bool(rng.rand() < 0.5)
+    else:  # eval: the region center_crop_resize would keep
+        x0, y0, side = eval_crop_box(w, h, size)
+        cw = ch = side
+        flip = False
+    arr = _jpeg_native.decode_crop_resize(
+        data, y0, x0, ch, cw, size, size, hflip=flip)
+    if arr is None:
+        return None
+    return arr, label
+
+
+def _decode_one(spec: _DecodeSpec, index: int, consumed_marker: int
+                ) -> Tuple[np.ndarray, int]:
+    """Decode + transform one sample.  Pure in ``(spec, index, marker)``
+    — the augmentation seed folds the sampler position captured at
+    submission time, so the stream is identical at every prefetch depth
+    and on every backend."""
+    if spec.train:
+        # fold the sample index + sampler position into the seed:
+        # deterministic but different augmentation per sample and epoch.
+        rng = np.random.RandomState(
+            (spec.seed + consumed_marker + index) % (2 ** 31))
+    else:
+        rng = None
+    if spec.native:
+        # snapshot the RNG: a native failure *after* the crop draws
+        # (e.g. truncated file) must hand PIL the same stream it
+        # would have seen had the native path never run
+        state = rng.get_state() if rng is not None else None
+        out = _decode_native_one(spec, index, rng)
+        if out is not None:
+            return out
+        if state is not None:
+            rng.set_state(state)
+    # the dataset's load() is authoritative (custom datasets override it)
+    img, label = spec.dataset.load(index)
+    if spec.train:
+        arr = random_resized_crop(rng, img, spec.image_size)
+    else:
+        arr = center_crop_resize(img, spec.image_size)
+    return arr, label
+
+
+# Spawned decode workers hold the spec in a module global (set once by the
+# pool initializer) so tasks ship only (index, marker), not the spec.
+_WORKER_SPEC: Optional[_DecodeSpec] = None
+
+
+def _process_worker_init(spec: _DecodeSpec) -> None:
+    global _WORKER_SPEC
+    _WORKER_SPEC = spec
+
+
+def _process_decode_chunk(indices, marker: int):
+    """Decode a chunk of samples in one task — amortizes the per-task
+    submit/pickle round trip (per-image tasks spend a measurable
+    fraction of a wide pool's budget on IPC, not decode).  The images
+    are stacked into ONE uint8 array so the result pickles as a single
+    contiguous buffer."""
+    outs = [_decode_one(_WORKER_SPEC, i, marker) for i in indices]
+    return (np.stack([o[0] for o in outs]),
+            np.asarray([o[1] for o in outs], np.int32))
+
+
+def _worker_warmup() -> bool:
+    """Pull the decode imports into a worker and hold it briefly so the
+    pool spawns its full width (ProcessPoolExecutor adds processes only
+    while a backlog exists)."""
+    import time
+
+    import PIL.Image  # noqa: F401 — the import IS the warmup
+
+    time.sleep(0.05)
+    return True
 
 
 class ImageFolderLoader:
     """DP-sharded training iterator over an :class:`ImageFolder`.
 
-    Yields global ``(images uint8 [B, size, size, 3], labels int32 [B])``
-    batches where ``B = local_batch * data_parallel_size`` and rows
-    ``[r*local : (r+1)*local]`` are rank ``r``'s disjoint shard (the
-    ``DistributedSampler`` contract) — feed the tuple to
-    ``parallel.dp_shard_batch`` to lay it onto the mesh.  Epoch shuffling
-    and mid-epoch resume come from
-    :class:`~apex_tpu.transformer._data.MegatronPretrainingRandomSampler`
-    (``consumed_samples`` is per-rank resumable state).
+    Yields ``(images uint8 [B, size, size, 3], labels int32 [B])``
+    batches where ``B = local_batch * len(dp_ranks)`` and row window
+    ``[i*local : (i+1)*local]`` is ``dp_ranks[i]``'s disjoint shard (the
+    ``DistributedSampler`` contract).  ``dp_ranks`` defaults to ALL dp
+    ranks (single-host: the global batch — feed the tuple to
+    ``parallel.dp_shard_batch``); a multi-process job passes
+    ``parallel.host_dp_ranks(mesh)`` so each host decodes only its own
+    shards and places them with
+    ``dp_shard_batch(batch, mesh, local_ranks=dp_ranks)``.
+    ``consumed_samples`` stays in GLOBAL samples on every host (each
+    yielded batch advances it by ``local_batch * data_parallel_size``),
+    so a single checkpointed integer resumes all hosts coherently.
+
+    ``backend``: ``"process"`` (spawned worker processes — the true
+    ``DataLoader(num_workers=...)`` analog, immune to the GIL; decode
+    state ships once per worker via the pool initializer) or
+    ``"thread"`` (in-process pool — lower fixed cost; both decode paths
+    release the GIL for the codec work but contend for it in the numpy
+    glue).  Epoch shuffling and mid-epoch resume come from
+    :class:`~apex_tpu.transformer._data.MegatronPretrainingRandomSampler`.
     """
 
     def __init__(self, dataset: ImageFolder, local_batch: int,
                  data_parallel_size: int = 1, image_size: int = 224,
                  consumed_samples: int = 0, train: bool = True,
                  workers: int = 8, seed: int = 0, prefetch: int = 2,
-                 native: Optional[bool] = None):
+                 native: Optional[bool] = None, backend: str = "thread",
+                 dp_ranks: Optional[Sequence[int]] = None,
+                 mp_start: str = "spawn"):
         from apex_tpu.transformer._data import (
             MegatronPretrainingRandomSampler,
         )
@@ -236,6 +392,10 @@ class ImageFolderLoader:
         self.train = train
         self.seed = seed
         self.prefetch = max(0, prefetch)
+        if backend not in ("thread", "process"):
+            raise ValueError(
+                f"backend must be 'thread' or 'process', got {backend!r}")
+        self.backend = backend
         # native=None -> auto: the C decode kernel when it builds (cc +
         # libjpeg present), PIL otherwise; failures of either the build
         # or any single image fall back to PIL per-image.  An explicit
@@ -251,34 +411,103 @@ class ImageFolderLoader:
                     "unavailable (no cc or libjpeg?); decoding via PIL")
         else:
             self._native = False
+        self._spec = _DecodeSpec(
+            dataset=dataset, image_size=image_size,
+            train=train, seed=seed, native=self._native)
+        self._workers = workers
         self._inflight = 0  # batches decoded/decoding ahead of the caller
-        self._pool = ThreadPoolExecutor(max_workers=workers)
-        self.samplers = [
-            MegatronPretrainingRandomSampler(
-                total_samples=len(dataset),
-                consumed_samples=consumed_samples,
-                local_minibatch_size=local_batch,
-                data_parallel_rank=r,
-                data_parallel_size=data_parallel_size,
-            )
-            for r in range(data_parallel_size)
-        ]
+        # Guards the sampler-advance + _inflight bookkeeping: under the
+        # documented loader -> prefetch_to_device stack, the TRANSFER
+        # thread drives this loader's generator while the trainer thread
+        # reads consumed_samples for a checkpoint — an unlocked read
+        # could tear between the sampler advance and the _inflight
+        # increment and over-count by one undelivered batch.
+        self._count_lock = threading.Lock()
+        if backend == "process":
+            import multiprocessing as mp
+            from concurrent.futures import ProcessPoolExecutor
+
+            # spawn (not fork): the parent may hold live XLA/decode
+            # threads, and a forked child inheriting their locks can
+            # deadlock; spawned workers import only the light data
+            # modules and receive the spec once via the initializer.
+            self._pool = ProcessPoolExecutor(
+                max_workers=workers,
+                mp_context=mp.get_context(mp_start),
+                initializer=_process_worker_init,
+                initargs=(self._spec,))
+        else:
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._pool = ThreadPoolExecutor(max_workers=workers)
+        from apex_tpu.data._producer import make_dp_samplers
+
+        self.dp_ranks, self.samplers = make_dp_samplers(
+            len(dataset), local_batch, data_parallel_size,
+            consumed_samples, dp_ranks)
 
     @property
     def consumed_samples(self) -> int:
-        """Samples in batches already *yielded* to the caller.  The
-        samplers themselves run ``prefetch`` batches ahead; in-flight
+        """GLOBAL samples in batches already *yielded* to the caller.
+        The samplers themselves run ``prefetch`` batches ahead; in-flight
         (decoding, not yet delivered) batches are subtracted so a
         checkpoint taken mid-epoch resumes at the first undelivered
         batch."""
-        return (self.samplers[0].consumed_samples
-                - self._inflight * self.local_batch * self.dp)
+        with self._count_lock:
+            return (self.samplers[0].consumed_samples
+                    - self._inflight * self.local_batch * self.dp)
 
-    def close(self) -> None:
-        """Shut down the decode thread pool (idempotent).  Loaders are
-        also context managers; without either, the pool's threads live
-        for the rest of the process."""
-        self._pool.shutdown(wait=False)
+    def rewind_batches(self, n: int) -> None:
+        """Roll the samplers back ``n`` yielded batches — the resume
+        surface :class:`~apex_tpu.data.prefetch.DevicePrefetcher` uses
+        on ``close()`` so undelivered device-queued batches are replayed
+        rather than lost."""
+        with self._count_lock:
+            for s in self.samplers:
+                s.consumed_samples -= n * self.local_batch * self.dp
+
+    def warm_up(self) -> "ImageFolderLoader":
+        """Spin the decode pool to full width before the first batch.
+        For the process backend this pays the worker spawn + import cost
+        (~1-2 s for a wide pool) up front instead of inside step 1 — the
+        ``DataLoader(persistent_workers=True)`` warm-start analog.  Cheap
+        no-op-ish for threads.  Returns self (chainable)."""
+        import concurrent.futures as cf
+
+        if self.backend == "process":
+            futs = [self._pool.submit(_worker_warmup)
+                    for _ in range(self._workers)]
+        else:
+            futs = [self._pool.submit(bool) for _ in range(self._workers)]
+        cf.wait(futs, timeout=120.0)
+        return self
+
+    def close(self, timeout: float = 10.0) -> None:
+        """Shut down the decode pool (idempotent).  Loaders are also
+        context managers; without either, a thread pool's threads — or a
+        process pool's workers — live for the rest of the process.
+
+        Process workers are reaped with a BOUNDED wait: join up to
+        ``timeout`` seconds, then escalate terminate -> kill (the
+        DataService.close discipline) — a worker wedged in an
+        uninterruptible NFS/FUSE read must not hang trainer shutdown
+        (or a preemption-driven teardown) forever."""
+        # snapshot the worker handles BEFORE shutdown (the executor's
+        # management thread clears its process table as workers exit)
+        procs = (list((getattr(self._pool, "_processes", None) or {})
+                      .values())
+                 if self.backend == "process" else [])
+        self._pool.shutdown(wait=False, cancel_futures=True)
+        if self.backend != "process":
+            return
+        import time
+
+        from apex_tpu.data._producer import reap_process
+
+        deadline = time.monotonic() + timeout
+        for p in procs:
+            reap_process(p, deadline - time.monotonic(),
+                         what="decode worker")
 
     def __enter__(self) -> "ImageFolderLoader":
         return self
@@ -288,79 +517,36 @@ class ImageFolderLoader:
 
     def __del__(self):  # best-effort backstop
         try:
-            self._pool.shutdown(wait=False)
+            self._pool.shutdown(wait=False, cancel_futures=True)
         except Exception:
             pass
 
-    def _decode(self, index: int, consumed_marker: int
-                ) -> Tuple[np.ndarray, int]:
-        if self.train:
-            # fold the sample index + sampler position into the seed:
-            # deterministic but different augmentation per sample and
-            # epoch.  The position is captured at submission time so the
-            # augmentation stream is identical at every prefetch depth.
-            rng = np.random.RandomState(
-                (self.seed + consumed_marker + index) % (2 ** 31))
-        else:
-            rng = None
-        if self._native:
-            # snapshot the RNG: a native failure *after* the crop draws
-            # (e.g. truncated file) must hand PIL the same stream it
-            # would have seen had the native path never run
-            state = rng.get_state() if rng is not None else None
-            out = self._decode_native(index, rng)
-            if out is not None:
-                return out
-            if state is not None:
-                rng.set_state(state)
-        img, label = self.dataset.load(index)
-        if self.train:
-            arr = random_resized_crop(rng, img, self.image_size)
-        else:
-            arr = center_crop_resize(img, self.image_size)
-        return arr, label
+    def _submit_batch(self, indices, marker: int) -> list:
+        """Fan one batch's decode out over the pool.  Threads get
+        per-image tasks (fine-grained, no IPC); processes get ~2 chunks
+        per worker (each task's result pickles as one contiguous stack —
+        per-image IPC round trips cost a wide pool real throughput)."""
+        if self.backend == "process":
+            per = max(1, -(-len(indices) // (2 * self._workers)))
+            return [self._pool.submit(
+                        _process_decode_chunk, indices[o:o + per], marker)
+                    for o in range(0, len(indices), per)]
+        return [self._pool.submit(_decode_one, self._spec, i, marker)
+                for i in indices]
 
-    def _decode_native(self, index: int,
-                       rng: Optional[np.random.RandomState]
-                       ) -> Optional[Tuple[np.ndarray, int]]:
-        """One-call C decode+crop+resize (``_native/jpegdec.c``) — DCT
-        scaled decode fused with the transform, ~2x a PIL worker on the
-        same core.  Returns ``None`` (caller decodes via PIL) for
-        non-JPEG files or any per-image failure.  Draws the crop box
-        from the SAME :func:`sample_crop_box` stream as the PIL path, so
-        augmentation determinism is path-independent."""
-        from apex_tpu.data import _jpeg_native
-
-        path, label = self.dataset.samples[index]
-        if not path.lower().endswith((".jpg", ".jpeg")):
-            return None
-        try:
-            with open(path, "rb") as f:
-                data = f.read()
-        except OSError:
-            return None
-        dims = _jpeg_native.jpeg_dims(data)
-        if dims is None:
-            return None
-        h, w = dims
-        size = self.image_size
-        if rng is not None:  # train transform
-            x0, y0, cw, ch = sample_crop_box(rng, w, h)
-            flip = bool(rng.rand() < 0.5)
-        else:  # eval: the region center_crop_resize would keep
-            x0, y0, side = eval_crop_box(w, h, size)
-            cw = ch = side
-            flip = False
-        arr = _jpeg_native.decode_crop_resize(
-            data, y0, x0, ch, cw, size, size, hflip=flip)
-        if arr is None:
-            return None
-        return arr, label
+    def _assemble(self, futs: list) -> Tuple[np.ndarray, np.ndarray]:
+        if self.backend == "process":
+            chunks = [f.result() for f in futs]
+            return (np.concatenate([c[0] for c in chunks]),
+                    np.concatenate([c[1] for c in chunks]))
+        decoded = [f.result() for f in futs]
+        return (np.stack([d[0] for d in decoded]),
+                np.asarray([d[1] for d in decoded], np.int32))
 
     def __iter__(self) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
-        """Yield global batches, keeping ``prefetch`` future batches'
-        decode work in flight: the next batches decode on the pool while
-        the caller's train step occupies the device, and assembly at
+        """Yield batches, keeping ``prefetch`` future batches' decode
+        work in flight: the next batches decode on the pool while the
+        caller's train step occupies the device, and assembly at
         ``next()`` normally just collects already-finished futures."""
         sampler_it = zip(*self.samplers)
         pending: deque = deque()
@@ -370,17 +556,20 @@ class ImageFolderLoader:
 
         def submit_next() -> bool:
             nonlocal mine
-            per_rank = next(sampler_it, None)
-            if per_rank is None:
-                return False
-            # sampler position *after* drawing this batch — the seed the
-            # synchronous (prefetch=0) loader would have used
-            marker = self.samplers[0].consumed_samples
-            futs = [self._pool.submit(self._decode, i, marker)
-                    for rank_ids in per_rank for i in rank_ids]
-            pending.append(futs)
+            # sampler advance + marker + in-flight increment are ONE
+            # atomic section against consumed_samples reads from the
+            # trainer thread (the transfer thread runs this generator)
+            with self._count_lock:
+                per_rank = next(sampler_it, None)
+                if per_rank is None:
+                    return False
+                # sampler position *after* drawing this batch — the seed
+                # the synchronous (prefetch=0) loader would have used
+                marker = self.samplers[0].consumed_samples
+                self._inflight += 1
+            indices = [i for rank_ids in per_rank for i in rank_ids]
+            pending.append(self._submit_batch(indices, marker))
             mine += 1
-            self._inflight += 1
             return True
 
         try:
@@ -393,12 +582,10 @@ class ImageFolderLoader:
                         break
                 if not pending:
                     break
-                futs = pending.popleft()
-                decoded = [f.result() for f in futs]
-                x = np.stack([d[0] for d in decoded])
-                y = np.asarray([d[1] for d in decoded], np.int32)
+                x, y = self._assemble(pending.popleft())
                 mine -= 1
-                self._inflight -= 1
+                with self._count_lock:
+                    self._inflight -= 1
                 yield x, y
         finally:
             # abandoned iterator (break / exception): the undelivered
@@ -408,9 +595,11 @@ class ImageFolderLoader:
             for f in (f for futs in pending for f in futs):
                 f.cancel()
             if mine:
-                for s in self.samplers:
-                    s.consumed_samples -= mine * self.local_batch * self.dp
-                self._inflight -= mine
+                with self._count_lock:
+                    for s in self.samplers:
+                        s.consumed_samples -= (
+                            mine * self.local_batch * self.dp)
+                    self._inflight -= mine
 
 
 def synthetic_image_batches(batch_size: int, image_size: int,

@@ -31,19 +31,22 @@ retains translation augmentation; RandomResizedCrop's scale/aspect
 jitter is intentionally traded away (decode-free means fixed-shape
 records — the same trade DALI's fused ``decode_random_crop`` pipelines
 make when fed pre-resized shards).
+
+The producer/prefetch machinery (bounded queue, per-iteration state,
+preemption + rewind contracts) lives in
+:mod:`apex_tpu.data._producer` and is shared with the LM-side
+:class:`~apex_tpu.data.sequence.PackedSequenceLoader`.
 """
 
 from __future__ import annotations
 
 import json
-import logging
 import os
-import queue
-import threading
-from typing import Iterator, Optional, Tuple
+from typing import Optional, Tuple
 
 import numpy as np
 
+from apex_tpu.data._producer import ProducerLoader
 from apex_tpu.data.image_folder import (
     IMAGENET_MEAN,
     IMAGENET_STD,
@@ -107,24 +110,6 @@ def pack_image_folder(root_or_dataset, out_prefix: str, side: int = 232,
     return PackedImageDataset(out_prefix)
 
 
-class _ProducerError:
-    """Exception relay from the producer thread to the consuming iterator."""
-
-    def __init__(self, exc: BaseException):
-        self.exc = exc
-
-
-class _Iteration:
-    """Per-``__iter__`` state: its own stop flag, bounded queue, producer
-    thread, and count of sampler-advanced-but-undelivered batches."""
-
-    def __init__(self, prefetch: int):
-        self.stop = threading.Event()
-        self.queue: "queue.Queue" = queue.Queue(maxsize=prefetch)
-        self.thread: Optional[threading.Thread] = None
-        self.mine = 0
-
-
 class PackedImageDataset:
     """Memory-mapped view over a packed shard (see module docstring)."""
 
@@ -147,16 +132,19 @@ class PackedImageDataset:
         return self._n
 
 
-class PackedLoader:
+class PackedLoader(ProducerLoader):
     """DP-sharded train iterator over a :class:`PackedImageDataset`.
 
     Same surface and contracts as
     :class:`~apex_tpu.data.image_folder.ImageFolderLoader` — yields
-    global ``(uint8 [B, side, side, 3], int32 [B])`` with rank ``r``'s
-    shard at rows ``[r*local : (r+1)*local]``, Megatron-sampler epoch
-    shuffling, ``consumed_samples`` mid-epoch resume, context-manager
-    ``close()`` — so ``prefetch_to_device`` and the examples compose
-    unchanged.  The producer is a single background thread: per batch it
+    ``(uint8 [B, side, side, 3], int32 [B])`` with
+    ``B = local_batch * len(dp_ranks)`` and ``dp_ranks[i]``'s shard at
+    rows ``[i*local : (i+1)*local]``, Megatron-sampler epoch shuffling,
+    GLOBAL ``consumed_samples`` mid-epoch resume, context-manager
+    ``close()``, per-host ``dp_ranks`` input sharding — so
+    ``prefetch_to_device`` and the examples compose unchanged.  The
+    producer is a single background thread
+    (:class:`~apex_tpu.data._producer.ProducerLoader`): per batch it
     fancy-indexes the memmap (gather-memcpy, no codec), which one core
     sustains at chip rate; ``prefetch`` bounds the queue.
 
@@ -167,185 +155,19 @@ class PackedLoader:
 
     def __init__(self, dataset: PackedImageDataset, local_batch: int,
                  data_parallel_size: int = 1, consumed_samples: int = 0,
-                 seed: int = 0, prefetch: int = 2):
-        from apex_tpu.transformer._data import (
-            MegatronPretrainingRandomSampler,
-        )
-
+                 seed: int = 0, prefetch: int = 2, dp_ranks=None):
+        super().__init__(
+            total_samples=len(dataset), local_batch=local_batch,
+            data_parallel_size=data_parallel_size,
+            consumed_samples=consumed_samples, seed=seed,
+            prefetch=prefetch, dp_ranks=dp_ranks)
         self.dataset = dataset
-        self.local_batch = local_batch
-        self.dp = data_parallel_size
-        self.seed = seed
-        self.prefetch = max(1, prefetch)
-        self.samplers = [
-            MegatronPretrainingRandomSampler(
-                total_samples=len(dataset),
-                consumed_samples=consumed_samples,
-                local_minibatch_size=local_batch,
-                data_parallel_rank=r,
-                data_parallel_size=data_parallel_size,
-            )
-            for r in range(data_parallel_size)
-        ]
-        self._lock = threading.Lock()
-        self._active: list = []  # live _Iteration states (usually 0 or 1)
-
-    @property
-    def consumed_samples(self) -> int:
-        """Samples in batches already yielded.  Producer threads run the
-        samplers ``prefetch`` batches ahead; batches pulled but not
-        delivered (queued, mid-gather, or discarded by an early
-        ``close()``) are subtracted under the same lock the producers
-        advance under, so a checkpoint taken between steps resumes at the
-        first undelivered batch — exactly ImageFolderLoader's contract."""
-        with self._lock:
-            return (self.samplers[0].consumed_samples
-                    - sum(st.mine for st in self._active)
-                    * self.local_batch * self.dp)
-
-    def close(self) -> None:
-        """Stop every live iteration and rewind the samplers past any
-        batches gathered but never delivered, so re-iterating (or
-        resuming from ``consumed_samples``) replays exactly the
-        undelivered data — ImageFolderLoader's abandoned-iteration
-        contract."""
-        with self._lock:
-            states = list(self._active)
-        for st in states:
-            self._finish(st)
-
-    def __enter__(self) -> "PackedLoader":
-        return self
-
-    def __exit__(self, *exc) -> None:
-        self.close()
 
     def _gather(self, idx_per_rank) -> Tuple[np.ndarray, np.ndarray]:
         idx = np.concatenate(idx_per_rank)
         # single fancy-index: one gather-memcpy out of the page cache
         return (self.dataset.images[idx],
                 self.dataset.labels[idx].astype(np.int32))
-
-    def _produce(self, st: "_Iteration") -> None:
-        its = [iter(s) for s in self.samplers]
-        while not st.stop.is_set():
-            try:
-                with self._lock:
-                    idx_per_rank = [next(it) for it in its]
-                    st.mine += 1
-                batch = self._gather(idx_per_rank)
-            except StopIteration:
-                # epoch end: sentinel wakes the consumer, which returns
-                st.queue.put(None)
-                return
-            except BaseException as e:  # noqa: BLE001 — relayed, not eaten
-                # a dead producer must fail the training loop, not wedge
-                # it in queue.get() (ImageFolderLoader propagates decode
-                # errors through future.result() the same way)
-                st.queue.put(_ProducerError(e))
-                return
-            while not st.stop.is_set():
-                try:
-                    st.queue.put(batch, timeout=0.2)
-                    break
-                except queue.Full:
-                    continue
-
-    def _finish(self, st: "_Iteration") -> None:
-        """Tear down one iteration: stop+join its producer, then rewind
-        the samplers by its undelivered batches (``st.mine``)."""
-        st.stop.set()
-        if st.thread is not None:
-            # unblock a producer waiting on a full queue; drained batches
-            # stay counted in st.mine (they were never delivered)
-            try:
-                while True:
-                    st.queue.get_nowait()
-            except queue.Empty:
-                pass
-            st.thread.join(timeout=5.0)
-            # wake a consumer still blocked in queue.get() (a preempted
-            # iterator whose producer exited without a sentinel): drain
-            # anything the producer managed to enqueue before stopping,
-            # then leave one end-of-epoch sentinel
-            try:
-                while True:
-                    st.queue.get_nowait()
-            except queue.Empty:
-                pass
-            try:
-                st.queue.put_nowait(None)
-            except queue.Full:
-                pass
-            if st.thread.is_alive():
-                # a producer stuck >5 s (cold memmap page-in on a slow
-                # disk) is left daemonized but must be visible, not a
-                # silently leaked thread holding the drained queue
-                logging.getLogger(__name__).warning(
-                    "PackedLoader: producer thread did not exit within "
-                    "5 s of stop; leaking it as a daemon (likely blocked "
-                    "in a memmap gather)")
-            st.thread = None
-        with self._lock:
-            if st in self._active:
-                self._active.remove(st)
-            undelivered, st.mine = st.mine, 0
-            if undelivered:
-                for s in self.samplers:
-                    s.consumed_samples -= (
-                        undelivered * self.local_batch * self.dp)
-
-    def __iter__(self) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
-        # one epoch per __iter__ call, mirroring ImageFolderLoader: the
-        # samplers hold position, so re-iterating starts the next epoch.
-        # All iteration state is per-call so overlapping/abandoned
-        # iterators never share a stop flag or queue — but the SAMPLERS
-        # are shared, so two *live* producers would interleave duplicate
-        # index streams while double-advancing consumed_samples.  Only
-        # one live iteration is supported (as with ImageFolderLoader):
-        # starting a new one first tears down any still-active prior
-        # iteration (covers abandoned, un-GC'd generators) and rewinds
-        # its undelivered batches.
-        with self._lock:
-            stale = list(self._active)
-        for old in stale:
-            self._finish(old)
-        st = _Iteration(self.prefetch)
-        with self._lock:
-            self._active.append(st)
-        st.thread = threading.Thread(
-            target=self._produce, args=(st,), daemon=True)
-        st.thread.start()
-        try:
-            while True:
-                # poll-with-timeout rather than a bare blocking get: a
-                # preempted iteration (stop set by a newer __iter__) must
-                # terminate even if its wake-up sentinel was lost to a
-                # racing put from a slow-to-exit producer
-                try:
-                    batch = st.queue.get(timeout=0.5)
-                except queue.Empty:
-                    if st.stop.is_set():
-                        return
-                    continue
-                if batch is None:
-                    return
-                if isinstance(batch, _ProducerError):
-                    raise batch.exc
-                with self._lock:
-                    # check-and-decrement must be one atomic section:
-                    # _finish (a competing __iter__ or close()) sets stop,
-                    # rewinds the samplers and zeroes st.mine under this
-                    # same lock — a stop check outside it could pass just
-                    # before the teardown, and the decrement after it
-                    # would both deliver an already-rewound batch twice
-                    # and drive st.mine to -1
-                    if st.stop.is_set():
-                        return
-                    st.mine -= 1
-                yield batch
-        finally:
-            self._finish(st)
 
 
 # ---------------------------------------------------------------------------
@@ -370,24 +192,27 @@ def random_crop_flip(images_u8, key, out_size: int,
     import jax
     import jax.numpy as jnp
 
+    from apex_tpu.observability.spans import named_span
+
     b, s = images_u8.shape[0], images_u8.shape[1]
     margin = s - out_size
     if margin < 0:
         raise ValueError(f"out_size {out_size} > stored side {s}")
-    k_h, k_w, k_f = jax.random.split(key, 3)
-    off_h = jax.random.randint(k_h, (b,), 0, margin + 1)
-    off_w = jax.random.randint(k_w, (b,), 0, margin + 1)
-    flip = jax.random.bernoulli(k_f, 0.5, (b,))
+    with named_span("data/augment"):
+        k_h, k_w, k_f = jax.random.split(key, 3)
+        off_h = jax.random.randint(k_h, (b,), 0, margin + 1)
+        off_w = jax.random.randint(k_w, (b,), 0, margin + 1)
+        flip = jax.random.bernoulli(k_f, 0.5, (b,))
 
-    def one(img, oh, ow, fl):
-        crop = jax.lax.dynamic_slice(img, (oh, ow, 0),
-                                     (out_size, out_size, 3))
-        return jnp.where(fl, crop[:, ::-1, :], crop)
+        def one(img, oh, ow, fl):
+            crop = jax.lax.dynamic_slice(img, (oh, ow, 0),
+                                         (out_size, out_size, 3))
+            return jnp.where(fl, crop[:, ::-1, :], crop)
 
-    cropped = jax.vmap(one)(images_u8, off_h, off_w, flip)
-    # same arithmetic as the online path so --packed is not a numerics
-    # A/B confounder
-    return normalize_on_device(cropped, mean, std, dtype)
+        cropped = jax.vmap(one)(images_u8, off_h, off_w, flip)
+        # same arithmetic as the online path so --packed is not a numerics
+        # A/B confounder
+        return normalize_on_device(cropped, mean, std, dtype)
 
 
 def center_crop(images_u8, out_size: int, mean=IMAGENET_MEAN,

@@ -38,6 +38,7 @@ from apex_tpu.parallel.distributed import (  # noqa: F401
     zero_data_parallel_train_step,
     zero_init,
     dp_shard_batch,
+    host_dp_ranks,
     replicate,
 )
 from apex_tpu.parallel.sync_batchnorm import (  # noqa: F401

@@ -33,6 +33,7 @@ from typing import Any, Callable, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from apex_tpu.parallel import collectives as cc
@@ -46,6 +47,7 @@ __all__ = [
     "zero_data_parallel_train_step",
     "zero_init",
     "dp_shard_batch",
+    "host_dp_ranks",
     "replicate",
 ]
 
@@ -85,23 +87,109 @@ def all_reduce_gradients(
     return jax.tree_util.tree_map(leaf, grads)
 
 
-def dp_shard_batch(batch, mesh=None):
+def host_dp_ranks(mesh=None):
+    """The GLOBAL data-parallel shard indices (flat over ``(dcn, dp)``,
+    dcn-major — the order :func:`dp_shard_batch` lays rows in) whose
+    devices THIS process hosts, sorted ascending.
+
+    The per-host input-sharding contract: a multi-process job gives each
+    loader ``dp_ranks=host_dp_ranks(mesh)`` so every host decodes only
+    its own shards (no redundant global decode), then places them with
+    ``dp_shard_batch(batch, mesh, local_ranks=host_dp_ranks(mesh))``.
+    Single-process: all ranks — the loaders' default degenerates to the
+    global batch.
+    """
+    if mesh is None:
+        mesh = mesh_lib.get_mesh()
+    proc = jax.process_index()
+    names = mesh.axis_names
+    dp_size = mesh.shape.get(mesh_lib.DATA_AXIS, 1)
+    ranks = set()
+    devs = np.asarray(mesh.devices)
+    for coord in np.ndindex(devs.shape):
+        if devs[coord].process_index != proc:
+            continue
+        flat = 0
+        for name, c in zip(names, coord):
+            if name == mesh_lib.DCN_AXIS:
+                flat += c * dp_size
+            elif name == mesh_lib.DATA_AXIS:
+                flat += c
+        ranks.add(flat)
+    return sorted(ranks)
+
+
+def dp_shard_batch(batch, mesh=None, *, local_ranks=None):
     """Place a host batch sharded along the data-parallel axes (leading
     dim over ``(dcn, dp)`` — the outer/cross-slice axis is size 1 on a
-    single slice, so this is correct at any scale)."""
+    single slice, so this is correct at any scale).
+
+    ``local_ranks`` (multi-host input sharding): the batch holds only the
+    rows of THIS process's dp shards — ``len(local_ranks)`` equal
+    windows, window ``i`` belonging to global dp rank ``local_ranks[i]``
+    (use :func:`host_dp_ranks`).  The leaves are assembled into GLOBAL
+    arrays via ``jax.make_array_from_single_device_arrays``: each
+    addressable device receives exactly its shard's rows, no host ever
+    materializes (or decodes) the global batch.  Every process must call
+    this collectively with its own rows.  ``local_ranks=None`` (default,
+    single-host) places the full global batch as before.
+    """
     if mesh is None:
         mesh = mesh_lib.get_mesh()
     dp_axes = tuple(a for a in (mesh_lib.DCN_AXIS, mesh_lib.DATA_AXIS)
                     if a in mesh.shape)
 
-    def leaf(x):
-        if jnp.ndim(x) == 0:  # scalars (e.g. a mixup lambda) replicate
-            spec = P()
-        else:
-            spec = P(dp_axes, *([None] * (jnp.ndim(x) - 1)))
-        return jax.device_put(x, NamedSharding(mesh, spec))
+    if local_ranks is None:
+        def leaf(x):
+            if jnp.ndim(x) == 0:  # scalars (e.g. a mixup lambda) replicate
+                spec = P()
+            else:
+                spec = P(dp_axes, *([None] * (jnp.ndim(x) - 1)))
+            return jax.device_put(x, NamedSharding(mesh, spec))
 
-    return jax.tree_util.tree_map(leaf, batch)
+        return jax.tree_util.tree_map(leaf, batch)
+
+    local_ranks = list(local_ranks)
+    dp_world = 1
+    for a in dp_axes:
+        dp_world *= mesh.shape[a]
+    rank_pos = {r: i for i, r in enumerate(local_ranks)}
+
+    def local_leaf(x):
+        x = np.asarray(x)
+        if x.ndim == 0:
+            spec = P()
+            global_shape = ()
+        else:
+            if x.shape[0] % len(local_ranks):
+                raise ValueError(
+                    f"local batch dim {x.shape[0]} not divisible by "
+                    f"len(local_ranks)={len(local_ranks)}")
+            per = x.shape[0] // len(local_ranks)
+            spec = P(dp_axes, *([None] * (x.ndim - 1)))
+            global_shape = (per * dp_world,) + x.shape[1:]
+        sharding = NamedSharding(mesh, spec)
+        idx_map = sharding.addressable_devices_indices_map(global_shape)
+        arrays = []
+        for dev, idx in idx_map.items():
+            if x.ndim == 0:
+                piece = x
+            else:
+                start = idx[0].start or 0
+                rank = start // per
+                if rank not in rank_pos:
+                    raise ValueError(
+                        f"device {dev} holds global dp shard {rank}, "
+                        f"which local_ranks={local_ranks} does not cover "
+                        "— pass host_dp_ranks(mesh) and give the loader "
+                        "the same dp_ranks")
+                pos = rank_pos[rank]
+                piece = x[pos * per:(pos + 1) * per]
+            arrays.append(jax.device_put(piece, dev))
+        return jax.make_array_from_single_device_arrays(
+            global_shape, sharding, arrays)
+
+    return jax.tree_util.tree_map(local_leaf, batch)
 
 
 def replicate(tree, mesh=None):

@@ -102,6 +102,7 @@ def build_gpt_3d(
     tp_axis: str = TENSOR_AXIS,
     moe_aux_coeff: float = 1e-2,
     remat_ticks=None,
+    packed_inputs: bool = False,
 ):
     """Return ``(init_fn, train_step, param_specs_fn)``.
 
@@ -118,6 +119,19 @@ def build_gpt_3d(
     ``remat_ticks``: forward to :func:`pipeline_apply` for the 1F1B-class
     live-activation bound (grouped-tick remat); the train step must run
     under ``jax.jit`` (it should anyway).
+
+    ``packed_inputs``: the real-data ingestion mode for
+    :class:`~apex_tpu.data.sequence.PackedSequenceLoader` streams — the
+    ``tokens`` argument of the loss/step becomes the loader's
+    ``(tokens [b, s], segment_ids [b, s])`` pair (both dp-sharded), and
+    the next-token loss is masked with
+    :func:`~apex_tpu.data.sequence.segment_loss_mask` so no position
+    predicts across a document boundary or into padding.  The loss
+    becomes masked-sum / masked-count (accumulated across microbatches),
+    the attention stays plain causal (the standard packed pre-training
+    trade; the segment ids carry enough information for block-diagonal
+    masks later).  Everything else — pipeline, sentinel, telemetry,
+    collective budget — is unchanged.
     """
     cfg = config
     if mesh is None:
@@ -183,8 +197,10 @@ def build_gpt_3d(
                             final_ln=ln_specs)
         return params, specs
 
-    def _local_loss(p: GPT3DParams, tokens, with_aux: bool = False):
+    def _local_loss(p: GPT3DParams, batch, with_aux: bool = False):
         """Mean LM loss of the local dp shard; runs with dp/pp/tp bound.
+        With ``packed_inputs`` the batch is ``(tokens, segment_ids)`` and
+        the mean is the segment-masked one (see :func:`build_gpt_3d`).
 
         Returns a ``(1,)``-shaped array, NOT a scalar: jax 0.4.x's
         old-style shard_map cannot name-check rank-0 values crossing the
@@ -199,6 +215,11 @@ def build_gpt_3d(
         collective the aux vector needs is the *widened* form of one the
         bare path already performs (never an extra op — the
         instrumented/bare HLO compare in tests/test_observability.py)."""
+        if packed_inputs:
+            tokens, segments = batch
+            seg_mbs = split_into_microbatches(segments, num_microbatches)
+        else:
+            tokens = batch
         mbs = split_into_microbatches(tokens, num_microbatches)
 
         def embed_one(t):
@@ -224,15 +245,40 @@ def build_gpt_3d(
             params_already_local=True, remat_ticks=remat_ticks,
         )
 
-        def head_one(hid, t):
+        def logits_of(hid):
             hid = final_ln.apply({"params": p.final_ln}, hid)
-            logits = parallel_lm_logits(
+            return parallel_lm_logits(
                 hid, p.embedding["word_embeddings"]["embedding"], cfg
             )
-            return jnp.mean(gpt_next_token_loss(logits, t, cfg))
 
-        losses = jax.vmap(head_one)(out, mbs)
-        ce = jnp.mean(losses).reshape(1)
+        if packed_inputs:
+            from apex_tpu.data.sequence import segment_loss_mask
+
+            def head_one(hid, t, seg):
+                per_tok = gpt_next_token_loss(logits_of(hid), t, cfg)
+                m = segment_loss_mask(seg)
+                # (1,)-shaped like every scalar on the loss tail (the
+                # old-shard_map _check_names constraint below)
+                return (jnp.sum(per_tok * m).reshape(1),
+                        jnp.sum(m).reshape(1))
+
+            sums, counts = jax.vmap(head_one)(out, mbs, seg_mbs)
+            # Leave the shard as [masked_sum, masked_count] — the
+            # DIVISION happens outside the dp reduction (make_loss_fn):
+            # a dp mean of per-shard ratios would equal-weight shards
+            # whatever their real-token count, but mean-of-sums over
+            # mean-of-counts is exactly global-sum/global-count (the dp
+            # divisor cancels), so unevenly padded shards weigh by
+            # their real tokens.
+            ce = jnp.concatenate([jnp.sum(sums).reshape(1),
+                                  jnp.maximum(jnp.sum(counts),
+                                              1.0).reshape(1)])
+        else:
+            def head_one(hid, t):
+                return jnp.mean(gpt_next_token_loss(logits_of(hid), t, cfg))
+
+            losses = jax.vmap(head_one)(out, mbs)
+            ce = jnp.mean(losses).reshape(1)
         # Telemetry rider: the per-microbatch aux vector is observational
         # only — stop_gradient keeps the differentiated subgraph (and so
         # the grads, bit for bit) identical to the bare path.  Dense
@@ -267,10 +313,23 @@ def build_gpt_3d(
                     aux_term, aux_mb = red[:1], red[1:]
                 else:
                     aux_term = cc.all_reduce(aux_term, tp_axis, "mean")
-            ce = ce + moe_aux_coeff * aux_term
+            if packed_inputs:
+                # packed ce is [sum, count] — the aux term cannot be
+                # added to a sum; it rides out as a third element and is
+                # composed after the division (make_loss_fn)
+                ce = jnp.concatenate([ce, aux_term])
+            else:
+                ce = ce + moe_aux_coeff * aux_term
         if with_aux:
             return jnp.concatenate([ce, aux_mb])
         return ce
+
+    def _batch_spec():
+        """dp-sharded spec for the batch argument — a single tokens array,
+        or the (tokens, segments) pair under ``packed_inputs``."""
+        if packed_inputs:
+            return (P(dp_axis), P(dp_axis))
+        return P(dp_axis)
 
     def make_loss_fn(param_specs):
         """Global (dp-mean) loss over global arrays.
@@ -295,12 +354,21 @@ def build_gpt_3d(
             lambda p, t: cc.all_reduce(
                 _local_loss(p, t), dp_axis, "mean"),
             mesh=mesh,
-            in_specs=(param_specs, P(dp_axis)),
+            in_specs=(param_specs, _batch_spec()),
             out_specs=P(None),
         )
 
         def loss_fn(params, tokens):
-            return jnp.squeeze(inner(params, tokens), axis=0)
+            vec = inner(params, tokens)
+            if not packed_inputs:
+                return jnp.squeeze(vec, axis=0)
+            # [sum, count(, aux_term)] dp-mean-reduced: mean-of-sums /
+            # mean-of-counts IS global-sum/global-count (dp cancels) —
+            # the exact masked mean, however unevenly padding lands
+            loss = vec[0] / vec[1]
+            if cfg.num_experts is not None:
+                loss = loss + moe_aux_coeff * vec[2]
+            return loss
 
         return loss_fn
 
@@ -319,13 +387,20 @@ def build_gpt_3d(
             lambda p, t: cc.all_reduce(
                 _local_loss(p, t, with_aux=True), dp_axis, "mean"),
             mesh=mesh,
-            in_specs=(param_specs, P(dp_axis)),
+            in_specs=(param_specs, _batch_spec()),
             out_specs=P(None),
         )
 
         def loss_fn(params, tokens):
             vec = inner(params, tokens)
-            return vec[0], vec[1:]
+            if not packed_inputs:
+                return vec[0], vec[1:]
+            loss = vec[0] / vec[1]  # exact global masked mean (above)
+            base = 2
+            if cfg.num_experts is not None:
+                loss = loss + moe_aux_coeff * vec[base]
+                base += 1
+            return loss, vec[base:]
 
         return loss_fn
 

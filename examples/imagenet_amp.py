@@ -92,6 +92,12 @@ def main(argv=None):
     p.add_argument("--lr", type=float, default=0.1)
     p.add_argument("--steps", type=int, default=20)
     p.add_argument("--workers", type=int, default=8)
+    p.add_argument("--backend", default="thread",
+                   choices=["thread", "process"],
+                   help="decode worker backend for the --data (JPEG) "
+                        "path: 'process' is the true DataLoader("
+                        "num_workers) analog (no GIL), 'thread' the "
+                        "lower-fixed-cost fallback (docs/data.md)")
     p.add_argument("--packed", default=None, metavar="PREFIX",
                    help="train from a packed (decode-free) shard at "
                         "PREFIX (apex_tpu.data.packed). Missing shard + "
@@ -174,12 +180,20 @@ def main(argv=None):
         raise SystemExit(
             f"--batch-size {args.batch_size} must be divisible by the "
             f"data-parallel world size ({dp})")
-    def epochs(loader):
-        # re-iterating resumes from consumed_samples -> next epoch
-        # permutation (the reference's `for epoch in range(...)` loop)
-        while True:
-            yield from loader
+    # Per-host input sharding: each process decodes only the dp shards
+    # its own devices hold (no redundant global decode) and places them
+    # with dp_shard_batch(local_ranks=...).  Single-process: all ranks,
+    # identical to the plain global placement.
+    host_ranks = parallel.host_dp_ranks(mesh)
+    host_sharded = len(host_ranks) < dp
+    place = None
+    if host_sharded:
+        from apex_tpu.parallel import dp_shard_batch
 
+        place = lambda b: dp_shard_batch(  # noqa: E731
+            b, mesh, local_ranks=host_ranks)
+        print(f"per-host input sharding: this process decodes dp ranks "
+              f"{host_ranks} of {dp}")
     loader = None
     if args.packed is not None:
         import os
@@ -212,32 +226,55 @@ def main(argv=None):
         print(f"Packed shard: {len(pds)} samples at side {pds.side}, "
               f"{len(pds.classes)} classes, dp={dp}")
         loader = PackedLoader(pds, local_batch=args.batch_size // dp,
-                              data_parallel_size=dp)
-        it = epochs(loader)
+                              data_parallel_size=dp,
+                              dp_ranks=host_ranks if host_sharded else None)
     elif args.data is not None:
         dataset = ImageFolder(_split_dir(args.data, "train"))
         _check_num_classes(dataset.classes, args)
         print(f"ImageFolder: {len(dataset)} samples, "
-              f"{len(dataset.classes)} classes, dp={dp}")
+              f"{len(dataset.classes)} classes, dp={dp}, "
+              f"backend={args.backend}")
         loader = ImageFolderLoader(
             dataset, local_batch=args.batch_size // dp,
             data_parallel_size=dp, image_size=args.image_size,
-            workers=args.workers)
-        it = epochs(loader)
+            workers=args.workers, backend=args.backend,
+            dp_ranks=host_ranks if host_sharded else None)
     else:
-        it = synthetic_image_batches(args.batch_size, args.image_size,
-                                     args.num_classes)
+        synth = synthetic_image_batches(args.batch_size, args.image_size,
+                                        args.num_classes)
 
-    # H2D transfers issue 2 batches ahead of the step loop (the reference
-    # data_prefetcher's side-stream role; device_put is async under JAX)
-    dev_it = prefetch_to_device(it, mesh, depth=2)
+    # H2D transfers run on the prefetcher's dedicated thread, 2 batches
+    # ahead of the step loop (the reference data_prefetcher's side-stream
+    # role; device_put is async under JAX), while the loader's decode
+    # pool fills the batch after — stalls land in the data/stall_ms gauge.
+    # The composition contract (docs/data.md): the prefetcher wraps the
+    # LOADER directly — it is one epoch like the loader, so on epoch end
+    # it is re-wrapped (close(close_source=False) keeps the decode pool).
+    # The local_ranks placement applies ONLY to the loader branches (they
+    # were built with dp_ranks=host_ranks); the synthetic stream yields
+    # the GLOBAL batch on every host and uses the default placement.
+    def wrap():
+        if loader is not None:
+            return prefetch_to_device(loader, mesh, depth=2, place=place)
+        return prefetch_to_device(synth, mesh, depth=2)
+
+    dev_it = wrap()
+
+    def next_batch():
+        nonlocal dev_it
+        while True:
+            try:
+                return next(dev_it)
+            except StopIteration:  # epoch end: next epoch's permutation
+                dev_it.close(close_source=False)
+                dev_it = wrap()
 
     t0 = time.perf_counter()
     loss = None
     try:
         aug_key = jax.random.PRNGKey(17)
         for i in range(args.steps):
-            batch = next(dev_it)
+            batch = next_batch()
             params, batch_stats, opt_state, loss = train_step(
                 params, batch_stats, opt_state, batch,
                 jax.random.fold_in(aug_key, i)
@@ -249,11 +286,18 @@ def main(argv=None):
                 print(f"step {i:4d} loss {float(loss):.4f}")
         jax.block_until_ready(loss)
     finally:
-        if loader is not None:
-            loader.close()  # reclaim the decode threads
+        dev_it.close()  # passthrough reclaims the decode pool too
     dt = time.perf_counter() - t0
     ips = args.batch_size * (args.steps - 1) / dt if args.steps > 1 else 0.0
     print(f"throughput: {ips:.1f} images/sec ({dt:.2f}s for {args.steps-1} steps)")
+    # in-run input-stall telemetry (docs/data.md stall cookbook): the
+    # prefetcher recorded every next() block into the default registry
+    from apex_tpu.observability import default_registry
+
+    hist = default_registry().histogram("span_ms/data/next_wait")
+    if hist.count:
+        print(f"input stall: mean {hist.mean:.2f} ms/step "
+              f"(max {hist.max:.2f} ms over {hist.count} steps)")
 
     if args.evaluate:
         prec1, preck, k = validate(model, params, batch_stats, policy,

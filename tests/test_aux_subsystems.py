@@ -1,8 +1,12 @@
 """Aux subsystems: timers export, autoresume protocol, rank logger
-(SURVEY §5 tracing / failure-detection / observability rows)."""
+(SURVEY §5 tracing / failure-detection / observability rows), and the
+input-pipeline smoke script (ISSUE 8 CI satellite)."""
 
 import json
 import logging
+import os
+import subprocess
+import sys
 
 import pytest
 
@@ -100,3 +104,25 @@ def test_rank_logger_stamps_rank_info():
     out = buf.getvalue()
     assert "hello from the library logger" in out
     assert "[0/1]" in out  # rank info stamped by RankInfoFormatter
+
+
+def test_data_pipeline_smoke_script(tmp_path):
+    """scripts/data_pipeline_smoke.sh end to end (the telemetry_smoke
+    wiring pattern): process-pool decode + double-buffered prefetch must
+    show nonzero overlap, the packed LM stream must flow through a
+    DataService, and shutdown must leak no worker processes.  Subprocess
+    because the process-pool spawn re-imports __main__ and the smoke
+    owns its own platform pinning."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    env["PYTHON"] = sys.executable
+    proc = subprocess.run(
+        ["bash", os.path.join(repo, "scripts", "data_pipeline_smoke.sh"),
+         str(tmp_path / "work")],
+        cwd=repo, env=env, capture_output=True, timeout=240)
+    assert proc.returncode == 0, (
+        f"data_pipeline_smoke.sh rc={proc.returncode}\n"
+        f"stderr tail:\n{proc.stderr.decode(errors='replace')[-3000:]}")
+    assert b"PASS" in proc.stderr

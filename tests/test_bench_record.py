@@ -53,9 +53,16 @@ def _worst_case_results():
                                "vs_bare": 1.012},
         "gpt_flash_fp8": {"value": 4112.3, "unit": "tokens/sec/chip"},
         "gpt_long_context": {"value": 2580.7, "unit": "tokens/sec/chip"},
-        "input_pipeline": {"value": 9685.0, "unit": "images/sec"},
+        "input_pipeline": {
+            "value": 9685.0, "unit": "images/sec",
+            # ISSUE 8 sub-rows: backend A/B, per-path stall, LM stream
+            "loader_ips_per_backend": {"thread": 4211.5, "process": 9685.0},
+            "stall_ms_per_step": {"thread": 241.31, "process": 98.22,
+                                  "packed": 0.02},
+            "packed_lm_tokens_per_sec": 18273451.9},
         "real_data_rn50": {"value": 6113.9, "unit": "images/sec/chip",
-                           "vs_synthetic": 0.693},
+                           "vs_synthetic": 0.693,
+                           "stall_ms_per_step": 12.07},
     }
     for r in rows.values():
         r["platform"] = "cpu"
@@ -94,6 +101,12 @@ def test_compact_record_under_1500_bytes():
     assert compact["rows"]["ckpt_save_restore"]["vs_sharded"] == 1.113
     assert compact["rows"]["ckpt_reshard"]["vs_same_mesh"] == 1.74
     assert compact["rows"]["telemetry_overhead"]["vs_bare"] == 1.012
+    # ISSUE 8 input-pipeline sub-rows survive the distillation
+    ip = compact["rows"]["input_pipeline"]
+    assert ip["loader_ips_per_backend"]["process"] == 9685.0
+    assert ip["stall_ms_per_step"]["packed"] == 0.02
+    assert ip["packed_lm_tokens_per_sec"] == 18273451.9
+    assert compact["rows"]["real_data_rn50"]["stall_ms_per_step"] == 12.07
 
 
 def test_compact_record_degrades_instead_of_overflowing():

@@ -1,0 +1,287 @@
+"""Packed-sequence LM streaming (apex_tpu.data.sequence): pack round
+trip, loader contracts (shared ProducerLoader machinery), segment loss
+masks, and ingestion into the ZeRO and 3D GPT trainers — the LM paths'
+first real-data input pipeline (ISSUE 8 tentpole layer 3)."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from apex_tpu.data import (  # noqa: E402
+    PackedSequenceDataset,
+    PackedSequenceLoader,
+    pack_token_documents,
+    prefetch_to_device,
+    segment_loss_mask,
+    synthetic_token_documents,
+)
+
+VOCAB, SEQ, EOS = 64, 32, 63
+
+
+@pytest.fixture(scope="module")
+def docs():
+    return synthetic_token_documents(48, vocab=VOCAB, mean_len=20, seed=0)
+
+
+@pytest.fixture(scope="module")
+def packed(docs, tmp_path_factory):
+    prefix = str(tmp_path_factory.mktemp("seq") / "train")
+    ds = pack_token_documents(docs, prefix, seq_len=SEQ, eos_id=EOS)
+    return prefix, ds
+
+
+def test_pack_round_trip(docs, packed):
+    """Concatenated non-padding tokens reproduce the document stream
+    exactly — packing loses nothing and pads only the final row tail."""
+    prefix, ds = packed
+    stream = np.concatenate([np.asarray(d + [EOS], np.int32) for d in docs])
+    flat_tok = np.asarray(ds.tokens).ravel()
+    flat_seg = np.asarray(ds.segments).ravel()
+    np.testing.assert_array_equal(flat_tok[flat_seg > 0], stream)
+    # padding exists only at the very tail (one partial final row)
+    pad = np.flatnonzero(flat_seg == 0)
+    if pad.size:
+        assert pad[0] == flat_seg.size - pad.size
+    # a fresh open sees the same bytes
+    ds2 = PackedSequenceDataset(prefix)
+    assert ds2.seq_len == SEQ and len(ds2) == len(ds)
+    np.testing.assert_array_equal(np.asarray(ds2.tokens),
+                                  np.asarray(ds.tokens))
+
+
+def test_segments_mark_document_boundaries(packed):
+    _, ds = packed
+    seg = np.asarray(ds.segments)
+    # per-row ids are 1-based and contiguous; 0 only as tail padding
+    for row in seg:
+        ids = row[row > 0]
+        uniq = np.unique(ids)
+        np.testing.assert_array_equal(uniq, np.arange(1, uniq.size + 1))
+        # non-decreasing within a row (documents are laid out in order)
+        assert (np.diff(ids) >= 0).all()
+
+
+def test_pack_rejects_empty_and_bad_version(tmp_path):
+    import json
+
+    with pytest.raises(ValueError):
+        pack_token_documents([], str(tmp_path / "out"), seq_len=8)
+    prefix = str(tmp_path / "bad")
+    with open(prefix + ".json", "w") as f:
+        json.dump({"n": 1, "seq_len": 8, "n_docs": 1, "version": 99}, f)
+    with pytest.raises(ValueError, match="version"):
+        PackedSequenceDataset(prefix)
+
+
+def test_loader_shapes_and_disjoint_dp_shards(packed):
+    _, ds = packed
+    with PackedSequenceLoader(ds, local_batch=4,
+                              data_parallel_size=2) as loader:
+        tokens, segments = next(iter(loader))
+    assert tokens.shape == (8, SEQ) and tokens.dtype == np.int32
+    assert segments.shape == (8, SEQ) and segments.dtype == np.int32
+    fresh = PackedSequenceLoader(ds, local_batch=4, data_parallel_size=2)
+    idx = [next(iter(s)) for s in fresh.samplers]
+    assert not set(idx[0]) & set(idx[1]), "dp shards overlap"
+    np.testing.assert_array_equal(tokens[:4], ds.tokens[idx[0]])
+    np.testing.assert_array_equal(tokens[4:], ds.tokens[idx[1]])
+    fresh.close()
+
+
+def test_loader_resume_contract(packed):
+    """The ProducerLoader contracts hold for the sequence subclass:
+    consumed_samples counts yielded batches only, and a fresh loader
+    from the checkpoint continues bit-exact."""
+    _, ds = packed
+    loader = PackedSequenceLoader(ds, local_batch=4)
+    it = iter(loader)
+    for _ in range(3):
+        next(it)
+    consumed = loader.consumed_samples
+    assert consumed == 12
+    loader.close()
+    with PackedSequenceLoader(ds, local_batch=4,
+                              consumed_samples=consumed) as l2:
+        nxt = next(iter(l2))
+    with PackedSequenceLoader(ds, local_batch=4) as l3:
+        it3 = iter(l3)
+        for _ in range(3):
+            next(it3)
+        expect = next(it3)
+    np.testing.assert_array_equal(nxt[0], expect[0])
+    np.testing.assert_array_equal(nxt[1], expect[1])
+
+
+def test_dp_ranks_host_shard_is_global_batch_window(packed):
+    """A dp_ranks-restricted loader yields exactly its ranks' windows of
+    the full loader's global batch — the per-host no-redundant-decode
+    contract."""
+    _, ds = packed
+    with PackedSequenceLoader(ds, local_batch=2,
+                              data_parallel_size=2) as full, \
+            PackedSequenceLoader(ds, local_batch=2, data_parallel_size=2,
+                                 dp_ranks=[1]) as host1:
+        t_full, s_full = next(iter(full))
+        t_h1, s_h1 = next(iter(host1))
+    assert t_h1.shape == (2, SEQ)
+    np.testing.assert_array_equal(t_h1, t_full[2:])
+    np.testing.assert_array_equal(s_h1, s_full[2:])
+    # consumed_samples stays GLOBAL on the host-sharded loader
+    assert host1.consumed_samples == full.consumed_samples == 4
+
+
+def test_segment_loss_mask_semantics():
+    seg = np.array([[1, 1, 2, 2, 0, 0]], np.int32)
+    m = segment_loss_mask(seg)
+    # positions: (1,1)=1 same doc; (1,2)=0 boundary; (2,2)=1; (2,0)=0 pad;
+    # (0,0)=0 pad
+    np.testing.assert_array_equal(m, [[1.0, 0.0, 1.0, 0.0, 0.0]])
+
+
+def test_device_prefetch_composition(packed):
+    _, ds = packed
+    with PackedSequenceLoader(ds, local_batch=4) as loader:
+        pf = prefetch_to_device(loader, depth=1, place=lambda b: b)
+        t, s = next(pf)
+        assert t.shape == (4, SEQ)
+        assert pf.consumed_samples == 4
+        pf.close()
+    # close() passthrough + rewind: loader agrees with the wrapper
+    assert loader.consumed_samples == 4
+
+
+def test_zero_trainer_ingests_packed_stream(packed):
+    """The ZeRO data-parallel step consumes (tokens, segments) batches
+    directly (its batch handling is pytree-generic): a tiny embedding LM
+    with a segment-masked next-token loss trains on the real stream."""
+    from apex_tpu import parallel
+    from apex_tpu.contrib.optimizers import DistributedFusedAdam
+    from apex_tpu.parallel.distributed import (
+        zero_data_parallel_train_step,
+        zero_init,
+    )
+
+    _, ds = packed
+    mesh = parallel.initialize_model_parallel()  # dp=8
+    try:
+        def loss_fn(params, batch):
+            tokens, segments = batch
+            h = params["emb"][tokens]                       # [b, s, d]
+            logits = jnp.einsum("bsd,vd->bsv", h, params["emb"])
+            logp = jax.nn.log_softmax(logits[:, :-1])
+            tgt = tokens[:, 1:]
+            nll = -jnp.take_along_axis(
+                logp, tgt[..., None], axis=-1)[..., 0]      # [b, s-1]
+            m = segment_loss_mask(segments)
+            return jnp.sum(nll * m) / jnp.maximum(jnp.sum(m), 1.0)
+
+        params = {"emb": jnp.asarray(
+            np.random.RandomState(0).randn(VOCAB, 16), jnp.float32)}
+        opt = DistributedFusedAdam(lr=1e-2)
+        state = zero_init(opt, params, mesh)
+        step = zero_data_parallel_train_step(loss_fn, opt, mesh=mesh)
+
+        with PackedSequenceLoader(ds, local_batch=1,
+                                  data_parallel_size=8) as loader:
+            dev = prefetch_to_device(loader, mesh, depth=2)
+            losses = []
+            for _ in range(3):
+                batch = next(dev)
+                params, state, loss = step(params, state, batch)
+                losses.append(float(loss))
+            dev.close(close_source=False)
+        assert all(np.isfinite(losses)), losses
+    finally:
+        parallel.mesh.destroy_model_parallel()
+
+
+@pytest.mark.slow
+def test_gpt3d_packed_inputs_end_to_end(packed):
+    """build_gpt_3d(packed_inputs=True) trains from the real packed
+    stream on the full dp x pp x tp(+sp) mesh."""
+    from apex_tpu import parallel
+    from apex_tpu.optimizers import FusedAdam
+    from apex_tpu.transformer.testing.gpt_parallel_train import build_gpt_3d
+    from apex_tpu.transformer.testing.standalone_transformer_lm import (
+        TransformerConfig,
+    )
+
+    _, ds = packed
+    mesh = parallel.initialize_model_parallel(
+        tensor_model_parallel_size=2, pipeline_model_parallel_size=2)
+    try:
+        cfg = TransformerConfig(
+            hidden_size=32, num_layers=2, num_attention_heads=4,
+            padded_vocab_size=VOCAB, max_position_embeddings=SEQ,
+            hidden_dropout=0.0, attention_dropout=0.0,
+            tensor_axis="tp", sequence_parallel=True)
+        init_fn, _, make_train_step = build_gpt_3d(
+            cfg, num_microbatches=2, mesh=mesh, packed_inputs=True)
+        params, specs = init_fn(jax.random.PRNGKey(0),
+                                jnp.zeros((8, SEQ), jnp.int32))
+        opt = FusedAdam(lr=1e-3)
+        opt_state = opt.init(params)
+        step = jax.jit(make_train_step(opt, specs))
+        with PackedSequenceLoader(ds, local_batch=4,
+                                  data_parallel_size=2) as loader:
+            dev = prefetch_to_device(loader, mesh, depth=2)
+            losses = []
+            for _ in range(2):
+                params, opt_state, loss = step(params, opt_state, next(dev))
+                losses.append(float(loss))
+            dev.close(close_source=False)
+        assert all(np.isfinite(losses)), losses
+        assert loader.consumed_samples == 16
+    finally:
+        parallel.mesh.destroy_model_parallel()
+
+
+def test_gpt3d_packed_loss_matches_manual_mask():
+    """packed_inputs loss == hand-masked serial computation on a dp-only
+    mesh (pp=tp=1): the ingestion path changes only the masking."""
+    from apex_tpu import parallel
+    from apex_tpu.transformer.testing.gpt_parallel_train import build_gpt_3d
+    from apex_tpu.transformer.testing.standalone_transformer_lm import (
+        TransformerConfig,
+    )
+
+    mesh = parallel.initialize_model_parallel()  # dp=8, pp=tp=1
+    try:
+        cfg = TransformerConfig(
+            hidden_size=16, num_layers=1, num_attention_heads=2,
+            padded_vocab_size=VOCAB, max_position_embeddings=SEQ,
+            hidden_dropout=0.0, attention_dropout=0.0,
+            tensor_axis="tp")
+        rng = np.random.RandomState(5)
+        tokens = jnp.asarray(rng.randint(1, VOCAB, size=(16, SEQ)),
+                             jnp.int32)
+        segments = np.ones((16, SEQ), np.int32)
+        segments[:, SEQ // 2:] = 2          # a doc boundary mid-sequence
+        segments[:, -3:] = 0                # and a padded tail
+        segments = jnp.asarray(segments)
+
+        init_fn, make_loss_fn, _ = build_gpt_3d(
+            cfg, num_microbatches=2, mesh=mesh, packed_inputs=True)
+        params, specs = init_fn(jax.random.PRNGKey(1), tokens)
+        loss = jax.jit(make_loss_fn(specs))(params, (tokens, segments))
+
+        # manual: unmasked per-token losses from the unpacked builder's
+        # loss are not directly exposed, so recompute the mask algebra:
+        # the packed loss must equal sum(per_tok * mask)/sum(mask) where
+        # per_tok comes from the SAME model — proxy check: full-coverage
+        # segments reproduce the unpacked mean loss bitwise.
+        ones = jnp.ones_like(segments)
+        init2, make_loss2, _ = build_gpt_3d(
+            cfg, num_microbatches=2, mesh=mesh)
+        loss_unpacked = jax.jit(make_loss2(specs))(params, tokens)
+        loss_allones = jax.jit(make_loss_fn(specs))(params, (tokens, ones))
+        np.testing.assert_allclose(np.asarray(loss_allones),
+                                   np.asarray(loss_unpacked),
+                                   rtol=1e-6, atol=1e-6)
+        # and masking strictly changes the loss (boundary + pad excluded)
+        assert not np.allclose(np.asarray(loss), np.asarray(loss_unpacked))
+    finally:
+        parallel.mesh.destroy_model_parallel()
