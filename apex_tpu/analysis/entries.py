@@ -24,6 +24,12 @@ programs the test suite and the driver exercise, each built tiny on the
   leaves arrive via ``make_array_from_callback``, and a layout/
   committed-ness regression on that path would silently drop the
   params+state aliasing that keeps ZeRO in its HBM budget.
+- ``serving_decode`` — the ISSUE 9 serving runtime's jit-stable decode
+  step (and, jaxpr-tier, its packed prefill) at tp=2: APX204 audits
+  that both paged KV-cache arenas alias in->out through the donated
+  step — a non-donated cache doubles the largest HBM tenant of a
+  serving chip — with the rest of the rulebook over the tp decode
+  path.
 
 Builders construct params by *executing only initializers* — the linted
 train/loss/ring programs themselves are traced and lowered, never run.
@@ -294,6 +300,64 @@ def _reshard() -> List[Program]:
         expect_conditional=True,
         expect_donation=_leaves(restored["params"], restored["opt"]),
     )]
+
+
+@_entry("serving_decode")
+def _serving_decode() -> List[Program]:
+    """The ISSUE 9 serving runtime's decode step at tp=2 (jit-stable
+    ``[max_batch, 1]`` continuous-batching shape): the APX204 donation
+    audit is the point — the paged KV arenas are the largest HBM tenant
+    of a serving chip and MUST alias in->out through the step (both
+    leaves, hence the exact floor of 2); a dropped ``donate_argnums``
+    or an aliasing regression on the scatter+Pallas-read path doubles
+    cache HBM silently.  APX201/202/203 run over the same tp decode
+    path (no ring / no sentinel: contracts default off), and the jaxpr
+    tier walks the shard_map body including the Pallas call sites.
+    The packed prefill program rides along jaxpr-tier-only (its HLO
+    contracts are structurally the decode step's; one XLA compile is
+    enough for the tier-1 window)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from apex_tpu import parallel
+    from apex_tpu.serving import ServingConfig, ServingEngine
+    from apex_tpu.transformer.testing import TransformerConfig
+    from apex_tpu.transformer.testing.gpt_parallel_train import build_gpt_3d
+
+    mesh = parallel.initialize_model_parallel(tensor_model_parallel_size=2)
+    cfg = TransformerConfig(
+        hidden_size=32, num_layers=2, num_attention_heads=4,
+        padded_vocab_size=64, max_position_embeddings=32,
+        hidden_dropout=0.0, attention_dropout=0.0, tensor_axis="tp",
+        use_flash_attention=True)
+    init_fn, _, _ = build_gpt_3d(cfg, num_chunks=2, num_microbatches=1,
+                                 mesh=mesh)
+    params, _ = init_fn(jax.random.PRNGKey(0), jnp.zeros((2, 4), jnp.int32))
+    eng = ServingEngine(
+        cfg, ServingConfig(max_batch=2, block_size=4, max_seq=16,
+                           prefill_len=16),
+        params, mesh=mesh)
+    b = eng.serving.max_batch
+    mb = eng.cache.max_blocks_per_request
+    decode_args = (
+        eng.arenas[0], eng.arenas[1], eng.params,
+        np.zeros((b, 1), np.int32), np.zeros((b,), np.int32),
+        jnp.zeros((b, mb), jnp.int32), np.zeros((b,), bool))
+    pl_len = eng.prefill_len
+    prefill_args = (
+        eng.arenas[0], eng.arenas[1], eng.params,
+        np.zeros((1, pl_len), np.int32), np.zeros((1, pl_len), np.int32),
+        np.zeros((1, pl_len), np.int32), np.zeros((pl_len,), np.int32),
+        np.zeros((pl_len,), np.int32))
+    return [
+        Program(name="serving_decode/decode_step",
+                fn=eng._decode, args=decode_args,
+                expect_donation=2),
+        Program(name="serving_decode/prefill",
+                fn=eng._prefill, args=prefill_args,
+                hlo_tier=False),
+    ]
 
 
 def run_entry(name: str) -> Tuple[Report, int]:

@@ -23,7 +23,9 @@ what the *host* does with them between steps:
 
 from __future__ import annotations
 
+import collections
 import logging
+import math
 import threading
 import time
 from typing import Any, Callable, Dict, Optional
@@ -86,15 +88,26 @@ class Gauge:
 
 class Histogram:
     """Streaming summary (count/total/min/max/last) — enough for span
-    timings and rates without holding samples."""
+    timings and rates without holding samples.
 
-    def __init__(self, lock: Optional[threading.Lock] = None):
+    ``keep_samples > 0`` additionally retains the most recent N
+    observations in a ring buffer so :meth:`percentile` works — the
+    serving runtime's per-request latency percentiles (p50/p99
+    time-per-output-token, docs/serving.md) need the distribution, not
+    just the moments.  Bounded by construction: an unbounded sample
+    list in a weeks-long serving process is a slow leak.
+    """
+
+    def __init__(self, lock: Optional[threading.Lock] = None,
+                 keep_samples: int = 0):
         self._lock = lock if lock is not None else threading.Lock()
         self.count = 0
         self.total = 0.0
         self.min: Optional[float] = None
         self.max: Optional[float] = None
         self.last: Optional[float] = None
+        self._samples = (collections.deque(maxlen=keep_samples)
+                         if keep_samples > 0 else None)
 
     def observe(self, v: float) -> None:
         v = float(v)
@@ -104,16 +117,42 @@ class Histogram:
             self.min = v if self.min is None else min(self.min, v)
             self.max = v if self.max is None else max(self.max, v)
             self.last = v
+            if self._samples is not None:
+                self._samples.append(v)
 
     @property
     def mean(self) -> Optional[float]:
         return self.total / self.count if self.count else None
 
+    @staticmethod
+    def _nearest_rank(ordered, q: float):
+        rank = math.ceil(q / 100.0 * len(ordered))   # 1-indexed
+        return ordered[max(0, min(len(ordered) - 1, rank - 1))]
+
+    def percentile(self, q: float) -> Optional[float]:
+        """q-th percentile (0..100, nearest-rank) over the retained
+        window; ``None`` without samples (not constructed with
+        ``keep_samples``, or nothing observed yet)."""
+        with self._lock:
+            if not self._samples:
+                return None
+            ordered = sorted(self._samples)
+        return self._nearest_rank(ordered, q)
+
     def summary(self) -> dict:
         with self._lock:
-            return {"count": self.count, "total": self.total,
-                    "mean": self.mean, "min": self.min, "max": self.max,
-                    "last": self.last}
+            out = {"count": self.count, "total": self.total,
+                   "mean": self.mean, "min": self.min, "max": self.max,
+                   "last": self.last}
+            # one copy+sort for all the percentile keys: the window can
+            # be 64k samples and flush holds the lock observe() needs
+            ordered = sorted(self._samples) if self._samples else None
+        if self._samples is not None:
+            out["p50"] = (self._nearest_rank(ordered, 50.0)
+                          if ordered else None)
+            out["p99"] = (self._nearest_rank(ordered, 99.0)
+                          if ordered else None)
+        return out
 
 
 class MetricRegistry:
@@ -143,10 +182,10 @@ class MetricRegistry:
         """Exactly one process owns the durable metrics artifact."""
         return self.rank == 0
 
-    def _get(self, store: dict, name: str, cls):
+    def _get(self, store: dict, name: str, factory):
         with self._lock:
             if name not in store:
-                store[name] = cls(self._lock)
+                store[name] = factory(self._lock)
             return store[name]
 
     def counter(self, name: str) -> Counter:
@@ -155,8 +194,12 @@ class MetricRegistry:
     def gauge(self, name: str) -> Gauge:
         return self._get(self._gauges, name, Gauge)
 
-    def histogram(self, name: str) -> Histogram:
-        return self._get(self._histograms, name, Histogram)
+    def histogram(self, name: str, *, keep_samples: int = 0) -> Histogram:
+        """``keep_samples`` applies only on first creation (an existing
+        histogram keeps its window — last-write-wins reconfiguration
+        would silently truncate someone else's percentiles)."""
+        return self._get(self._histograms, name,
+                         lambda lock: Histogram(lock, keep_samples))
 
     def snapshot(self) -> dict:
         """Flat ``{name: value}`` view (histograms as summary dicts)."""

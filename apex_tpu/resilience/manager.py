@@ -110,6 +110,14 @@ class CheckpointManager:
         return os.path.join(self.directory,
                             name if self.sharded else name + ".npz")
 
+    def step_path(self, step: int) -> str:
+        """Filesystem path of one step's checkpoint artifact (the shard
+        directory, or the flat ``.npz``).  Public so subtree readers —
+        e.g. ``serving.loader`` restoring only the params out of a full
+        train state via ``reshard.load_logical`` — can address a
+        verified step without reaching into manager internals."""
+        return self._path(step)
+
     def all_steps(self):
         """Step numbers with a checkpoint present, ascending (presence,
         not integrity — ``restore_latest`` verifies)."""

@@ -1,0 +1,63 @@
+"""apex_tpu.serving — continuous-batching decode runtime (ISSUE 9).
+
+The inference-side twin of the training stack: the repo trains GPT at
+every parallelism and restores checkpoints onto arbitrary meshes; this
+package turns those checkpoints into a *serving* runtime —
+
+- :mod:`.kv_cache` — paged/block KV cache: a pooled
+  ``[n_blocks, block, heads, head_dim]`` device arena per layer with a
+  host-side :class:`~apex_tpu.serving.kv_cache.BlockAllocator` handing
+  fixed-size blocks to requests (the vLLM paging model), sharded over
+  the existing ``tp`` axis alongside the tensor-parallel heads.
+- :mod:`.paged_attention` — the fused Pallas decode kernel:
+  gather-from-block-table (scalar-prefetch index maps, so skipped and
+  out-of-range blocks never move HBM bytes) + online-softmax attention
+  over the cache in ONE kernel, next to the unfused XLA lowering it is
+  A/B'd against (bench ``serving.vs_unfused``).
+- :mod:`.fused_ops` — the fused dequant/residual/norm epilogue on the
+  decode hot path (one VMEM-resident kernel instead of three
+  elementwise+reduction HLOs — the operation-fusion paper's decode
+  finding, PAPERS.md arxiv 2502.17728).
+- :mod:`.model` — prefill/decode split over the *training* layers:
+  prefill reuses the flash-attention kernel (segment ids give packed
+  multi-request prefill), decode is a fixed-shape ``[max_batch, 1]``
+  step reusing ``ColumnParallelLinear``/``RowParallelLinear`` and RoPE.
+- :mod:`.scheduler` / :mod:`.engine` — continuous (in-flight)
+  batching: requests join and leave mid-flight with ZERO decode-step
+  recompiles (all churn is data, never shape), latency
+  percentiles/tokens-per-sec through the PR 5 metrics registry, and
+  draining on preemption via ``resilience.PreemptionGuard``.
+- :mod:`.loader` — restore-from-training-checkpoint through the PR 6
+  ``ShardingSpec`` reshard layer (train on mesh N, serve on mesh M).
+
+See ``docs/serving.md`` for the architecture and cookbook.
+"""
+
+from apex_tpu.serving.kv_cache import (
+    BlockAllocator,
+    KVCacheConfig,
+    OutOfBlocksError,
+    init_kv_arena,
+)
+from apex_tpu.serving.paged_attention import (
+    paged_attention_decode,
+    paged_attention_decode_unfused,
+)
+from apex_tpu.serving.scheduler import Request, RequestState, Scheduler
+from apex_tpu.serving.engine import ServingConfig, ServingEngine
+from apex_tpu.serving.loader import restore_gpt_for_serving
+
+__all__ = [
+    "BlockAllocator",
+    "KVCacheConfig",
+    "OutOfBlocksError",
+    "Request",
+    "RequestState",
+    "Scheduler",
+    "ServingConfig",
+    "ServingEngine",
+    "init_kv_arena",
+    "paged_attention_decode",
+    "paged_attention_decode_unfused",
+    "restore_gpt_for_serving",
+]

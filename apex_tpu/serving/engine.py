@@ -1,0 +1,341 @@
+"""The serving engine: continuous batching over the paged-cache decode.
+
+One object owns the whole runtime: the compiled prefill/decode programs
+(built ONCE — request churn is data, never shape, so the decode step
+compiles exactly once per process; :meth:`ServingEngine.
+decode_compile_count` pins this in tests), the sharded KV arenas
+(donated through every step so XLA updates them in place — APX204,
+analyzer entry ``serving_decode``), the host scheduler, the PR 5
+metrics, and the PR 3 preemption drain.
+
+Step anatomy (:meth:`ServingEngine.step`)::
+
+    [preemption?] -> admit waiting requests     (slots + blocks)
+                  -> prefill the admitted ones  (packed rows, flash)
+                  -> one batched decode step    (paged attention)
+                  -> append/finish bookkeeping  (host)
+
+Metric catalog (rank-aware registry, docs/observability.md +
+docs/serving.md):
+
+- ``serving/ttft_ms``      histogram (sampled: p50/p99) — submit to
+  first token, per request
+- ``serving/tpot_ms``      histogram (sampled: p50/p99) — inter-token
+  interval on the decode path, per token
+- ``serving/tokens_generated`` / ``serving/requests_finished`` /
+  ``serving/requests_cancelled`` counters
+- ``serving/active_slots`` / ``serving/free_blocks`` gauges
+- ``serving/preemption_drains`` counter
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, List, Optional, Sequence
+
+import numpy as np
+
+from apex_tpu.parallel import collectives as cc
+from apex_tpu.parallel.mesh import TENSOR_AXIS, get_mesh
+from apex_tpu.serving.kv_cache import (
+    KVCacheConfig,
+    arena_partition_spec,
+    init_kv_arena,
+)
+from apex_tpu.serving.model import DecodeModel
+from apex_tpu.serving.scheduler import Request, RequestState, Scheduler
+
+__all__ = ["ServingConfig", "ServingEngine"]
+
+
+@dataclasses.dataclass(frozen=True)
+class ServingConfig:
+    """Static shape of the runtime (everything that pins a compile)."""
+
+    max_batch: int = 8           # concurrent decode slots
+    block_size: int = 16         # tokens per KV block
+    max_seq: int = 256           # per-request context cap (prompt+output)
+    n_blocks: Optional[int] = None   # arena size; default = worst case
+    prefill_len: Optional[int] = None  # packed prefill row; default max_seq
+    cache_dtype: Any = None      # arena storage dtype; default param dtype
+    fused_attention: bool = True   # Pallas paged kernel vs unfused XLA
+    fuse_epilogue: bool = True     # fused residual/norm epilogue kernel
+
+    def resolve_n_blocks(self, max_blocks_per_request: int) -> int:
+        if self.n_blocks is not None:
+            return self.n_blocks
+        return self.max_batch * max_blocks_per_request
+
+
+class ServingEngine:
+    """Continuous-batching greedy-decode runtime over a GPT checkpoint.
+
+    ``params``: a :class:`~apex_tpu.transformer.testing.
+    gpt_parallel_train.GPT3DParams` with the layer stack in the
+    canonical ``[vpp, pp, ...]`` form (what ``build_gpt_3d``'s init and
+    the :mod:`~apex_tpu.serving.loader` restore both produce — the two
+    leading dims are merged row-major into the ``[L, ...]`` serving
+    stack).  ``guard``: an optional
+    :class:`~apex_tpu.resilience.PreemptionGuard`; once it trips, the
+    engine drains — no admissions, running requests decode to
+    completion and deliver, waiting ones are cancelled.
+    """
+
+    def __init__(self, config, serving: ServingConfig, params, *,
+                 mesh=None, tp_axis: str = TENSOR_AXIS, registry=None,
+                 guard=None):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        from apex_tpu.observability.metrics import default_registry
+        from apex_tpu.transformer.tensor_parallel import infer_param_specs
+
+        self.mesh = mesh if mesh is not None else get_mesh()
+        self.tp_axis = tp_axis
+        self.serving = serving
+        if (config.position_embedding_type == "learned"
+                and config.max_position_embeddings < serving.max_seq):
+            raise ValueError(
+                f"max_seq ({serving.max_seq}) exceeds the learned position "
+                f"table ({config.max_position_embeddings})")
+
+        cache_dtype = (serving.cache_dtype if serving.cache_dtype is not None
+                       else config.param_dtype)
+        probe = KVCacheConfig(
+            n_layers=config.num_layers, n_blocks=1,
+            block_size=serving.block_size, kv_heads=config.query_groups,
+            head_dim=config.head_dim, max_seq=serving.max_seq,
+            dtype=cache_dtype)
+        self.cache = dataclasses.replace(
+            probe,
+            n_blocks=serving.resolve_n_blocks(probe.max_blocks_per_request))
+        self.model = DecodeModel(
+            config, self.cache, fused_attention=serving.fused_attention,
+            fuse_epilogue=serving.fuse_epilogue)
+        self.prefill_len = serving.prefill_len or serving.max_seq
+
+        # [vpp, pp, ...] -> [L, ...] (row-major merge == virtual-stage
+        # major == plain layer order; gpt3d_logical_folds rationale)
+        L = config.num_layers
+        params = params._replace(layers=jax.tree_util.tree_map(
+            lambda l: l.reshape((L,) + l.shape[2:]), params.layers))
+        self.params = params
+
+        e_specs = infer_param_specs(params.embedding, axis=tp_axis)
+        per_layer = jax.tree_util.tree_map(
+            lambda l: jax.ShapeDtypeStruct(l.shape[1:], l.dtype),
+            params.layers)
+        l_specs = jax.tree_util.tree_map(
+            lambda s: P(None, *tuple(s)),
+            infer_param_specs(per_layer, axis=tp_axis),
+            is_leaf=lambda x: isinstance(x, P))
+        ln_specs = jax.tree_util.tree_map(lambda _: P(), params.final_ln)
+        self.param_specs = type(params)(
+            embedding=e_specs, layers=l_specs, final_ln=ln_specs)
+
+        self.arenas = init_kv_arena(self.cache, self.mesh, tp_axis)
+        a_spec = arena_partition_spec(tp_axis)
+
+        rep = P()
+        decode_body = cc.shard_over(
+            self.model.decode_step, mesh=self.mesh,
+            in_specs=(a_spec, a_spec, self.param_specs, rep, rep, rep, rep),
+            out_specs=(a_spec, a_spec, P(None), P(None, None)),
+        )
+        prefill_body = cc.shard_over(
+            self.model.prefill, mesh=self.mesh,
+            in_specs=(a_spec, a_spec, self.param_specs, rep, rep, rep, rep,
+                      rep),
+            out_specs=(a_spec, a_spec, P(None), P(None, None)),
+        )
+        # the arenas are donated: the KV cache must alias in->out or the
+        # biggest HBM tenant of the chip doubles (APX204, entry
+        # serving_decode)
+        self._decode = jax.jit(decode_body, donate_argnums=(0, 1))
+        self._prefill = jax.jit(prefill_body, donate_argnums=(0, 1))
+        self._jnp = jnp
+
+        self.scheduler = Scheduler(self.cache, serving.max_batch)
+        self.registry = registry if registry is not None else \
+            default_registry()
+        self.guard = guard
+        self._tables = np.zeros(
+            (serving.max_batch, self.cache.max_blocks_per_request),
+            np.int32)
+        self._steps = 0
+
+    # -------------------------------------------------------------- intro
+
+    def decode_compile_count(self) -> int:
+        """Compiled-variant count of the decode step (the zero-recompile
+        contract: stays 1 across any request churn)."""
+        return int(self._decode._cache_size())
+
+    @property
+    def draining(self) -> bool:
+        return self.scheduler.draining
+
+    # -------------------------------------------------------------- submit
+
+    def submit(self, prompt: Sequence[int], max_new_tokens: int,
+               eos_id: Optional[int] = None) -> Request:
+        if len(np.shape(prompt)) != 1 or len(prompt) > self.prefill_len:
+            raise ValueError(
+                f"prompt must be 1-D with at most prefill_len="
+                f"{self.prefill_len} tokens, got shape {np.shape(prompt)}")
+        req = self.scheduler.submit(prompt, max_new_tokens, eos_id)
+        if req.state is RequestState.CANCELLED:
+            # submitted into the drain window: count it like every other
+            # cancellation or the catalog undercounts exactly when the
+            # operator is watching a preemption
+            self.registry.counter("serving/requests_cancelled").inc()
+        return req
+
+    # --------------------------------------------------------------- drain
+
+    def drain(self) -> List[Request]:
+        """Preemption path: cancel the queue, keep decoding the running
+        requests until their responses are delivered."""
+        cancelled = self.scheduler.drain()
+        if cancelled:
+            self.registry.counter("serving/requests_cancelled").inc(
+                len(cancelled))
+        self.registry.counter("serving/preemption_drains").inc()
+        return cancelled
+
+    # ---------------------------------------------------------------- step
+
+    def step(self) -> None:
+        """One engine tick: admit + prefill joiners, one decode step."""
+        if (self.guard is not None and self.guard.triggered
+                and not self.draining):
+            self.drain()
+        admitted = self.scheduler.admit()
+        for row in self._pack_rows(admitted):
+            self._prefill_row(row)
+        self._decode_once()
+        self._steps += 1
+        self.registry.gauge("serving/active_slots").set(
+            len(self.scheduler.running()))
+        self.registry.gauge("serving/free_blocks").set(
+            self.scheduler.allocator.n_free)
+
+    def run_until_drained(self, max_steps: int = 100_000) -> None:
+        """Drive :meth:`step` until no request is waiting or running
+        (under drain: until the running ones have delivered)."""
+        for _ in range(max_steps):
+            if self.scheduler.idle:
+                return
+            self.step()
+        raise RuntimeError(f"not drained after {max_steps} steps")
+
+    # ------------------------------------------------------------- prefill
+
+    def _pack_rows(self, reqs: List[Request]) -> List[List[Request]]:
+        """First-fit pack admitted prompts into ``[1, prefill_len]``
+        rows — several requests prefill in one flash pass (segment ids
+        keep them from attending to each other)."""
+        rows: List[List[Request]] = []
+        fill = 0
+        for req in reqs:
+            n = len(req.prompt)
+            if not rows or fill + n > self.prefill_len:
+                rows.append([])
+                fill = 0
+            rows[-1].append(req)
+            fill += n
+        return rows
+
+    def _prefill_row(self, reqs: List[Request]) -> None:
+        L = self.prefill_len
+        bs = self.cache.block_size
+        tokens = np.zeros((1, L), np.int32)
+        pos_ids = np.zeros((1, L), np.int32)
+        seg_ids = np.zeros((1, L), np.int32)
+        dest_b = np.full((L,), self.cache.n_blocks, np.int32)  # OOB=dropped
+        dest_o = np.zeros((L,), np.int32)
+        last_index = {}
+        cursor = 0
+        for si, req in enumerate(reqs, start=1):
+            p = len(req.prompt)
+            sl = slice(cursor, cursor + p)
+            tokens[0, sl] = req.prompt
+            pos_ids[0, sl] = np.arange(p)
+            seg_ids[0, sl] = si
+            dest_b[sl] = [req.blocks[t // bs] for t in range(p)]
+            dest_o[sl] = [t % bs for t in range(p)]
+            last_index[req.rid] = cursor + p - 1
+            cursor += p
+
+        k, v = self.arenas
+        k, v, next_tokens, _ = self._prefill(
+            k, v, self.params, tokens, pos_ids, seg_ids, dest_b, dest_o)
+        self.arenas = (k, v)
+        next_np = np.asarray(next_tokens)
+
+        now = time.monotonic()
+        for req in reqs:
+            req.cache_len = len(req.prompt)
+            row = self._tables[req.slot]
+            row[:] = 0
+            row[:len(req.blocks)] = req.blocks
+            self._emit(req, int(next_np[last_index[req.rid]]), now)
+
+    # -------------------------------------------------------------- decode
+
+    def _decode_once(self) -> None:
+        B = self.serving.max_batch
+        # a request at the context cap cannot write another token:
+        # deliver what it has (truncation is a response, not a hang)
+        for req in list(self.scheduler.running()):
+            if req.cache_len >= self.cache.max_seq:
+                self._finish(req)
+        reqs = self.scheduler.running()
+        if not reqs:
+            return
+        tokens = np.zeros((B, 1), np.int32)
+        positions = np.zeros((B,), np.int32)
+        active = np.zeros((B,), bool)
+        for req in reqs:
+            tokens[req.slot, 0] = req.last_token
+            positions[req.slot] = req.cache_len
+            active[req.slot] = True
+
+        k, v = self.arenas
+        k, v, next_tokens, _ = self._decode(
+            k, v, self.params, tokens, positions,
+            self._jnp.asarray(self._tables), active)
+        self.arenas = (k, v)
+        next_np = np.asarray(next_tokens)
+
+        now = time.monotonic()
+        for req in reqs:
+            req.cache_len += 1
+            self._emit(req, int(next_np[req.slot]), now)
+
+    # ---------------------------------------------------------- bookkeeping
+
+    def _emit(self, req: Request, token: int, now: float) -> None:
+        """Record one generated token; finish on eos/budget."""
+        if req.t_first_token is None:
+            req.t_first_token = now
+            self.registry.histogram(
+                "serving/ttft_ms", keep_samples=4096).observe(
+                    (now - req.t_submit) * 1e3)
+        else:
+            self.registry.histogram(
+                "serving/tpot_ms", keep_samples=65536).observe(
+                    (now - req.t_last_token) * 1e3)
+        req.t_last_token = now
+        req.output_tokens.append(token)
+        self.registry.counter("serving/tokens_generated").inc()
+        if (len(req.output_tokens) >= req.max_new_tokens
+                or (req.eos_id is not None and token == req.eos_id)):
+            self._finish(req)
+
+    def _finish(self, req: Request) -> None:
+        self._tables[req.slot][:] = 0
+        self.scheduler.finish(req)
+        self.registry.counter("serving/requests_finished").inc()

@@ -1,0 +1,198 @@
+"""Paged KV cache: pooled block arena + host-side block allocator.
+
+The vLLM paging model mapped onto the repo's sharded-state conventions:
+
+- **Device side** — one pooled arena per K and per V, shape
+  ``[n_layers, n_blocks, block_size, kv_heads, head_dim]`` (each layer's
+  slice is the ``[n_blocks, block, heads, head_dim]`` arena of the
+  design), held as a *global array* sharded over the tensor-parallel
+  axis on the heads dim — the same chop as the tensor-parallel
+  attention heads, so every tp rank owns the cache rows of exactly the
+  heads it computes.  The arena is **donated** through the decode step
+  (``jax.jit(..., donate_argnums=...)``) so XLA updates it in place: a
+  non-donated cache would double the single largest HBM tenant of a
+  serving chip (analyzer entry ``serving_decode``, rule APX204, audits
+  exactly this).
+- **Host side** — :class:`BlockAllocator`: a free list of physical
+  block ids with ownership tracking.  Allocation is O(1) per block and
+  *fragmentation-free by construction*: blocks are fixed-size and any
+  free block can serve any request, so the only admission question is
+  ``n_free >= blocks_needed`` — never "is there a contiguous run".
+  Invariants (every block is free XOR owned by exactly one request;
+  double-free and foreign-free raise) are checked by
+  :meth:`BlockAllocator.check` and pinned in ``tests/test_serving.py``.
+
+The per-request *block table* (logical block index -> physical block
+id) lives with the scheduler's request records; the engine packs the
+tables of the active slots into one ``[max_batch, max_blocks]`` int32
+device argument each step — churn changes the table *values*, never
+any shape, which is what keeps the decode step compile-stable.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = [
+    "KVCacheConfig",
+    "BlockAllocator",
+    "OutOfBlocksError",
+    "init_kv_arena",
+    "arena_partition_spec",
+]
+
+
+class OutOfBlocksError(RuntimeError):
+    """The arena cannot serve the requested number of blocks.
+
+    Admission control is expected to check :meth:`BlockAllocator.can_alloc`
+    first; hitting this during a decode append means the operator sized
+    ``n_blocks`` below ``max_batch * max_blocks_per_request``.
+    """
+
+
+@dataclasses.dataclass(frozen=True)
+class KVCacheConfig:
+    """Static shape of the paged cache.
+
+    ``kv_heads`` is the *global* K/V head count (``config.query_groups``
+    of the served model); under tensor parallelism each rank holds
+    ``kv_heads / tp`` of them.  ``max_seq`` rounds up to whole blocks;
+    ``max_blocks_per_request`` is the per-request block-table width.
+    """
+
+    n_layers: int
+    n_blocks: int
+    block_size: int
+    kv_heads: int
+    head_dim: int
+    max_seq: int
+    dtype: Any = np.float32
+
+    def __post_init__(self):
+        if self.block_size < 1 or self.n_blocks < 1:
+            raise ValueError(
+                f"block_size ({self.block_size}) and n_blocks "
+                f"({self.n_blocks}) must be positive")
+        if self.max_seq < 1:
+            raise ValueError(f"max_seq must be positive, got {self.max_seq}")
+
+    @property
+    def max_blocks_per_request(self) -> int:
+        return -(-self.max_seq // self.block_size)
+
+    def blocks_for(self, n_tokens: int) -> int:
+        """Number of blocks a sequence of ``n_tokens`` occupies."""
+        return -(-n_tokens // self.block_size)
+
+
+def arena_partition_spec(tp_axis: Optional[str]):
+    """PartitionSpec of one arena: heads (dim 3) sharded over ``tp``."""
+    from jax.sharding import PartitionSpec as P
+
+    return P(None, None, None, tp_axis, None)
+
+
+def init_kv_arena(cfg: KVCacheConfig, mesh=None, tp_axis: Optional[str] = "tp"
+                  ) -> Tuple[Any, Any]:
+    """Allocate the zeroed ``(k, v)`` arenas as sharded global arrays.
+
+    Shape ``[n_layers, n_blocks, block_size, kv_heads, head_dim]``,
+    heads sharded over ``tp_axis`` when a mesh is given (the same axis
+    the attention heads are column-parallel over, so the cache rows a
+    rank reads in the paged kernel are exactly the rows it owns).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    shape = (cfg.n_layers, cfg.n_blocks, cfg.block_size, cfg.kv_heads,
+             cfg.head_dim)
+    k = jnp.zeros(shape, cfg.dtype)
+    v = jnp.zeros(shape, cfg.dtype)
+    if mesh is not None and tp_axis is not None:
+        from jax.sharding import NamedSharding
+
+        if cfg.kv_heads % mesh.shape[tp_axis]:
+            raise ValueError(
+                f"kv_heads ({cfg.kv_heads}) not divisible by tp "
+                f"({mesh.shape[tp_axis]})")
+        sharding = NamedSharding(mesh, arena_partition_spec(tp_axis))
+        k = jax.device_put(k, sharding)
+        v = jax.device_put(v, sharding)
+    return k, v
+
+
+class BlockAllocator:
+    """Free-list allocator over the physical block pool.
+
+    LIFO free list (recently-freed blocks are reused first — their HBM
+    pages are the warmest) plus an ownership map for invariant checking.
+    NOT thread-safe: the scheduler owns it from one thread, matching the
+    engine's single-threaded step loop.
+    """
+
+    def __init__(self, n_blocks: int):
+        if n_blocks < 1:
+            raise ValueError(f"n_blocks must be positive, got {n_blocks}")
+        self.n_blocks = n_blocks
+        self._free: List[int] = list(range(n_blocks - 1, -1, -1))
+        self._owner: Dict[int, Any] = {}
+
+    @property
+    def n_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def n_owned(self) -> int:
+        return len(self._owner)
+
+    def can_alloc(self, n: int) -> bool:
+        return n <= len(self._free)
+
+    def alloc(self, n: int, owner: Any = None) -> List[int]:
+        """Take ``n`` blocks for ``owner``; raises :class:`OutOfBlocksError`
+        (allocating nothing) when fewer than ``n`` are free."""
+        if n < 0:
+            raise ValueError(f"cannot allocate {n} blocks")
+        if n > len(self._free):
+            raise OutOfBlocksError(
+                f"requested {n} blocks, only {len(self._free)} of "
+                f"{self.n_blocks} free")
+        blocks = [self._free.pop() for _ in range(n)]
+        for b in blocks:
+            self._owner[b] = owner
+        return blocks
+
+    def free(self, blocks: Sequence[int], owner: Any = None) -> None:
+        """Return blocks to the pool.  A block that is already free
+        (double free) or owned by someone else raises — silently
+        recycling a live request's cache rows is the worst failure mode
+        a paged cache has."""
+        for b in blocks:
+            if b not in self._owner:
+                raise ValueError(f"double free of block {b}")
+            if self._owner[b] != owner:
+                raise ValueError(
+                    f"block {b} owned by {self._owner[b]!r}, freed by "
+                    f"{owner!r}")
+        for b in blocks:
+            del self._owner[b]
+            self._free.append(b)
+
+    def check(self) -> None:
+        """Assert the pool invariant: free and owned partition the pool
+        (no leak, no double ownership, no phantom ids)."""
+        free = set(self._free)
+        owned = set(self._owner)
+        if len(free) != len(self._free):
+            raise AssertionError("duplicate ids on the free list")
+        if free & owned:
+            raise AssertionError(
+                f"blocks both free and owned: {sorted(free & owned)}")
+        if free | owned != set(range(self.n_blocks)):
+            raise AssertionError(
+                f"pool leak: {self.n_blocks - len(free) - len(owned)} "
+                "blocks neither free nor owned")

@@ -1,0 +1,149 @@
+"""Restore training checkpoints onto the serving mesh.
+
+The PR 6 elastic-restore layer already proves a GPT train state moves
+bit-losslessly between meshes; serving is "mesh M" with two twists:
+
+- the serving mesh has ``pp=1`` (the decode step runs every layer on
+  every rank — a 1-token pipeline would be all bubble), so the
+  training-side ``[vpp, pp, ...]`` layer stack re-factors to
+  ``[L, 1, ...]``.  Both layouts are row-major views of the same
+  virtual-stage-major logical ``[L]`` stack (``gpt3d_logical_folds``),
+  so the re-factor is a pure reshape — bit-lossless by construction.
+- serving needs only the **params subtree** of the saved train state.
+  ``restore_resharded`` templates the whole tree (leaf-count checked),
+  so this loader goes through :func:`~apex_tpu.resilience.reshard.
+  load_logical` instead — the mesh-independent ``{path: leaf}`` view
+  (folds merged, ZeRO buckets expanded) — and places just the
+  ``params/...`` leaves onto the serving template.  An optimizer-state
+  layout change can therefore never break a rollout.
+
+Verification and corrupt-fallback mirror ``restore_latest``: every
+candidate is checksum-verified before reading, and a corrupt newest
+checkpoint falls back to the previous committed one.
+
+Cookbook (docs/serving.md has the long form)::
+
+    mesh = parallel.initialize_model_parallel(
+        tensor_model_parallel_size=SERVE_TP)       # serving mesh
+    params, specs = restore_gpt_for_serving(ckpt_dir, config, mesh=mesh)
+    engine = ServingEngine(config, ServingConfig(...), params, mesh=mesh)
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Tuple
+
+__all__ = ["restore_gpt_for_serving", "serving_like"]
+
+logger = logging.getLogger(__name__)
+
+
+def serving_like(config, mesh, *, tp_axis: str = "tp", seed: int = 0):
+    """A serving-mesh ``(params, specs)`` template for ``config``.
+
+    Built by ``build_gpt_3d``'s own init on the serving mesh (pp=1, so
+    ``num_chunks = num_layers`` and the stack lands as ``[L, 1, ...]``)
+    — the one source of truth for shapes, shardings and pytree
+    structure, so the restore template can never drift from what the
+    engine consumes.
+    """
+    import jax
+
+    from apex_tpu.transformer.testing.gpt_parallel_train import build_gpt_3d
+
+    if mesh.shape["pp"] != 1:
+        raise ValueError(
+            f"serving mesh must have pp=1 (got pp={mesh.shape['pp']}); "
+            "a 1-token decode step has no pipeline to fill")
+    init_fn, _, _ = build_gpt_3d(
+        config, num_chunks=config.num_layers, num_microbatches=1,
+        mesh=mesh, tp_axis=tp_axis)
+    sample = jax.numpy.zeros((2, 2), jax.numpy.int32)
+    return init_fn(jax.random.PRNGKey(seed), sample)
+
+
+def _place_subtree(logical: dict, like, prefix: str):
+    """Map logical ``{path: np.ndarray}`` leaves under ``prefix/`` onto
+    the template tree (reshape-only placement with the template's
+    shardings)."""
+    import jax
+    import numpy as np
+
+    from apex_tpu.checkpoint import CheckpointCorruptError, _path_str
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(like)
+    out = []
+    for p, tleaf in flat:
+        path = f"{prefix}/{_path_str(p)}"
+        if path not in logical:
+            raise CheckpointCorruptError(
+                f"checkpoint has no leaf {path!r} (saved tree does not "
+                f"carry the served params under {prefix!r}?)")
+        host = logical[path]
+        tgt_shape = tuple(np.shape(tleaf))
+        if int(np.prod(host.shape)) != int(np.prod(tgt_shape)):
+            raise CheckpointCorruptError(
+                f"{path}: logical shape {list(host.shape)} cannot "
+                f"reshape to serving shape {list(tgt_shape)}")
+        host = np.ascontiguousarray(host).reshape(tgt_shape).astype(
+            tleaf.dtype, copy=False)
+        if isinstance(tleaf, jax.Array):
+            out.append(jax.make_array_from_callback(
+                tgt_shape, tleaf.sharding, lambda idx, h=host: h[idx]))
+        else:
+            out.append(host)
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def restore_gpt_for_serving(ckpt_dir: str, config, *, mesh=None,
+                            tp_axis: str = "tp", key: str = "params",
+                            sharded: bool = True, verify: bool = True
+                            ) -> Tuple[object, object]:
+    """Restore the newest intact GPT checkpoint onto the serving mesh.
+
+    ``ckpt_dir`` is a :class:`~apex_tpu.resilience.CheckpointManager`
+    directory whose checkpoints hold the train state as a mapping with
+    the :class:`GPT3DParams` under ``key`` (the 3D trainer convention);
+    every other entry (optimizer state, sentinel) is ignored.  Returns
+    ``(params, specs)`` with the layer stack in the canonical
+    ``[L, 1, ...]`` serving form, resharded from whatever
+    ``(vpp, pp, tp, dp)`` layout the checkpoint was trained on.
+    """
+    from apex_tpu import checkpoint as ckpt
+    from apex_tpu.observability.spans import span
+    from apex_tpu.resilience import CheckpointManager, reshard
+
+    like_params, specs = serving_like(config, mesh_or_registered(mesh),
+                                      tp_axis=tp_axis)
+    mgr = CheckpointManager(ckpt_dir, sharded=sharded)
+    failures = []
+    with span("serving/restore"):
+        for step in reversed(mgr.all_steps()):
+            try:
+                if verify:
+                    mgr.verify(step)
+                logical, _ = reshard.load_logical(mgr.step_path(step))
+                params = _place_subtree(logical, like_params, key)
+                if failures:
+                    logger.warning(
+                        "serving restore fell back to step %d past %s",
+                        step, "; ".join(failures))
+                return params, specs
+            except (ckpt.CheckpointCorruptError, ValueError, OSError,
+                    KeyError) as e:
+                failures.append(f"step {step}: {e!r}")
+                logger.warning(
+                    "checkpoint step %d unusable for serving (%r); "
+                    "falling back", step, e)
+    raise FileNotFoundError(
+        f"no checkpoint under {ckpt_dir!r} restorable for serving"
+        + (f" (tried: {'; '.join(failures)})" if failures else ""))
+
+
+def mesh_or_registered(mesh):
+    if mesh is not None:
+        return mesh
+    from apex_tpu.parallel.mesh import get_mesh
+
+    return get_mesh()

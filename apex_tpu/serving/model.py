@@ -1,0 +1,324 @@
+"""Prefill/decode forward over the *training* transformer layers.
+
+The serving twin of ``transformer.testing.gpt_parallel_train``: the same
+parameter pytree (:class:`~apex_tpu.transformer.testing.gpt_parallel_train.
+GPT3DParams`, layer stack flattened to ``[L, ...]``), the same
+tensor-parallel modules (``ColumnParallelLinear``/``RowParallelLinear``
+/``VocabParallelEmbedding``-backed :class:`Embedding`, ``ParallelMLP``,
+``FusedLayerNorm``) and the same RoPE tables — but driven through two
+inference-shaped entry points instead of a loss:
+
+- :meth:`DecodeModel.prefill` — one **packed row** ``[1, L]`` holding
+  one or more requests' prompts back to back (host-built segment ids,
+  position ids, and per-token cache destinations).  Attention is the
+  PR 2 flash kernel with ``segment_ids`` — packed multi-request prefill
+  falls out of the varlen mechanism for free — and each layer's K/V
+  are scattered into the paged arena at host-precomputed
+  ``(block, offset)`` destinations.
+- :meth:`DecodeModel.decode_step` — the jit-stable continuous-batching
+  step: fixed ``[max_batch, 1]`` tokens, per-slot positions/tables and
+  an active mask; inactive slots are pure data (their cache writes are
+  routed out of range and dropped; their attention length is 0), so
+  requests joining/leaving never change a shape and the step **never
+  recompiles**.  Attention over the cache is the fused Pallas
+  paged-attention kernel (:mod:`.paged_attention`), and the
+  residual/norm tail of each block can run as the fused epilogue
+  kernel (:mod:`.fused_ops`) — both A/B-able against their unfused XLA
+  lowerings via the constructor flags.
+
+Both entry points are **shard_map bodies**: run them under
+``collectives.shard_over`` with the tensor axis bound (the engine does
+this) — the parallel linears then shard exactly as in training, and
+the K/V arena rows a rank touches are the heads it owns.  Greedy
+next-token ids are computed inside (vocab-sharded logits are gathered
+over tp before the argmax), so the host round-trips one int per slot
+per step, not a logits tensor.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import jax.numpy as jnp
+from jax import lax
+
+from apex_tpu.parallel import collectives as cc
+from apex_tpu.serving.fused_ops import (
+    fused_residual_norm,
+    residual_norm_unfused,
+)
+from apex_tpu.serving.kv_cache import KVCacheConfig
+from apex_tpu.serving.paged_attention import (
+    paged_attention_decode,
+    paged_attention_decode_unfused,
+)
+from apex_tpu.transformer.layers.layer_norm import FusedLayerNorm
+from apex_tpu.transformer.rope import (
+    apply_rotary,
+    apply_rotary_decode,
+    rotary_cos_sin,
+)
+from apex_tpu.transformer.tensor_parallel import (
+    ColumnParallelLinear,
+    RowParallelLinear,
+)
+from apex_tpu.transformer.tensor_parallel.utils import divide
+from apex_tpu.transformer.testing.standalone_transformer_lm import (
+    Embedding,
+    ParallelMLP,
+    TransformerConfig,
+    parallel_lm_logits,
+)
+
+__all__ = ["DecodeModel", "serving_config"]
+
+
+def serving_config(config: TransformerConfig) -> TransformerConfig:
+    """The inference view of a training config.
+
+    Dropout off (inference), sequence parallelism off (a decode step
+    has one token per slot — there is no sequence dim to shard; param
+    shapes are identical so training checkpoints load unchanged),
+    ring overlap off (no SP collective to decompose), fp8 off (the
+    delayed-scaling state lives in a training-side collection).
+    """
+    if config.apply_residual_connection_post_layernorm:
+        raise NotImplementedError(
+            "serving decode assumes the standard pre-LN residual; "
+            "apply_residual_connection_post_layernorm is not wired")
+    if config.num_experts is not None:
+        raise NotImplementedError(
+            "MoE serving is not wired yet (the EP roadmap item)")
+    return dataclasses.replace(
+        config, hidden_dropout=0.0, attention_dropout=0.0,
+        sequence_parallel=False, overlap_comm=False, context_axis=None,
+        fp8=False)
+
+
+class DecodeModel:
+    """Functional prefill/decode forward bound to a config + cache shape.
+
+    Stateless: parameters and cache arenas are arguments, so the same
+    instance serves any checkpoint of the architecture and the engine
+    can donate the arenas through jit.
+    """
+
+    def __init__(self, config: TransformerConfig, cache: KVCacheConfig, *,
+                 fused_attention: bool = True, fuse_epilogue: bool = True):
+        cfg = serving_config(config)
+        self.cfg = cfg
+        self.cache = cache
+        self.fused_attention = fused_attention
+        self.fuse_epilogue = fuse_epilogue
+
+        d = cfg.head_dim
+        n, g = cfg.num_attention_heads, cfg.query_groups
+        self.hpg = divide(n, g)
+        if cache.kv_heads != g:
+            raise ValueError(
+                f"cache kv_heads ({cache.kv_heads}) != model query_groups "
+                f"({g})")
+        if cache.head_dim != d:
+            raise ValueError(
+                f"cache head_dim ({cache.head_dim}) != model head_dim ({d})")
+        self.embed = Embedding(cfg)
+        self.qkv = ColumnParallelLinear(
+            cfg.hidden_size, (n + 2 * g) * d, axis=cfg.tensor_axis,
+            dtype=cfg.dtype, param_dtype=cfg.param_dtype)
+        self.dense = RowParallelLinear(
+            n * d, cfg.hidden_size, input_is_parallel=True,
+            skip_bias_add=True, axis=cfg.tensor_axis,
+            dtype=cfg.dtype, param_dtype=cfg.param_dtype)
+        self.mlp = ParallelMLP(cfg)
+        self.ln = FusedLayerNorm(cfg.hidden_size, eps=cfg.layernorm_epsilon)
+
+    # ----------------------------------------------------------------- util
+
+    def _split_qkv(self, qkv):
+        """Group-major fused-QKV split (``ParallelAttention`` layout):
+        per K/V group its query heads, then its one K and one V head."""
+        cfg = self.cfg
+        d = cfg.head_dim
+        world = cc.bound_axis_size(cfg.tensor_axis)
+        g_local = divide(cfg.query_groups, world)
+        n_local = divide(cfg.num_attention_heads, world)
+        s, b = qkv.shape[0], qkv.shape[1]
+        qkv = qkv.reshape(s, b, g_local, (self.hpg + 2) * d)
+        q = qkv[..., :self.hpg * d].reshape(s, b, n_local, d)
+        k = qkv[..., self.hpg * d:(self.hpg + 1) * d]
+        v = qkv[..., (self.hpg + 1) * d:]
+        return q, k, v
+
+    def _layer_stack(self, params, x, k_arena, v_arena, attn_core, rope_fn):
+        """Scan the ``[L, ...]`` layer stack; each step consumes its own
+        arena slice and emits the updated one (the scan re-stacks them,
+        which XLA aliases into the donated input arena)."""
+
+        def body(carry, xs):
+            x = carry
+            lp, k_layer, v_layer = xs
+            ln1 = self.ln.apply({"params": lp["input_layernorm"]}, x)
+            qkv = self.qkv.apply(
+                {"params": lp["self_attention"]["query_key_value"]}, ln1)
+            q, k, v = self._split_qkv(qkv)
+            q, k = rope_fn(q, k)
+            ctx, k_layer, v_layer = attn_core(q, k, v, k_layer, v_layer)
+            y, y_bias = self.dense.apply(
+                {"params": lp["self_attention"]["dense"]}, ctx)
+            ln2 = lp["post_attention_layernorm"]
+            if self.fuse_epilogue:
+                ln2_out, h = fused_residual_norm(
+                    y, x, ln2["scale"], ln2["bias"], bias=y_bias,
+                    eps=self.cfg.layernorm_epsilon)
+            else:
+                ln2_out, h = residual_norm_unfused(
+                    y, x, ln2["scale"], ln2["bias"], bias=y_bias,
+                    eps=self.cfg.layernorm_epsilon)
+            m, m_bias = self.mlp.apply({"params": lp["mlp"]}, ln2_out)
+            return h + m + m_bias, (k_layer, v_layer)
+
+        x, (k_arena, v_arena) = lax.scan(
+            body, x, (params.layers, k_arena, v_arena))
+        return x, k_arena, v_arena
+
+    def _head(self, params, x):
+        """Final LN + tied LM head + tp-gathered greedy argmax.
+
+        Returns ``(next_tokens [s, b], logits [s, b, vocab])`` with the
+        FULL vocab (gathered over tp so the argmax — and the host —
+        see one consistent id space)."""
+        cfg = self.cfg
+        hidden = self.ln.apply({"params": params.final_ln}, x)
+        logits = parallel_lm_logits(
+            hidden, params.embedding["word_embeddings"]["embedding"], cfg)
+        if cfg.tensor_axis is not None \
+                and cc.bound_axis_size(cfg.tensor_axis) > 1:
+            logits = cc.all_gather(logits, cfg.tensor_axis, concat_axis=-1)
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32), logits
+
+    def _rope_tables(self, positions, dtype):
+        cfg = self.cfg
+        if cfg.position_embedding_type != "rope":
+            return None
+        return rotary_cos_sin(positions, cfg.rotary_dim, cfg.rotary_base,
+                              dtype)
+
+    # ---------------------------------------------------------------- entry
+
+    def decode_step(self, k_arena, v_arena, params, tokens, positions,
+                    block_tables, active):
+        """One continuously-batched greedy decode step (shard_map body).
+
+        ``tokens [max_batch, 1]`` (each slot's last sampled/prompt
+        token), ``positions [max_batch]`` (the cache index this token
+        is written at — the slot's current length), ``block_tables
+        [max_batch, max_blocks]``, ``active [max_batch]`` bool.  Every
+        shape is fixed by the engine config; request churn only changes
+        values.  Returns ``(k_arena, v_arena, next_tokens [max_batch],
+        logits [max_batch, vocab])``.
+        """
+        cfg = self.cfg
+        cache = self.cache
+        bs = cache.block_size
+        b = tokens.shape[0]
+        positions = positions.astype(jnp.int32)
+        lengths = jnp.where(active, positions + 1, 0).astype(jnp.int32)
+        # this step's cache write destination; inactive slots write out
+        # of range and the scatter drops them
+        logical = positions // bs
+        phys = jnp.take_along_axis(
+            block_tables, logical[:, None], axis=1)[:, 0]
+        phys = jnp.where(active, phys, cache.n_blocks).astype(jnp.int32)
+        offs = (positions % bs).astype(jnp.int32)
+
+        if cfg.position_embedding_type == "learned":
+            x = self.embed.apply({"params": params.embedding}, tokens,
+                                 positions[:, None])
+        else:
+            x = self.embed.apply({"params": params.embedding}, tokens)
+        # x: [1, max_batch, hidden]
+        rope = self._rope_tables(positions, x.dtype)
+
+        def rope_fn(q, k):
+            if rope is None:
+                return q, k
+            cos, sin = rope
+            return (apply_rotary_decode(q, cos, sin),
+                    apply_rotary_decode(k, cos, sin))
+
+        attend = (paged_attention_decode if self.fused_attention
+                  else paged_attention_decode_unfused)
+
+        def attn_core(q, k, v, k_layer, v_layer):
+            # append this token's K/V, then attend over the paged cache
+            k_layer = k_layer.at[phys, offs].set(
+                k[0].astype(k_layer.dtype), mode="drop")
+            v_layer = v_layer.at[phys, offs].set(
+                v[0].astype(v_layer.dtype), mode="drop")
+            ctx = attend(q[0], k_layer, v_layer, block_tables, lengths)
+            return ctx.reshape(1, b, -1).astype(q.dtype), k_layer, v_layer
+
+        x, k_arena, v_arena = self._layer_stack(
+            params, x, k_arena, v_arena, attn_core, rope_fn)
+        next_tokens, logits = self._head(params, x)
+        return k_arena, v_arena, next_tokens[0], logits[0]
+
+    def prefill(self, k_arena, v_arena, params, tokens, position_ids,
+                segment_ids, dest_blocks, dest_offsets):
+        """Packed multi-request prefill of one ``[1, L]`` row
+        (shard_map body).
+
+        ``position_ids [1, L]`` — each token's position *within its
+        request* (restarting per segment; also the RoPE angle source,
+        so packing composes with rope); ``segment_ids [1, L]`` — 1-based
+        request ids, 0 = padding (the flash-attention varlen mechanism:
+        causal ∧ same-segment = per-request causal attention);
+        ``dest_blocks/dest_offsets [L]`` — each token's physical cache
+        destination (out-of-range = dropped, used for padding).
+        Returns ``(k_arena, v_arena, next_tokens [L], logits [L,
+        vocab])`` — the greedy next token *at every position*; the host
+        reads each request's last-prompt-position entry as its first
+        generated token.
+        """
+        from apex_tpu.ops.flash_attention import flash_attention
+
+        cfg = self.cfg
+        L = tokens.shape[1]
+        dest_blocks = dest_blocks.astype(jnp.int32)
+        dest_offsets = dest_offsets.astype(jnp.int32)
+
+        if cfg.position_embedding_type == "learned":
+            x = self.embed.apply({"params": params.embedding}, tokens,
+                                 position_ids)
+        else:
+            x = self.embed.apply({"params": params.embedding}, tokens)
+        # x: [L, 1, hidden]
+        rope = self._rope_tables(position_ids[0], x.dtype)
+
+        def rope_fn(q, k):
+            if rope is None:
+                return q, k
+            cos, sin = rope
+            return apply_rotary(q, cos, sin), apply_rotary(k, cos, sin)
+
+        def attn_core(q, k, v, k_layer, v_layer):
+            # q [L, 1, n_local, d]; k/v [L, 1, g_local, d] (compact GQA)
+            k_layer = k_layer.at[dest_blocks, dest_offsets].set(
+                k[:, 0].astype(k_layer.dtype), mode="drop")
+            v_layer = v_layer.at[dest_blocks, dest_offsets].set(
+                v[:, 0].astype(v_layer.dtype), mode="drop")
+            ke, ve = k, v
+            if self.hpg > 1:
+                ke = jnp.repeat(ke, self.hpg, axis=2)
+                ve = jnp.repeat(ve, self.hpg, axis=2)
+            ctx = flash_attention(
+                q.transpose(1, 2, 0, 3), ke.transpose(1, 2, 0, 3),
+                ve.transpose(1, 2, 0, 3), causal=True,
+                segment_ids_q=segment_ids, segment_ids_kv=segment_ids,
+            )  # [1, n_local, L, d]
+            return (ctx.transpose(2, 0, 1, 3).reshape(L, 1, -1)
+                    .astype(q.dtype), k_layer, v_layer)
+
+        x, k_arena, v_arena = self._layer_stack(
+            params, x, k_arena, v_arena, attn_core, rope_fn)
+        next_tokens, logits = self._head(params, x)
+        return k_arena, v_arena, next_tokens[:, 0], logits[:, 0]

@@ -1,0 +1,216 @@
+"""Fused paged-attention decode kernel (Pallas) + its unfused XLA twin.
+
+The decode-step attention of the serving runtime: one query token per
+active slot attends over that request's KV cache, which lives scattered
+across fixed-size blocks of the pooled arena
+(:mod:`apex_tpu.serving.kv_cache`).  The unfused XLA lowering needs a
+big gather (materialising ``[batch, max_seq, heads, head_dim]`` K/V
+copies in HBM) followed by an unfused chain of elementwise/reduction
+ops — exactly the decode profile the operation-fusion paper (PAPERS.md,
+arxiv 2502.17728) measures as the dominant cost.  The fused kernel does
+**gather + online-softmax attention in one pass**:
+
+- grid ``(batch, max_blocks)`` with the block index innermost; the
+  K/V **index maps read the block table** (scalar prefetch —
+  ``pltpu.PrefetchScalarGridSpec``), so each grid step's HBM→VMEM copy
+  pulls the right physical block directly.  No gathered K/V copy ever
+  exists in HBM.
+- blocks past the request's length are skipped with ``pl.when`` (no
+  MXU/VPU work) and their index maps **clamp to the last live block**,
+  so Pallas elides the HBM copy too — the paged analog of the flash
+  kernel's causal block skipping (``ops/flash_attention.py``).
+- running ``(m, l, acc)`` online-softmax state lives in VMEM scratch
+  across the block sweep (the flash decomposition), so VMEM holds
+  O(block) state however long the context.
+- K/V are read in their **storage dtype** and upcast to fp32 inside
+  the kernel (the fused-dequant convention — a bf16 cache moves half
+  the HBM bytes and the dequant rides the same VMEM residency).
+- grouped-query attention: the arena stores the compact ``kv_heads``
+  (= query groups); the kernel broadcasts each group across its query
+  heads *in VMEM* — the GQA bandwidth saving is precisely the point of
+  storing groups, not heads.
+
+Layouts::
+
+    q:            [batch, n_heads, head_dim]      (one token per slot)
+    k/v arena:    [n_blocks, block_size, kv_heads, head_dim]
+    block_tables: [batch, max_blocks]  int32  (entries past the live
+                  range may be anything in-range; they are clamped)
+    lengths:      [batch] int32  (tokens in cache; 0 = inactive slot)
+    out:          [batch, n_heads, head_dim]  (zeros for length 0)
+
+``interpret=True`` is selected automatically off-TPU so the same code
+runs on the CPU test mesh (the flash-attention convention).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["paged_attention_decode", "paged_attention_decode_unfused"]
+
+NEG_INF = -1e30
+_LANES = 128
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _resolve(scale: Optional[float], d: int) -> float:
+    return (1.0 / (d ** 0.5)) if scale is None else scale
+
+
+def _kernel(tab_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
+            m_sc, l_sc, acc_sc, *, scale: float, block_size: int, hpg: int):
+    i = pl.program_id(0)
+    j = pl.program_id(1)
+    num_blocks = pl.num_programs(1)
+    length = len_ref[i]
+
+    @pl.when(j == 0)
+    def _init():
+        m_sc[...] = jnp.full_like(m_sc, NEG_INF)
+        l_sc[...] = jnp.zeros_like(l_sc)
+        acc_sc[...] = jnp.zeros_like(acc_sc)
+
+    @pl.when(j * block_size < length)
+    def _body():
+        q = q_ref[0].astype(jnp.float32)            # [n, d]
+        # in-kernel dequant: storage dtype (bf16/fp32 cache) -> fp32
+        k = k_ref[0].astype(jnp.float32)            # [bs, g, d]
+        v = v_ref[0].astype(jnp.float32)
+        if hpg > 1:                                  # GQA broadcast in VMEM
+            k = jnp.repeat(k, hpg, axis=1)           # [bs, n, d]
+            v = jnp.repeat(v, hpg, axis=1)
+        s = jnp.einsum("nd,tnd->nt", q, k) * scale   # [n, bs]
+        cols = j * block_size + jax.lax.broadcasted_iota(
+            jnp.int32, (1, block_size), 1)
+        s = jnp.where(cols < length, s, NEG_INF)
+
+        m = m_sc[:, 0]
+        l = l_sc[:, 0]
+        m_new = jnp.maximum(m, jnp.max(s, axis=1))
+        # all-masked-row guard (flash convention): exp against a NEG_INF
+        # max must yield 0 mass, not exp(0)=1 per masked entry
+        m_safe = jnp.where(m_new <= NEG_INF * 0.5, 0.0, m_new)
+        p = jnp.exp(s - m_safe[:, None])
+        alpha = jnp.exp(jnp.minimum(m - m_new, 0.0))
+        l_new = l * alpha + jnp.sum(p, axis=1)
+        acc_new = acc_sc[...] * alpha[:, None] + jnp.einsum(
+            "nt,tnd->nd", p, v)
+        m_sc[...] = jnp.broadcast_to(m_new[:, None], m_sc.shape)
+        l_sc[...] = jnp.broadcast_to(l_new[:, None], l_sc.shape)
+        acc_sc[...] = acc_new
+
+    @pl.when(j == num_blocks - 1)
+    def _finalize():
+        l_fin = l_sc[:, 0]
+        l_safe = jnp.where(l_fin == 0.0, 1.0, l_fin)
+        o_ref[0] = (acc_sc[...] / l_safe[:, None]).astype(o_ref.dtype)
+
+
+def paged_attention_decode(q, k_arena, v_arena, block_tables, lengths, *,
+                           block_size: Optional[int] = None,
+                           scale: Optional[float] = None):
+    """One fused gather+attention pass over the paged cache.
+
+    See the module docstring for layouts.  ``block_tables`` entries are
+    clamped into the live range, so unused table columns may hold any
+    value (the scheduler leaves them 0); a slot with ``lengths == 0``
+    produces a zero output row.
+    """
+    b, n, d = q.shape
+    n_blocks, bs, g, dk = k_arena.shape
+    if block_size is not None and block_size != bs:
+        raise ValueError(
+            f"block_size ({block_size}) != arena block dim ({bs})")
+    if dk != d:
+        raise ValueError(f"head_dim mismatch: q {d}, arena {dk}")
+    if n % g:
+        raise ValueError(f"n_heads ({n}) not a multiple of kv_heads ({g})")
+    hpg = n // g
+    max_blocks = block_tables.shape[1]
+
+    def kv_idx(i, j, tab_ref, len_ref):
+        # clamp skipped blocks to the last live one: Pallas re-references
+        # the previous block and elides the HBM copy (flash's causal
+        # skip); length 0 clamps to logical block 0 -> table entry 0.
+        live = jnp.maximum((len_ref[i] - 1) // bs, 0)
+        return (tab_ref[i, jnp.minimum(j, live)], 0, 0, 0)
+
+    def q_idx(i, j, tab_ref, len_ref):
+        return (i, 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, max_blocks),
+        in_specs=[
+            pl.BlockSpec((1, n, d), q_idx),
+            pl.BlockSpec((1, bs, g, d), kv_idx),
+            pl.BlockSpec((1, bs, g, d), kv_idx),
+        ],
+        out_specs=pl.BlockSpec((1, n, d), q_idx),
+        scratch_shapes=[
+            pltpu.VMEM((n, _LANES), jnp.float32),
+            pltpu.VMEM((n, _LANES), jnp.float32),
+            pltpu.VMEM((n, d), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(_kernel, scale=_resolve(scale, d),
+                               block_size=bs, hpg=hpg)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, n, d), q.dtype),
+        compiler_params=_compiler_params(),
+        interpret=_interpret(),
+    )(block_tables.astype(jnp.int32), lengths.astype(jnp.int32),
+      q, k_arena, v_arena)
+
+
+def _compiler_params():
+    """Batch dim is independent (parallel, megacore-splittable); the
+    block sweep carries the online-softmax scratch (arbitrary)."""
+    params_cls = getattr(pltpu, "CompilerParams", None) \
+        or pltpu.TPUCompilerParams
+    return params_cls(dimension_semantics=("parallel", "arbitrary"))
+
+
+def paged_attention_decode_unfused(q, k_arena, v_arena, block_tables,
+                                   lengths, *, scale: Optional[float] = None):
+    """The plain-XLA lowering of the same computation — the A/B baseline
+    (bench ``serving.vs_unfused``) and the parity reference.
+
+    Materialises the gathered ``[batch, max_blocks*block, heads, d]``
+    K/V copies in HBM and lets XLA lower the softmax chain — the
+    unfused decode profile the Pallas kernel exists to beat.
+    """
+    b, n, d = q.shape
+    _, bs, g, _ = k_arena.shape
+    hpg = n // g
+    # gather the whole table per slot: [b, max_blocks, bs, g, d]
+    k = jnp.take(k_arena, block_tables, axis=0).astype(jnp.float32)
+    v = jnp.take(v_arena, block_tables, axis=0).astype(jnp.float32)
+    t = block_tables.shape[1] * bs
+    k = k.reshape(b, t, g, d)
+    v = v.reshape(b, t, g, d)
+    if hpg > 1:
+        k = jnp.repeat(k, hpg, axis=2)
+        v = jnp.repeat(v, hpg, axis=2)
+    s = jnp.einsum("bnd,btnd->bnt", q.astype(jnp.float32), k)
+    s = s * _resolve(scale, d)
+    mask = jnp.arange(t)[None, None, :] < lengths[:, None, None]
+    s = jnp.where(mask, s, NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    m_safe = jnp.where(m <= NEG_INF * 0.5, 0.0, m)
+    p = jnp.exp(s - m_safe)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    out = jnp.einsum("bnt,btnd->bnd", p, v) / jnp.where(l == 0.0, 1.0, l)
+    return out.astype(q.dtype)

@@ -1,0 +1,190 @@
+"""Host-side continuous-batching scheduler.
+
+The state machine the engine drives once per step:
+
+    WAITING --admit (slot + blocks free)--> RUNNING --eos / budget /
+        max_seq--> FINISHED
+    WAITING --drain--> CANCELLED
+
+- **Admission** is all-or-nothing per request: a free decode slot AND
+  the request's *worst-case* block count
+  (``blocks_for(min(prompt + max_new_tokens, max_seq))``) must both be
+  available.  Reserving the worst case up front means a running
+  request can never fail a mid-flight block append — the pool is a
+  hard admission control, not an eviction policy (documented trade:
+  lower occupancy than optimistic allocation + preemption, but no
+  request ever restarts).  Blocks are fixed-size so this is a pure
+  counter check — fragmentation cannot strand capacity
+  (``kv_cache.BlockAllocator``).
+- **Slots** are indices into the engine's fixed ``[max_batch]`` decode
+  arrays; a request keeps one slot from admission to finish.  Churn
+  rewrites the slot's row of the block-table/length arrays — data,
+  never shape, which is what the zero-recompile contract rests on.
+- **Draining** (preemption): no further admissions; RUNNING requests
+  decode to completion and deliver their responses; WAITING requests
+  are cancelled immediately (the submitter sees a terminal state, not
+  a hang) — the serving analog of the PR 3 drain-then-exit.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import enum
+import itertools
+import time
+from typing import Deque, List, Optional, Sequence
+
+import numpy as np
+
+from apex_tpu.serving.kv_cache import BlockAllocator, KVCacheConfig
+
+__all__ = ["Request", "RequestState", "Scheduler"]
+
+
+class RequestState(enum.Enum):
+    WAITING = "waiting"
+    RUNNING = "running"
+    FINISHED = "finished"
+    CANCELLED = "cancelled"
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request and its live serving state."""
+
+    rid: int
+    prompt: np.ndarray                  # int32 [prompt_len]
+    max_new_tokens: int
+    eos_id: Optional[int] = None
+
+    state: RequestState = RequestState.WAITING
+    output_tokens: List[int] = dataclasses.field(default_factory=list)
+    blocks: List[int] = dataclasses.field(default_factory=list)
+    slot: Optional[int] = None
+    cache_len: int = 0                  # tokens currently in the paged cache
+
+    # wall-clock marks for the latency metrics (engine-stamped)
+    t_submit: float = 0.0
+    t_first_token: Optional[float] = None
+    t_last_token: Optional[float] = None
+
+    @property
+    def done(self) -> bool:
+        return self.state in (RequestState.FINISHED, RequestState.CANCELLED)
+
+    @property
+    def last_token(self) -> int:
+        if self.output_tokens:
+            return self.output_tokens[-1]
+        return int(self.prompt[-1])
+
+
+class Scheduler:
+    """Slot + block bookkeeping for the continuous batch."""
+
+    def __init__(self, cache: KVCacheConfig, max_batch: int):
+        self.cache = cache
+        self.max_batch = max_batch
+        self.allocator = BlockAllocator(cache.n_blocks)
+        self.slots: List[Optional[Request]] = [None] * max_batch
+        self.waiting: Deque[Request] = collections.deque()
+        self._ids = itertools.count()
+        self.draining = False
+
+    # ------------------------------------------------------------- submit
+
+    def submit(self, prompt: Sequence[int], max_new_tokens: int,
+               eos_id: Optional[int] = None) -> Request:
+        prompt = np.asarray(prompt, np.int32)
+        if prompt.ndim != 1 or prompt.size == 0:
+            raise ValueError("prompt must be a non-empty 1-D token list")
+        if prompt.size >= self.cache.max_seq:
+            raise ValueError(
+                f"prompt of {prompt.size} tokens does not fit max_seq="
+                f"{self.cache.max_seq} with room to generate")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        req = Request(rid=next(self._ids), prompt=prompt,
+                      max_new_tokens=max_new_tokens, eos_id=eos_id,
+                      t_submit=time.monotonic())
+        need = self._worst_case_blocks(req)
+        if need > self.allocator.n_blocks:
+            # admission is the only allocation point, so a request the
+            # WHOLE pool cannot cover would sit at the head of the FIFO
+            # queue forever, starving everything behind it — reject it
+            # at the door instead
+            raise ValueError(
+                f"request needs {need} blocks worst-case "
+                f"(prompt {prompt.size} + max_new_tokens "
+                f"{max_new_tokens}) but the arena has only "
+                f"{self.allocator.n_blocks}; raise n_blocks or lower "
+                "max_new_tokens")
+        if self.draining:
+            req.state = RequestState.CANCELLED
+            return req
+        self.waiting.append(req)
+        return req
+
+    # -------------------------------------------------------------- admit
+
+    def _worst_case_blocks(self, req: Request) -> int:
+        horizon = min(len(req.prompt) + req.max_new_tokens,
+                      self.cache.max_seq)
+        return self.cache.blocks_for(horizon)
+
+    def free_slots(self) -> List[int]:
+        return [i for i, r in enumerate(self.slots) if r is None]
+
+    def admit(self) -> List[Request]:
+        """Move WAITING requests into free slots while capacity lasts
+        (FIFO — no request starves behind a later, smaller one).
+        Returns the newly-admitted requests; the engine prefills them."""
+        admitted: List[Request] = []
+        if self.draining:
+            return admitted
+        free = self.free_slots()
+        while self.waiting and free:
+            req = self.waiting[0]
+            need = self._worst_case_blocks(req)
+            if not self.allocator.can_alloc(need):
+                break
+            self.waiting.popleft()
+            req.blocks = self.allocator.alloc(need, owner=req.rid)
+            req.slot = free.pop(0)
+            req.state = RequestState.RUNNING
+            req.cache_len = 0
+            self.slots[req.slot] = req
+            admitted.append(req)
+        return admitted
+
+    # ------------------------------------------------------------- finish
+
+    def finish(self, req: Request) -> None:
+        """Release a RUNNING request's slot and blocks."""
+        if req.state is not RequestState.RUNNING:
+            raise ValueError(f"finish() on {req.state} request {req.rid}")
+        self.allocator.free(req.blocks, owner=req.rid)
+        req.blocks = []
+        self.slots[req.slot] = None
+        req.slot = None
+        req.state = RequestState.FINISHED
+        req.t_last_token = time.monotonic()
+
+    def running(self) -> List[Request]:
+        return [r for r in self.slots if r is not None]
+
+    def drain(self) -> List[Request]:
+        """Stop admissions and cancel the queue; running requests keep
+        their slots (the engine decodes them to completion).  Returns
+        the cancelled requests."""
+        self.draining = True
+        cancelled = list(self.waiting)
+        self.waiting.clear()
+        for req in cancelled:
+            req.state = RequestState.CANCELLED
+        return cancelled
+
+    @property
+    def idle(self) -> bool:
+        return not self.waiting and all(r is None for r in self.slots)

@@ -30,7 +30,7 @@ from typing import Tuple
 
 import jax.numpy as jnp
 
-__all__ = ["rotary_cos_sin", "apply_rotary"]
+__all__ = ["rotary_cos_sin", "apply_rotary", "apply_rotary_decode"]
 
 
 def rotary_cos_sin(positions, rotary_dim: int, base: float = 10000.0,
@@ -51,14 +51,10 @@ def rotary_cos_sin(positions, rotary_dim: int, base: float = 10000.0,
     return jnp.cos(angles).astype(dtype), jnp.sin(angles).astype(dtype)
 
 
-def apply_rotary(x, cos, sin):
-    """Rotate the leading ``2 * cos.shape[-1]`` channels of ``x``
-    ``[s, b, n, d]`` (Megatron's ``[sq, b, np, hn]`` layout); channels
-    past ``rotary_dim`` pass through (``rotary_percent < 1``)."""
+def _rotate(x, cos, sin):
+    """Half-rotation with pre-broadcast cos/sin (shaped to x's rank)."""
     half = cos.shape[-1]
     rotary_dim = 2 * half
-    cos = cos[:, None, None, :]  # broadcast over [b, n]
-    sin = sin[:, None, None, :]
     x1 = x[..., :half]
     x2 = x[..., half:rotary_dim]
     rotated = jnp.concatenate(
@@ -66,3 +62,21 @@ def apply_rotary(x, cos, sin):
     if rotary_dim == x.shape[-1]:
         return rotated
     return jnp.concatenate([rotated, x[..., rotary_dim:]], axis=-1)
+
+
+def apply_rotary(x, cos, sin):
+    """Rotate the leading ``2 * cos.shape[-1]`` channels of ``x``
+    ``[s, b, n, d]`` (Megatron's ``[sq, b, np, hn]`` layout); channels
+    past ``rotary_dim`` pass through (``rotary_percent < 1``)."""
+    # cos/sin [s, half]: broadcast over [b, n]
+    return _rotate(x, cos[:, None, None, :], sin[:, None, None, :])
+
+
+def apply_rotary_decode(x, cos, sin):
+    """Decode-step rotation: ``x [1, b, n, d]`` (one token per batch
+    slot) with **per-slot** positions — ``cos``/``sin`` ``[b, half]``
+    from ``rotary_cos_sin(positions[b], ...)``.  The serving runtime's
+    form of the same half-rotation: in a continuously-batched decode
+    step every slot sits at a different sequence position, so the
+    tables broadcast over the head dim but vary along batch."""
+    return _rotate(x, cos[None, :, None, :], sin[None, :, None, :])

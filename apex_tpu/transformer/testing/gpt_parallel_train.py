@@ -103,6 +103,7 @@ def build_gpt_3d(
     moe_aux_coeff: float = 1e-2,
     remat_ticks=None,
     packed_inputs: bool = False,
+    block_diagonal: bool = False,
 ):
     """Return ``(init_fn, train_step, param_specs_fn)``.
 
@@ -128,12 +129,35 @@ def build_gpt_3d(
     :func:`~apex_tpu.data.sequence.segment_loss_mask` so no position
     predicts across a document boundary or into padding.  The loss
     becomes masked-sum / masked-count (accumulated across microbatches),
-    the attention stays plain causal (the standard packed pre-training
-    trade; the segment ids carry enough information for block-diagonal
-    masks later).  Everything else — pipeline, sentinel, telemetry,
-    collective budget — is unchanged.
+    and by default the attention stays plain causal (the standard packed
+    pre-training trade).  Everything else — pipeline, sentinel,
+    telemetry, collective budget — is unchanged.
+
+    ``block_diagonal`` (requires ``packed_inputs`` and
+    ``config.use_flash_attention``): close the packed trade — the
+    per-microbatch segment ids ride the pipelined activation pytree
+    (rotating with the microbatch they describe; int leaves carry no
+    tangent, so the backward schedule is untouched) and feed the flash
+    kernel's segment masking, so attention is **block-diagonal causal**
+    — no position attends back into the previous document.  The fused
+    softmax core has no segment mechanism (it would silently ignore
+    them), hence the flash requirement.  Full-coverage segments (one
+    document spanning the row) reproduce the plain-causal forward
+    bitwise: the combined causal∧same-segment mask degenerates to the
+    causal mask and the kernel arithmetic is unchanged
+    (``tests/test_sequence_data.py``).
     """
     cfg = config
+    if block_diagonal:
+        if not packed_inputs:
+            raise ValueError(
+                "block_diagonal requires packed_inputs=True — the segment "
+                "ids that define the blocks arrive with the packed batch")
+        if not cfg.use_flash_attention:
+            raise ValueError(
+                "block_diagonal requires config.use_flash_attention: the "
+                "fused-softmax attention core has no segment-mask "
+                "mechanism and would silently ignore the ids")
     if mesh is None:
         from apex_tpu.parallel.mesh import get_mesh
         mesh = get_mesh()
@@ -232,18 +256,39 @@ def build_gpt_3d(
         # per tick for the same _check_names reason as the loss below.
         aux0 = jnp.zeros((num_microbatches, 1), jnp.float32)
 
-        def stage_fn(lp, xa):
-            x, aux = xa
-            y, mut = layer.apply({"params": lp}, x, None,
-                                 mutable=["losses"])
-            from apex_tpu.transformer.moe import collect_moe_aux
+        if block_diagonal:
+            # Segment ids ride the activation pytree so each microbatch's
+            # ids rotate with its activations through the schedule (int32,
+            # tangent-free — the transposed pipeline is unchanged); every
+            # stage feeds them to the flash kernel's segment masking.
+            def stage_fn(lp, xa):
+                x, aux, seg = xa
+                y, mut = layer.apply({"params": lp}, x, None,
+                                     segment_ids=seg,
+                                     mutable=["losses"])
+                from apex_tpu.transformer.moe import collect_moe_aux
 
-            return y, aux + collect_moe_aux(mut)
+                return y, aux + collect_moe_aux(mut), seg
 
-        out, aux_out = pipeline_apply(
-            stage_fn, p.layers, (h, aux0), axis=pp_axis, num_chunks=vpp,
-            params_already_local=True, remat_ticks=remat_ticks,
-        )
+            out, aux_out, _ = pipeline_apply(
+                stage_fn, p.layers, (h, aux0, seg_mbs), axis=pp_axis,
+                num_chunks=vpp, params_already_local=True,
+                remat_ticks=remat_ticks,
+            )
+        else:
+            def stage_fn(lp, xa):
+                x, aux = xa
+                y, mut = layer.apply({"params": lp}, x, None,
+                                     mutable=["losses"])
+                from apex_tpu.transformer.moe import collect_moe_aux
+
+                return y, aux + collect_moe_aux(mut)
+
+            out, aux_out = pipeline_apply(
+                stage_fn, p.layers, (h, aux0), axis=pp_axis,
+                num_chunks=vpp, params_already_local=True,
+                remat_ticks=remat_ticks,
+            )
 
         def logits_of(hid):
             hid = final_ln.apply({"params": p.final_ln}, hid)

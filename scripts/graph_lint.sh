@@ -1,7 +1,8 @@
 #!/usr/bin/env bash
 # Graph lint (ISSUE 4): run the static-analysis rulebook over every
 # registered entry config (3D GPT trainer, ZeRO train steps, dryrun MoE
-# config, overlap rings) on the CPU mesh.  Exit 0 = no ERROR finding.
+# config, overlap rings, reshard restore, serving decode) on the CPU
+# mesh.  Exit 0 = no ERROR finding.
 #
 # This is the CI face of apex_tpu.analysis: the rules that mechanize the
 # repo's mesh-correctness invariants (docs/analysis.md has the rulebook).

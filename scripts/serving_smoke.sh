@@ -1,0 +1,25 @@
+#!/usr/bin/env bash
+# Serving smoke (ISSUE 9 satellite): spin up the continuous-batching
+# decode runtime (apex_tpu.serving) on the virtual CPU mesh, stream N
+# requests with staggered arrivals and lengths, and assert:
+#   - continuously-batched greedy decode is TOKEN-IDENTICAL to a
+#     per-request full-forward argmax reference,
+#   - the decode step compiled exactly ONCE across all request churn
+#     (the zero-recompile contract),
+#   - a real SIGTERM drains cleanly: in-flight responses delivered,
+#     queued requests cancelled, exit 0.
+# (The KV-arena donation contract is the analyzer's job:
+#  scripts/graph_lint.sh --entries serving_decode, rule APX204.)
+# Wired fast-tier in tests/test_aux_subsystems.py like the PR 7 data
+# smoke.
+#
+# Usage: scripts/serving_smoke.sh
+set -euo pipefail
+
+REPO="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+PYTHON="${PYTHON:-python}"
+
+cd "$REPO"
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+  "$PYTHON" apex_tpu/testing/serving_smoke.py
+echo "PASS" >&2

@@ -1,6 +1,7 @@
 """Aux subsystems: timers export, autoresume protocol, rank logger
-(SURVEY §5 tracing / failure-detection / observability rows), and the
-input-pipeline smoke script (ISSUE 8 CI satellite)."""
+(SURVEY §5 tracing / failure-detection / observability rows), the
+input-pipeline smoke script (ISSUE 8 CI satellite), and the serving
+smoke script (ISSUE 9 CI satellite)."""
 
 import json
 import logging
@@ -126,3 +127,25 @@ def test_data_pipeline_smoke_script(tmp_path):
         f"data_pipeline_smoke.sh rc={proc.returncode}\n"
         f"stderr tail:\n{proc.stderr.decode(errors='replace')[-3000:]}")
     assert b"PASS" in proc.stderr
+
+
+def test_serving_smoke_script():
+    """scripts/serving_smoke.sh end to end (ISSUE 9): continuously-
+    batched greedy decode token-identical to the per-request
+    full-forward reference across staggered request churn, exactly one
+    decode compile, and a clean SIGTERM drain (in-flight delivered,
+    queue cancelled).  Subprocess because the smoke sends itself a real
+    SIGTERM and owns its own platform/mesh pinning."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    env["PYTHON"] = sys.executable
+    proc = subprocess.run(
+        ["bash", os.path.join(repo, "scripts", "serving_smoke.sh")],
+        cwd=repo, env=env, capture_output=True, timeout=300)
+    assert proc.returncode == 0, (
+        f"serving_smoke.sh rc={proc.returncode}\n"
+        f"stderr tail:\n{proc.stderr.decode(errors='replace')[-3000:]}")
+    assert b"PASS" in proc.stderr
+    assert b"phase A OK" in proc.stderr and b"phase B OK" in proc.stderr

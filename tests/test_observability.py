@@ -322,6 +322,32 @@ class TestJsonlCrashSafety:
         assert r0.flush(w, step=0)["metrics"]["x"] == 2.0
         assert len(read_jsonl(path)) == 1
 
+    def test_histogram_percentiles(self):
+        """keep_samples histograms (the serving latency metrics) expose
+        nearest-rank percentiles over a BOUNDED window; plain
+        histograms stay sample-free and answer None."""
+        reg = MetricRegistry(rank=0)
+        h = reg.histogram("serving/tpot_ms", keep_samples=100)
+        assert h.percentile(50) is None        # nothing observed yet
+        for v in range(1, 101):                # 1..100
+            h.observe(float(v))
+        assert h.percentile(50) == 50.0
+        assert h.percentile(99) == 99.0
+        assert h.percentile(0) == 1.0 and h.percentile(100) == 100.0
+        s = h.summary()
+        assert s["p50"] == 50.0 and s["p99"] == 99.0 and s["count"] == 100
+        # window is bounded: 100 more observations evict the old ones
+        for v in range(1000, 1100):
+            h.observe(float(v))
+        assert h.percentile(0) == 1000.0 and h.count == 200
+        # keep_samples applies on first creation only (no silent
+        # truncation of someone else's window)
+        assert reg.histogram("serving/tpot_ms") is h
+        plain = reg.histogram("plain")
+        plain.observe(1.0)
+        assert plain.percentile(50) is None
+        assert "p50" not in plain.summary()
+
 
 class TestHeartbeat:
     def test_flags_hung_checkpoint_write_to_preemption_guard(
