@@ -252,6 +252,13 @@ class DevicePrefetcher:
         stall_ms = (time.perf_counter() - t0) * 1e3
         self._registry.gauge("data/stall_ms").set(stall_ms)
         self._registry.histogram("span_ms/data/next_wait").observe(stall_ms)
+        # Flight recorder (no-op unless armed): the same blocking wait,
+        # as a timeline interval feeding the goodput ``data_stall``
+        # bucket (docs/observability.md) — this is main-thread time
+        # outside any step scope, so attribution stays disjoint.
+        from apex_tpu.observability import timeline
+
+        timeline.emit("data_stall", dur_s=stall_ms / 1e3)
         return out
 
     # -- shutdown ------------------------------------------------------

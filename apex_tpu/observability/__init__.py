@@ -27,18 +27,39 @@ layers the production JAX/PyTorch trainers treat as first-class
   :class:`JsonlWriter` whose reader tolerates torn tails (the PR 3
   crash-safety contract, applied to metrics).
 
-Catalog, span map, and the profiler-capture cookbook:
-``docs/observability.md``.
+- :mod:`.timeline` + :mod:`.goodput` + :mod:`.debug_server` — the
+  **run-timeline** layer (ISSUE 10): a crash-safe monotonic-clock
+  :class:`FlightRecorder` (bounded ring + JSONL spill, torn-tail-only
+  loss) fed by the trainer drivers, ``CheckpointManager``,
+  ``DevicePrefetcher``, and the serving engine; a goodput/badput
+  report attributing every wall-clock second to one bucket (compute /
+  compile / data stall / checkpoint / restore / skipped / drain /
+  other) plus per-request serving attribution; and an opt-in stdlib
+  HTTP :class:`DebugServer` (``/metrics`` Prometheus text,
+  ``/statusz`` live timeline tail + goodput + engine state).
+
+Catalog, span map, timeline schema, goodput cookbook, and the
+profiler-capture cookbook: ``docs/observability.md``.
 """
 
+from apex_tpu.observability.debug_server import DebugServer
+from apex_tpu.observability.goodput import (
+    format_report,
+    goodput_report,
+    serving_goodput_report,
+)
 from apex_tpu.observability.metrics import (
     HeartbeatMonitor,
     MetricRegistry,
     compiled_flops,
     default_registry,
+    is_host_local,
     mfu,
+    mfu_or_reason,
     peak_flops_for,
+    peak_flops_reason,
 )
+from apex_tpu.observability.timeline import FlightRecorder
 from apex_tpu.observability.spans import (
     TraceWindow,
     named_span,
@@ -76,11 +97,19 @@ __all__ = [
     "TraceWindow",
     "MetricRegistry",
     "default_registry",
+    "is_host_local",
     "HeartbeatMonitor",
     "compiled_flops",
     "peak_flops_for",
+    "peak_flops_reason",
     "mfu",
+    "mfu_or_reason",
     "JsonlWriter",
     "read_jsonl",
     "iter_jsonl",
+    "FlightRecorder",
+    "DebugServer",
+    "goodput_report",
+    "serving_goodput_report",
+    "format_report",
 ]

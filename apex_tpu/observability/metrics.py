@@ -36,9 +36,13 @@ __all__ = [
     "Histogram",
     "MetricRegistry",
     "default_registry",
+    "is_host_local",
+    "HOST_LOCAL_PREFIXES",
     "compiled_flops",
     "peak_flops_for",
+    "peak_flops_reason",
     "mfu",
+    "mfu_or_reason",
     "HeartbeatMonitor",
 ]
 
@@ -155,6 +159,27 @@ class Histogram:
         return out
 
 
+# Catalog prefixes whose values are HOST-LOCAL facts: every process
+# measures its own (this host's input stall, this host's span timings,
+# this host's serving slots), and rank 0's flush describes only rank 0.
+# Everything else in the catalog (``train/*``) is a GLOBAL fact — the
+# in-graph stats are reduced over the mesh before they reach any host,
+# so rank 0's value IS the job's value and the default rank-0-only
+# flush loses nothing.  For the host-local names, opt into
+# ``flush(..., all_ranks=True)`` (rank-stamped records) when per-host
+# visibility matters — docs/observability.md has the split table.
+HOST_LOCAL_PREFIXES = (
+    "data/", "span_ms/", "heartbeat/", "serving/", "ckpt/", "loader/",
+)
+
+
+def is_host_local(name: str) -> bool:
+    """True when a catalog entry is a per-host fact (only the writer
+    rank's value survives a default ``flush``) rather than a globally
+    reduced one."""
+    return name.startswith(HOST_LOCAL_PREFIXES)
+
+
 class MetricRegistry:
     """Named metric store with rank-aware flushing.
 
@@ -203,21 +228,44 @@ class MetricRegistry:
 
     def snapshot(self) -> dict:
         """Flat ``{name: value}`` view (histograms as summary dicts)."""
+        typed = self.snapshot_typed()
+        out: Dict[str, Any] = dict(typed["counters"])
+        out.update(typed["gauges"])
+        out.update(typed["histograms"])
+        return out
+
+    def snapshot_typed(self) -> dict:
+        """Per-kind snapshot ``{"counters": {name: value}, "gauges":
+        {...}, "histograms": {name: summary}}`` — for consumers that
+        must know a metric's kind (the Prometheus exposition needs
+        ``# TYPE`` lines), taken under the registry lock so it is
+        consistent against concurrent recording."""
         with self._lock:
-            out: Dict[str, Any] = {
-                name: c.value for name, c in self._counters.items()}
-            out.update({name: g.value for name, g in self._gauges.items()})
-            out.update({name: h.summary()
-                        for name, h in self._histograms.items()})
-            return out
+            return {
+                "counters": {n: c.value for n, c in self._counters.items()},
+                "gauges": {n: g.value for n, g in self._gauges.items()},
+                "histograms": {n: h.summary()
+                               for n, h in self._histograms.items()},
+            }
 
     def flush(self, writer, *, step: Optional[int] = None,
-              extra: Optional[dict] = None) -> Optional[dict]:
+              extra: Optional[dict] = None,
+              all_ranks: bool = False) -> Optional[dict]:
         """Write one record ``{ts, step, rank, metrics, **extra}`` via
         ``writer.write`` — **only on the writer rank** (other ranks
         return ``None`` without touching storage).  ``writer=None`` is a
-        no-op, so callers thread an optional writer without branching."""
-        if writer is None or not self.is_writer:
+        no-op, so callers thread an optional writer without branching.
+
+        ``all_ranks=True`` opts into a per-rank flush: every process
+        writes its (rank-stamped) record.  This exists because much of
+        the catalog is **host-local** (:func:`is_host_local` —
+        ``data/stall_ms``, loader throughput, span timings): under the
+        default rank-0 gate, a rank-3 input stall is invisible in the
+        durable record.  Point each rank's writer at a rank-qualified
+        path (``metrics.rank{k}.jsonl``) — the JSONL append protocol is
+        line-atomic but interleaving ranks in one file makes per-rank
+        series needlessly order-dependent."""
+        if writer is None or not (self.is_writer or all_ranks):
             return None
         record: Dict[str, Any] = {"ts": time.time(), "rank": self.rank}
         if step is not None:
@@ -255,17 +303,30 @@ _PEAK_FLOPS = (
 )
 
 
-def peak_flops_for(device) -> Optional[float]:
-    """Peak bf16 FLOP/s of a jax device, ``None`` when unknown (CPU —
-    MFU against an undefined peak would be noise, not a metric)."""
+def peak_flops_reason(device):
+    """``(peak_bf16_flops, reason)`` for a jax device — exactly one of
+    the pair is ``None``.  The reason string names *why* MFU is
+    undefined (unknown platform vs missing device) instead of the old
+    silent ``None``, so a report can print "MFU: n/a (<reason>)" rather
+    than dropping the row (ISSUE 10 satellite)."""
+    if device is None:
+        return None, "no device given (peak FLOP/s unknown)"
     kind = getattr(device, "device_kind", "").lower()
     platform = getattr(device, "platform", "")
     if platform != "tpu":
-        return None
+        return None, (f"no peak-FLOPs table entry for platform "
+                      f"{platform!r} (MFU is defined against a TPU peak)")
     for tag, peak in _PEAK_FLOPS:
         if tag in kind:
-            return peak
-    return 197e12  # conservative default (v5e)
+            return peak, None
+    return 197e12, None  # conservative default (v5e)
+
+
+def peak_flops_for(device) -> Optional[float]:
+    """Peak bf16 FLOP/s of a jax device, ``None`` when unknown (CPU —
+    MFU against an undefined peak would be noise, not a metric).  Use
+    :func:`peak_flops_reason` when the caller should say *why*."""
+    return peak_flops_reason(device)[0]
 
 
 def compiled_flops(compiled) -> Optional[float]:
@@ -287,6 +348,30 @@ def compiled_flops(compiled) -> Optional[float]:
     return float(flops) if flops else None
 
 
+def mfu_or_reason(flops_per_step: Optional[float], step_time_s: float, *,
+                  peak_flops: Optional[float] = None,
+                  device=None, n_devices: int = 1):
+    """``(mfu, reason)`` — exactly one of the pair is ``None``.
+
+    The reason distinguishes the two silent-``None`` cases the old API
+    conflated: the backend reporting no cost-analysis FLOP count
+    (:func:`compiled_flops` → ``None``) vs an unknown device peak
+    (CPU / no device).  Callers that can only show a number keep using
+    :func:`mfu`; callers with a text channel (serving ``/statusz``,
+    bench rows, reports) surface the reason."""
+    if step_time_s <= 0:
+        return None, f"non-positive step time ({step_time_s})"
+    if flops_per_step is None:
+        return None, ("backend reported no cost-analysis FLOP count "
+                      "(compiled_flops() returned None)")
+    if peak_flops is None:
+        peak_flops, reason = peak_flops_reason(device)
+        if peak_flops is None:
+            return None, reason
+    value = flops_per_step / step_time_s / (peak_flops * max(n_devices, 1))
+    return value, None
+
+
 def mfu(flops_per_step: Optional[float], step_time_s: float, *,
         peak_flops: Optional[float] = None,
         device=None, n_devices: int = 1) -> Optional[float]:
@@ -296,14 +381,11 @@ def mfu(flops_per_step: Optional[float], step_time_s: float, *,
     :func:`compiled_flops` of the jitted step — under SPMD that is the
     global program, hence ``n_devices`` scales the denominator).
     Returns ``None`` when either the FLOP count or the peak is unknown
-    (CPU) rather than a made-up number."""
-    if flops_per_step is None or step_time_s <= 0:
-        return None
-    if peak_flops is None:
-        peak_flops = peak_flops_for(device) if device is not None else None
-    if peak_flops is None:
-        return None
-    return flops_per_step / step_time_s / (peak_flops * max(n_devices, 1))
+    (CPU) rather than a made-up number; :func:`mfu_or_reason` says
+    which."""
+    return mfu_or_reason(flops_per_step, step_time_s,
+                         peak_flops=peak_flops, device=device,
+                         n_devices=n_devices)[0]
 
 
 # --- heartbeat -----------------------------------------------------------

@@ -38,6 +38,7 @@ import time
 from typing import Any, Optional
 
 from apex_tpu import checkpoint as ckpt
+from apex_tpu.observability import timeline
 from apex_tpu.observability.spans import span
 
 __all__ = ["CheckpointManager"]
@@ -172,8 +173,11 @@ class CheckpointManager:
         path = self._path(step)
         # Host span (wall clock + trace range, docs/observability.md):
         # checkpoint stalls are a classic silent step-time thief — the
-        # span_ms/checkpoint/save histogram makes them a metric.
-        with span("checkpoint/save"):
+        # span_ms/checkpoint/save histogram makes them a metric, and the
+        # flight-recorder event attributes the stall to the goodput
+        # ``checkpoint`` bucket (no-op when no recorder is armed).
+        with span("checkpoint/save"), \
+                timeline.scope("checkpoint_save", step=step):
             if self.sharded:
                 self._with_retries(
                     lambda: ckpt.save_checkpoint_sharded(
@@ -198,8 +202,12 @@ class CheckpointManager:
         self.wait()
         path = self._path(step)
         # Only the snapshot+submission is on the training thread — the
-        # span bounds exactly the step-time cost of an async save.
-        with span("checkpoint/save_async_submit"):
+        # span (and the timeline event feeding the goodput ``checkpoint``
+        # bucket) bounds exactly the step-time cost of an async save;
+        # the background write overlaps compute and is deliberately NOT
+        # timeline-attributed.
+        with span("checkpoint/save_async_submit"), \
+                timeline.scope("checkpoint_save_async_submit", step=step):
             if self.sharded:
                 handle = self._with_retries(
                     lambda: ckpt.save_checkpoint_sharded_async(
@@ -316,7 +324,8 @@ class CheckpointManager:
         """Integrity pass over one step's checkpoint (checksums, torn
         files).  Raises :class:`apex_tpu.checkpoint.CheckpointCorruptError`."""
         path = self._path(step)
-        with span("checkpoint/verify"):
+        with span("checkpoint/verify"), \
+                timeline.scope("checkpoint_verify", step=step):
             if self.sharded:
                 return ckpt.verify_checkpoint_sharded(path)
             return ckpt.verify_checkpoint(path)
@@ -417,7 +426,13 @@ class CheckpointManager:
                         self.verify(step)
                     resharded = (spec is not None
                                  and not self._template_matches(step, like))
-                    with span("checkpoint/restore"):
+                    # timeline: verify and restore are emitted as their
+                    # own disjoint intervals (NOT the restore_latest
+                    # wrapper, which contains both — goodput buckets
+                    # must never double-count).
+                    with span("checkpoint/restore"), \
+                            timeline.scope("checkpoint_restore", step=step,
+                                           resharded=resharded):
                         if resharded:
                             from apex_tpu.resilience import reshard
 

@@ -26,6 +26,13 @@ docs/serving.md):
   ``serving/requests_cancelled`` counters
 - ``serving/active_slots`` / ``serving/free_blocks`` gauges
 - ``serving/preemption_drains`` counter
+- ``serving/mfu``          gauge — decode-step MFU when the device peak
+  is known (``introspect()["mfu_reason"]`` says why otherwise)
+
+Run-timeline (ISSUE 10): with a flight recorder armed
+(:mod:`apex_tpu.observability.timeline`) the engine additionally logs
+the full request lifecycle keyed by request id — see the class
+docstring and docs/observability.md.
 """
 
 from __future__ import annotations
@@ -36,6 +43,7 @@ from typing import Any, List, Optional, Sequence
 
 import numpy as np
 
+from apex_tpu.observability import timeline
 from apex_tpu.parallel import collectives as cc
 from apex_tpu.parallel.mesh import TENSOR_AXIS, get_mesh
 from apex_tpu.serving.kv_cache import (
@@ -80,11 +88,27 @@ class ServingEngine:
     :class:`~apex_tpu.resilience.PreemptionGuard`; once it trips, the
     engine drains — no admissions, running requests decode to
     completion and deliver, waiting ones are cancelled.
+
+    ``heartbeat``: an optional :class:`~apex_tpu.observability.metrics.
+    HeartbeatMonitor` — the engine beats it at the end of every
+    :meth:`step` (after the decode results materialize), so a hung
+    device step (dead collective, wedged transfer) stops the beats, the
+    monitor's ``on_hang`` fires the guard, and the engine's next alive
+    moment **drains** — delivering in-flight responses — instead of the
+    scheduler wedging forever (ISSUE 10 satellite; wire ``on_hang`` to
+    the same ``guard``).
+
+    ``timeline_tick_every``: when a flight recorder is armed
+    (:mod:`apex_tpu.observability.timeline`), every request's lifecycle
+    is logged (submit → admit → prefill → decode ticks → finish/
+    cancel, keyed by ``rid``); decode ticks are sampled every N
+    generated tokens so the hot loop pays one host dict per N tokens,
+    not per token.
     """
 
     def __init__(self, config, serving: ServingConfig, params, *,
                  mesh=None, tp_axis: str = TENSOR_AXIS, registry=None,
-                 guard=None):
+                 guard=None, heartbeat=None, timeline_tick_every: int = 8):
         import jax
         import jax.numpy as jnp
         from jax.sharding import PartitionSpec as P
@@ -161,10 +185,26 @@ class ServingEngine:
         self.registry = registry if registry is not None else \
             default_registry()
         self.guard = guard
+        self.heartbeat = heartbeat
+        if timeline_tick_every < 1:
+            raise ValueError(
+                f"timeline_tick_every must be >= 1, got "
+                f"{timeline_tick_every}")
+        self.timeline_tick_every = timeline_tick_every
         self._tables = np.zeros(
             (serving.max_batch, self.cache.max_blocks_per_request),
             np.int32)
         self._steps = 0
+        # MFU bookkeeping (ISSUE 10 satellite): FLOPs of the decode
+        # program probed once (lazily, pre-donation), last decode wall
+        # time measured each step; serving/mfu flushed as a gauge when
+        # defined, else the reason string is kept for /statusz.
+        self._decode_flops: Optional[float] = None
+        self._last_decode_s: Optional[float] = None
+        self._flops_probed = False
+        self._probe_fail_reason: Optional[str] = None
+        self.mfu: Optional[float] = None
+        self.mfu_reason: Optional[str] = "decode step has not run yet"
 
     # -------------------------------------------------------------- intro
 
@@ -186,11 +226,15 @@ class ServingEngine:
                 f"prompt must be 1-D with at most prefill_len="
                 f"{self.prefill_len} tokens, got shape {np.shape(prompt)}")
         req = self.scheduler.submit(prompt, max_new_tokens, eos_id)
+        timeline.emit("request_submit", rid=req.rid,
+                      prompt_tokens=len(req.prompt),
+                      max_new_tokens=max_new_tokens)
         if req.state is RequestState.CANCELLED:
             # submitted into the drain window: count it like every other
             # cancellation or the catalog undercounts exactly when the
             # operator is watching a preemption
             self.registry.counter("serving/requests_cancelled").inc()
+            timeline.emit("request_cancel", rid=req.rid)
         return req
 
     # --------------------------------------------------------------- drain
@@ -198,10 +242,13 @@ class ServingEngine:
     def drain(self) -> List[Request]:
         """Preemption path: cancel the queue, keep decoding the running
         requests until their responses are delivered."""
+        timeline.emit("preemption", wall_ts=time.time())
         cancelled = self.scheduler.drain()
         if cancelled:
             self.registry.counter("serving/requests_cancelled").inc(
                 len(cancelled))
+        for req in cancelled:
+            timeline.emit("request_cancel", rid=req.rid)
         self.registry.counter("serving/preemption_drains").inc()
         return cancelled
 
@@ -213,6 +260,9 @@ class ServingEngine:
                 and not self.draining):
             self.drain()
         admitted = self.scheduler.admit()
+        for req in admitted:
+            timeline.emit("request_admit", rid=req.rid, slot=req.slot,
+                          blocks=len(req.blocks))
         for row in self._pack_rows(admitted):
             self._prefill_row(row)
         self._decode_once()
@@ -221,6 +271,11 @@ class ServingEngine:
             len(self.scheduler.running()))
         self.registry.gauge("serving/free_blocks").set(
             self.scheduler.allocator.n_free)
+        # the beat lands only after this tick's device work materialized
+        # — a wedged decode stops the beats and the monitor fires the
+        # guard, turning a scheduler wedge into an ordinary drain
+        if self.heartbeat is not None:
+            self.heartbeat.beat(self._steps)
 
     def run_until_drained(self, max_steps: int = 100_000) -> None:
         """Drive :meth:`step` until no request is waiting or running
@@ -270,10 +325,12 @@ class ServingEngine:
             cursor += p
 
         k, v = self.arenas
-        k, v, next_tokens, _ = self._prefill(
-            k, v, self.params, tokens, pos_ids, seg_ids, dest_b, dest_o)
-        self.arenas = (k, v)
-        next_np = np.asarray(next_tokens)
+        with timeline.scope("prefill", rids=[r.rid for r in reqs],
+                            tokens=cursor):
+            k, v, next_tokens, _ = self._prefill(
+                k, v, self.params, tokens, pos_ids, seg_ids, dest_b, dest_o)
+            self.arenas = (k, v)
+            next_np = np.asarray(next_tokens)
 
         now = time.monotonic()
         for req in reqs:
@@ -304,16 +361,85 @@ class ServingEngine:
             active[req.slot] = True
 
         k, v = self.arenas
+        tables = self._jnp.asarray(self._tables)
+        if not self._flops_probed:
+            # One-time FLOPs probe for the MFU gauge: lowering traces
+            # the decode body (no second XLA compile, no execution —
+            # the arenas are not donated by a trace) and the HLO cost
+            # pass reports the program's FLOPs.  Must happen BEFORE the
+            # call below consumes the donated arenas.
+            self._probe_decode_flops(
+                (k, v, self.params, tokens, positions, tables, active))
+        t0 = time.perf_counter()
         k, v, next_tokens, _ = self._decode(
-            k, v, self.params, tokens, positions,
-            self._jnp.asarray(self._tables), active)
+            k, v, self.params, tokens, positions, tables, active)
         self.arenas = (k, v)
         next_np = np.asarray(next_tokens)
+        self._last_decode_s = time.perf_counter() - t0
+        self._refresh_mfu()
 
         now = time.monotonic()
         for req in reqs:
             req.cache_len += 1
             self._emit(req, int(next_np[req.slot]), now)
+
+    # ------------------------------------------------------------------ mfu
+
+    def _probe_decode_flops(self, args) -> None:
+        """Fill ``self._decode_flops`` (or the reason it is unknown)."""
+        from apex_tpu.observability.metrics import compiled_flops
+
+        self._flops_probed = True
+        try:
+            lowered = self._decode.lower(*args)
+        except Exception as e:  # telemetry never breaks serving
+            self._probe_fail_reason = (
+                f"decode lowering for cost analysis failed: {e!r}")
+            self.mfu_reason = self._probe_fail_reason
+            return
+        self._decode_flops = compiled_flops(lowered)
+
+    def _refresh_mfu(self) -> None:
+        """Derive MFU from the last decode's wall time; flush the gauge
+        when defined, keep the None-reason (unknown device peak vs
+        missing cost analysis) for ``/statusz`` and logs otherwise."""
+        from apex_tpu.observability.metrics import mfu_or_reason
+
+        if self._last_decode_s is None:
+            return
+        if self._probe_fail_reason is not None:
+            # keep the specific probe failure — the generic "no
+            # cost-analysis FLOPs" message would misdiagnose it
+            self.mfu, self.mfu_reason = None, self._probe_fail_reason
+            return
+        n_devices = self.mesh.devices.size
+        value, reason = mfu_or_reason(
+            self._decode_flops, self._last_decode_s,
+            device=self.mesh.devices.flat[0], n_devices=n_devices)
+        self.mfu, self.mfu_reason = value, reason
+        if value is not None:
+            self.registry.gauge("serving/mfu").set(value)
+
+    # ---------------------------------------------------------- introspection
+
+    def introspect(self) -> dict:
+        """Live engine state for ``/statusz`` (read-only snapshot; the
+        :class:`~apex_tpu.observability.debug_server.DebugServer`
+        duck-types this)."""
+        return {
+            "steps": self._steps,
+            "active_slots": len(self.scheduler.running()),
+            "free_slots": len(self.scheduler.free_slots()),
+            "free_blocks": self.scheduler.allocator.n_free,
+            "total_blocks": self.scheduler.allocator.n_blocks,
+            "queue_depth": len(self.scheduler.waiting),
+            "draining": self.draining,
+            "decode_compiles": self.decode_compile_count(),
+            "last_decode_ms": (round(self._last_decode_s * 1e3, 3)
+                               if self._last_decode_s is not None else None),
+            "mfu": self.mfu,
+            "mfu_reason": self.mfu_reason,
+        }
 
     # ---------------------------------------------------------- bookkeeping
 
@@ -331,7 +457,10 @@ class ServingEngine:
         req.t_last_token = now
         req.output_tokens.append(token)
         self.registry.counter("serving/tokens_generated").inc()
-        if (len(req.output_tokens) >= req.max_new_tokens
+        n = len(req.output_tokens)
+        if n % self.timeline_tick_every == 0:
+            timeline.emit("decode_tick", rid=req.rid, tokens=n)
+        if (n >= req.max_new_tokens
                 or (req.eos_id is not None and token == req.eos_id)):
             self._finish(req)
 
@@ -339,3 +468,5 @@ class ServingEngine:
         self._tables[req.slot][:] = 0
         self.scheduler.finish(req)
         self.registry.counter("serving/requests_finished").inc()
+        timeline.emit("request_finish", rid=req.rid,
+                      tokens=len(req.output_tokens))

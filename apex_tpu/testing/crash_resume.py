@@ -300,6 +300,17 @@ def main(argv=None) -> int:
     mgr = CheckpointManager(args.ckpt_dir, keep=args.keep,
                             sharded=not args.flat, spec=spec)
 
+    # Flight recorder (ISSUE 10): both trainer legs (3D GPT / flat-bucket
+    # ZeRO) emit the run timeline when APEX_TPU_TIMELINE_DIR is set —
+    # step intervals, sentinel skips, the checkpoint save/verify/restore
+    # events from the manager, and the preemption/drain tail; the spill
+    # survives the SIGKILL this harness exists to inject (torn-tail-only
+    # loss).  Armed before the resume path so restores are on the
+    # timeline too.
+    from apex_tpu.observability import timeline
+
+    recorder = timeline.arm_from_env()
+
     start = 0
     if args.resume:
         try:
@@ -321,12 +332,18 @@ def main(argv=None) -> int:
     def packed(p, s, z):
         return {"params": p, "opt": s, "sent": z}
 
+    prev_skips = int(np.asarray(sent.skipped_steps))
+
+    import time
+
     guard = PreemptionGuard()
     try:
         for i in range(start, args.steps):
+            t_step = time.monotonic()
             params, opt_state, sent, loss = step_fn(params, opt_state,
                                                     data_fn(i), sent)
             loss = jax.block_until_ready(loss)
+            step_s = time.monotonic() - t_step
             # No finiteness assert: the armed sentinel SKIPS an overflow
             # step rather than dying, and a non-finite reported loss is
             # deterministic, so the bit-exact curve comparison still
@@ -334,23 +351,39 @@ def main(argv=None) -> int:
             if not bool(np.isfinite(np.asarray(loss))):
                 print(f"crash_resume: step {i} overflowed (skipped "
                       f"by sentinel)", file=sys.stderr)
+            if recorder is not None:
+                # the step event can only be emitted AFTER the skip
+                # verdict is known — a sentinel-skipped step must land
+                # in the goodput `skipped_step` bucket, not `compute`
+                skips = int(np.asarray(sent.skipped_steps))
+                skipped = skips > prev_skips
+                recorder.emit("step", dur_s=step_s, step=i,
+                              **({"skipped": True} if skipped else {}))
+                if skipped:
+                    recorder.sentinel_skip(i, skips)
+                prev_skips = skips
             _append_loss(args.losses, i, loss)
             mgr.save_async(packed(params, opt_state, sent), i)
             if args.step_delay > 0:
                 # sleep WHILE the async writer is in flight, so an
                 # external SIGKILL can land mid-save
-                import time
-
                 time.sleep(args.step_delay)
             if guard.triggered:
                 # drain the in-flight async save: step i is durable once
                 # wait() returns (no redundant re-save in the grace
                 # window)
-                mgr.wait()
+                if recorder is not None:
+                    recorder.preemption(step=i)
+                with timeline.scope("drain", step=i):
+                    mgr.wait()
+                if recorder is not None:
+                    recorder.flush()
                 print(f"crash_resume: preempted, drained at step {i}, "
                       "clean exit", file=sys.stderr)
                 return 0
         mgr.wait()
+        if recorder is not None:
+            recorder.flush()
     finally:
         guard.uninstall()
     if args.fingerprint:

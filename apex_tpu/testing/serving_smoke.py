@@ -105,18 +105,38 @@ def make_reference(cfg, params):
 
 
 def main() -> int:
-    from apex_tpu.observability.metrics import MetricRegistry
+    from apex_tpu.observability import timeline
+    from apex_tpu.observability.goodput import serving_goodput_report
+    from apex_tpu.observability.metrics import (
+        HeartbeatMonitor, MetricRegistry)
     from apex_tpu.resilience import PreemptionGuard
     from apex_tpu.serving import ServingConfig, ServingEngine
 
     mesh, cfg, params = build()
     registry = MetricRegistry()
 
+    # Flight recorder (ISSUE 10): the smoke runs with the timeline armed
+    # so the request lifecycle (submit -> admit -> prefill -> decode
+    # ticks -> finish/cancel) and the per-request goodput attribution
+    # are asserted end to end, not just unit-tested.  Spills to
+    # APEX_TPU_TIMELINE_DIR when set (scripts/obs_smoke.sh), else ring
+    # only.
+    recorder = timeline.arm_from_env()
+    if recorder is None:
+        recorder = timeline.arm(timeline.FlightRecorder())
+
     # ---- phase A: staggered churn vs full-forward reference ----------
+    # Heartbeat armed on the decode loop (ISSUE 10 satellite): the
+    # engine beats it each tick and an explicit check_now() below
+    # exercises the detection path on a healthy run (it must stay
+    # silent).  The wedged-decode -> guard -> drain leg is proven
+    # deterministically in tests/test_serving.py
+    # (test_heartbeat_hung_decode_triggers_drain).
+    heartbeat = HeartbeatMonitor(timeout_s=120.0, registry=registry)
     eng = ServingEngine(
         cfg, ServingConfig(max_batch=3, block_size=4, max_seq=MAX_SEQ,
                            prefill_len=MAX_SEQ),
-        params, mesh=mesh, registry=registry)
+        params, mesh=mesh, registry=registry, heartbeat=heartbeat)
     rng = np.random.RandomState(7)
     wave = [(rng.randint(1, VOCAB - 1, size=rng.randint(2, 14)).tolist(),
              int(rng.randint(2, 6))) for _ in range(5)]
@@ -148,9 +168,40 @@ def main() -> int:
     eng.scheduler.allocator.check()
     total = int(registry.counter("serving/tokens_generated").value)
     tpot = registry.histogram("serving/tpot_ms")
+    # one explicit detection poll: beats just landed, so a healthy run
+    # must not flag (check_now is the deterministic poll the monitor's
+    # background thread would run)
+    if heartbeat.check_now() or heartbeat.hang_count != 0 or \
+            registry.gauge("heartbeat/last_step").value is None:
+        log(f"FAIL: heartbeat not beating cleanly (last_step="
+            f"{registry.gauge('heartbeat/last_step').value}, "
+            f"hangs={heartbeat.hang_count})")
+        return 1
+    # Timeline + per-request goodput (ISSUE 10): every phase-A request
+    # must have a complete submit -> admit -> finish lifecycle on the
+    # timeline, and the attribution must close the books.
+    sgp = serving_goodput_report(recorder.events())
+    for req in reqs:
+        row = sgp["requests"].get(req.rid)
+        if row is None or row["state"] != "finished":
+            log(f"FAIL: request {req.rid} lifecycle incomplete on the "
+                f"timeline: {row}")
+            return 1
+        if abs(row["queue_wait_s"] + row["active_s"]
+               - (req.t_last_token - req.t_submit)) > 0.05:
+            log(f"FAIL: request {req.rid} goodput split "
+                f"{row} != engine-stamped wall "
+                f"{req.t_last_token - req.t_submit:.3f}s")
+            return 1
+    if not (sgp["goodput_fraction"] and 0.0 < sgp["goodput_fraction"] <= 1.0):
+        log(f"FAIL: serving goodput_fraction {sgp['goodput_fraction']}")
+        return 1
     log(f"phase A OK: {len(wave)} requests token-identical to the "
         f"full-forward reference, {total} tokens, 1 decode compile, "
-        f"tpot p50={tpot.percentile(50):.1f}ms p99={tpot.percentile(99):.1f}ms")
+        f"tpot p50={tpot.percentile(50):.1f}ms p99={tpot.percentile(99):.1f}ms, "
+        f"serving goodput {sgp['goodput_fraction']:.3f} "
+        f"(active {sgp['totals']['active_s']:.3f}s / queue "
+        f"{sgp['totals']['queue_wait_s']:.3f}s)")
 
     # ---- phase B: SIGTERM drain --------------------------------------
     # Same engine (same compiled programs — phase B costs zero extra
@@ -192,8 +243,21 @@ def main() -> int:
             return 1
     finally:
         guard.uninstall()
+    # drain attribution: the cancelled requests must appear on the
+    # timeline as drained (wholly wasted) request-seconds
+    sgp = serving_goodput_report(recorder.events())
+    for req in queued:
+        row = sgp["requests"].get(req.rid)
+        if row is None or row["state"] != "cancelled":
+            log(f"FAIL: cancelled request {req.rid} not on the timeline "
+                f"as cancelled: {row}")
+            return 1
+    if sgp["totals"]["cancelled"] < len(queued):
+        log(f"FAIL: drain totals {sgp['totals']} missing cancellations")
+        return 1
+    timeline.disarm()
     log("phase B OK: SIGTERM drained — in-flight delivered, queue "
-        "cancelled")
+        "cancelled, drain attributed on the timeline")
     print("PASS", file=sys.stderr, flush=True)
     return 0
 

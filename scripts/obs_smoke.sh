@@ -1,0 +1,102 @@
+#!/usr/bin/env bash
+# Observability smoke (ISSUE 10 satellite): run the driver dryrun with
+# the FLIGHT RECORDER armed and prove the whole run-timeline layer end
+# to end:
+#   - the spilled timeline parses under read_jsonl(strict=True) (the
+#     crash-safe torn-tail contract),
+#   - the goodput report's buckets are exhaustive and disjoint — they
+#     sum to the recorder's wall-clock, the recorder's wall-clock
+#     matches the driver's independent stopwatch within 2%, and the
+#     offline recompute over the spilled file agrees with the armed
+#     recorder's incremental accounting,
+#   - the /metrics endpoint scrapes (Prometheus text) and /statusz
+#     serves the timeline tail + goodput-so-far.
+# Companion to telemetry_smoke.sh (ISSUE 5, the metrics pipeline) —
+# wired fast-tier in tests/test_aux_subsystems.py.
+#
+# Usage: scripts/obs_smoke.sh [N_DEVICES] [OUT_DIR]
+#   N_DEVICES  virtual CPU mesh size for dryrun_multichip (default 8;
+#              the fast-tier test uses 2 to keep the XLA compile small)
+#   OUT_DIR    where timeline.jsonl/goodput.json land (default: mktemp)
+set -euo pipefail
+
+REPO="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+N_DEVICES="${1:-8}"
+OUT_DIR="${2:-$(mktemp -d /tmp/apex_tpu_obs.XXXXXX)}"
+mkdir -p "$OUT_DIR"
+
+echo "obs_smoke: dryrun_multichip(${N_DEVICES}) with flight recorder -> ${OUT_DIR}" >&2
+
+cd "$REPO"
+APEX_TPU_TIMELINE_DIR="$OUT_DIR" python -c \
+  "import __graft_entry__ as g; g.dryrun_multichip(${N_DEVICES})" \
+  2> >(tee "$OUT_DIR/dryrun.stderr" >&2)
+
+python - "$OUT_DIR" <<'EOF'
+import json
+import re
+import sys
+import urllib.request
+
+out_dir = sys.argv[1]
+
+from apex_tpu.observability import (
+    DebugServer, FlightRecorder, MetricRegistry, read_jsonl)
+from apex_tpu.observability.goodput import goodput_report
+
+# -- timeline parses under the strict crash-safety semantics ----------
+events = read_jsonl(f"{out_dir}/timeline.jsonl", strict=True)
+assert events, "no timeline events spilled"
+kinds = [e["kind"] for e in events]
+assert kinds[0] == "run_begin" and "run_end" in kinds, kinds
+assert "compile" in kinds and "step" in kinds, kinds
+
+# -- goodput: exhaustive + disjoint, and online == offline ------------
+with open(f"{out_dir}/goodput.json") as f:
+    flushed = json.load(f)
+wall = flushed["wall_s"]
+ssum = sum(flushed["buckets"].values())
+assert abs(ssum - wall) <= 0.02 * wall, (
+    f"buckets sum {ssum} != wall {wall}")
+assert flushed["overcommit_s"] <= 0.02 * wall, flushed
+offline = goodput_report(events)
+assert abs(offline["wall_s"] - wall) <= 0.02 * wall, (offline, flushed)
+for name, sec in flushed["buckets"].items():
+    assert abs(offline["buckets"][name] - sec) <= max(0.02 * wall, 1e-3), (
+        name, offline["buckets"][name], sec)
+assert flushed["buckets"]["compile"] > 0, flushed
+assert flushed["buckets"]["compute"] > 0, flushed
+
+# -- the recorder's clock vs the driver's independent stopwatch -------
+stderr = open(f"{out_dir}/dryrun.stderr").read()
+m = re.search(r"driver_wall_s=([0-9.]+) recorder_wall_s=([0-9.]+)", stderr)
+assert m, f"no goodput stopwatch line in dryrun stderr:\n{stderr[-500:]}"
+driver_wall, rec_wall = float(m.group(1)), float(m.group(2))
+assert abs(driver_wall - rec_wall) <= 0.02 * driver_wall, (
+    driver_wall, rec_wall)
+
+# -- /metrics scrapes + /statusz serves the tail ----------------------
+registry = MetricRegistry()
+registry.counter("smoke/events").inc(len(events))
+registry.histogram("smoke/lat_ms", keep_samples=8).observe(1.5)
+rec = FlightRecorder()
+for ev in events[1:]:  # replay into a live recorder (skip its run_begin)
+    ev = dict(ev)
+    ev.pop("t", None)
+    rec.emit(ev.pop("kind"), dur_s=ev.pop("dur_s", None), **ev)
+with DebugServer(registry=registry, recorder=rec) as srv:
+    metrics = urllib.request.urlopen(srv.url("/metrics"), timeout=10).read()
+    text = metrics.decode()
+    assert "apex_smoke_events" in text and "# TYPE" in text, text[:400]
+    assert "apex_smoke_lat_ms_count" in text, text[:400]
+    statusz = json.loads(urllib.request.urlopen(
+        srv.url("/statusz"), timeout=10).read())
+    assert statusz["timeline"], statusz
+    assert statusz["goodput"]["buckets"]["compile"] > 0, statusz
+
+print(f"obs_smoke OK: {len(events)} timeline events, wall {wall:.2f}s, "
+      f"goodput {flushed['goodput_fraction']:.3f} "
+      f"(compile {flushed['buckets']['compile']:.2f}s, "
+      f"compute {flushed['buckets']['compute']:.2f}s), "
+      "/metrics + /statusz scraped")
+EOF

@@ -149,3 +149,25 @@ def test_serving_smoke_script():
         f"stderr tail:\n{proc.stderr.decode(errors='replace')[-3000:]}")
     assert b"PASS" in proc.stderr
     assert b"phase A OK" in proc.stderr and b"phase B OK" in proc.stderr
+
+
+def test_obs_smoke_script(tmp_path):
+    """scripts/obs_smoke.sh end to end (ISSUE 10 CI satellite): the
+    driver dryrun with the FLIGHT RECORDER armed — the spilled timeline
+    parses under strict torn-tail semantics, the goodput buckets close
+    the books against an independent stopwatch (exhaustive + disjoint),
+    online accounting matches the offline recompute, and the debug
+    server's /metrics + /statusz scrape.  2-device mesh to keep the XLA
+    compile in the fast tier (the telemetry_smoke wiring pattern)."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)  # the script's dryrun pins its own
+    proc = subprocess.run(
+        ["bash", os.path.join(repo, "scripts", "obs_smoke.sh"),
+         "2", str(tmp_path / "out")],
+        cwd=repo, env=env, capture_output=True, timeout=560)
+    assert proc.returncode == 0, (
+        f"obs_smoke.sh rc={proc.returncode}\n"
+        f"stdout: {proc.stdout.decode(errors='replace')[-2000:]}\n"
+        f"stderr tail:\n{proc.stderr.decode(errors='replace')[-2000:]}")
+    assert b"obs_smoke OK" in proc.stdout

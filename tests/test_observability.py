@@ -348,6 +348,90 @@ class TestJsonlCrashSafety:
         assert plain.percentile(50) is None
         assert "p50" not in plain.summary()
 
+    def test_histogram_empty_and_single_sample_windows(self):
+        """ISSUE 10 satellite: percentile() edge cases.  Empty window —
+        every q answers None (never a fabricated 0); one sample — every
+        q is that sample (nearest-rank with n=1); summary() mirrors."""
+        from apex_tpu.observability.metrics import Histogram
+
+        h = Histogram(keep_samples=8)
+        for q in (0, 50, 99, 100):
+            assert h.percentile(q) is None
+        s = h.summary()
+        assert s == {"count": 0, "total": 0.0, "mean": None, "min": None,
+                     "max": None, "last": None, "p50": None, "p99": None}
+        h.observe(7.25)
+        for q in (0, 50, 99, 100):
+            assert h.percentile(q) == 7.25
+        s = h.summary()
+        assert s["p50"] == s["p99"] == 7.25
+        assert s["mean"] == 7.25 and s["count"] == 1
+
+    def test_histogram_ring_wraparound_exact(self):
+        """keep_samples ring wrap must retain EXACTLY the newest N
+        observations — off-by-one here silently shifts every
+        percentile."""
+        from apex_tpu.observability.metrics import Histogram
+
+        h = Histogram(keep_samples=4)
+        for v in (1.0, 2.0, 3.0, 4.0):
+            h.observe(v)
+        assert h.percentile(0) == 1.0 and h.percentile(100) == 4.0
+        h.observe(5.0)  # evicts exactly 1.0
+        assert h.percentile(0) == 2.0 and h.percentile(100) == 5.0
+        assert sorted(h._samples) == [2.0, 3.0, 4.0, 5.0]
+        for v in (6.0, 7.0, 8.0, 9.0):  # full wrap
+            h.observe(v)
+        assert sorted(h._samples) == [6.0, 7.0, 8.0, 9.0]
+        assert h.percentile(50) == 7.0  # nearest-rank over the window
+
+    def test_histogram_summary_mean_vs_percentile_semantics(self):
+        """summary() keys answer over two documented domains: count/
+        total/mean/min/max are LIFETIME moments, p50/p99 cover the
+        bounded sample window — after a wrap they may legitimately
+        disagree, and before one they must agree."""
+        from apex_tpu.observability.metrics import Histogram
+
+        h = Histogram(keep_samples=4)
+        for v in (1.0, 2.0, 3.0, 4.0):
+            h.observe(v)
+        s = h.summary()
+        assert s["mean"] == pytest.approx(2.5)
+        assert s["p50"] == 2.0  # nearest-rank(50%, n=4) = 2nd
+        for v in (100.0, 100.0, 100.0, 100.0):
+            h.observe(v)
+        s = h.summary()
+        # lifetime mean remembers the evicted small values...
+        assert s["mean"] == pytest.approx((1 + 2 + 3 + 4 + 400) / 8)
+        assert s["count"] == 8 and s["min"] == 1.0 and s["max"] == 100.0
+        # ...while the windowed percentiles describe only the window
+        assert s["p50"] == 100.0 and s["p99"] == 100.0
+
+    def test_registry_per_rank_flush_opt_in(self, tmp_path):
+        """ISSUE 10 satellite: host-local metrics (data/stall_ms,
+        span_ms/*) are per-host facts — all_ranks=True lets every rank
+        write its own rank-stamped record instead of rank 0's values
+        silently standing in for the fleet."""
+        from apex_tpu.observability.metrics import is_host_local
+
+        w1 = JsonlWriter(str(tmp_path / "m.rank1.jsonl"))
+        r1 = MetricRegistry(rank=1, world=2)
+        r1.gauge("data/stall_ms").set(42.0)
+        rec = r1.flush(w1, step=3, all_ranks=True)
+        assert rec is not None and rec["rank"] == 1
+        back = read_jsonl(str(tmp_path / "m.rank1.jsonl"))
+        assert back[0]["rank"] == 1
+        assert back[0]["metrics"]["data/stall_ms"] == 42.0
+        # default stays rank-gated
+        assert r1.flush(w1, step=4) is None
+        # the catalog split the docs table is generated from
+        assert is_host_local("data/stall_ms")
+        assert is_host_local("span_ms/checkpoint/save")
+        assert is_host_local("serving/ttft_ms")
+        assert is_host_local("heartbeat/hangs")
+        assert not is_host_local("train/loss")
+        assert not is_host_local("train/grad_norm")
+
 
 class TestHeartbeat:
     def test_flags_hung_checkpoint_write_to_preemption_guard(
@@ -493,6 +577,38 @@ class TestMfu:
         if flops is not None:  # backend-dependent; math must hold when set
             assert flops > 0
             assert mfu(flops, 1.0, peak_flops=1e12) > 0
+
+    def test_mfu_none_carries_a_reason(self):
+        """ISSUE 10 satellite: the two silently-conflated None cases
+        (unknown device peak vs missing cost analysis) now name
+        themselves, and exactly one of (value, reason) is None."""
+        from apex_tpu.observability.metrics import (
+            mfu_or_reason, peak_flops_reason)
+
+        value, reason = mfu_or_reason(None, 0.01, peak_flops=1e12)
+        assert value is None and "cost-analysis" in reason
+        value, reason = mfu_or_reason(1e9, 0.01,
+                                      device=jax.devices()[0])
+        assert value is None and "'cpu'" in reason
+        value, reason = mfu_or_reason(1e9, 0.01)
+        assert value is None and "no device" in reason
+        value, reason = mfu_or_reason(1e9, 0.0, peak_flops=1e12)
+        assert value is None and "step time" in reason
+        value, reason = mfu_or_reason(1e9, 0.01, peak_flops=1e12)
+        assert reason is None and value == pytest.approx(0.1)
+        # mfu() stays the value-only projection
+        assert mfu(1e9, 0.01, peak_flops=1e12) == pytest.approx(0.1)
+        peak, reason = peak_flops_reason(jax.devices()[0])
+        assert peak is None and "platform 'cpu'" in reason
+        peak, reason = peak_flops_reason(None)
+        assert peak is None and "no device" in reason
+
+        class _TpuDevice:
+            platform = "tpu"
+            device_kind = "TPU v4"
+
+        peak, reason = peak_flops_reason(_TpuDevice())
+        assert peak == 275e12 and reason is None
 
 
 # ---------------------------------------------------------------------------

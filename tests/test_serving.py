@@ -8,6 +8,8 @@ real-SIGTERM drain lives in scripts/serving_smoke.sh).  Slow tier: the
 tp=2 parity leg and the train-mesh -> serve-mesh restore.
 """
 
+import time
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -479,3 +481,161 @@ def eng_params_of(eng):
     params = eng.params
     return params._replace(layers=jax.tree_util.tree_map(
         lambda l: l.reshape((l.shape[0], 1) + l.shape[1:]), params.layers))
+
+
+# ------------------------------------------------- ISSUE 10: observability
+
+
+def test_heartbeat_hung_decode_triggers_drain():
+    """ISSUE 10 satellite: the heartbeat armed on the decode loop.  A
+    device step that wedges (parked behind an event, the
+    faults.hung_writes shape applied to the decode dispatch) stops the
+    beats; the monitor's on_hang fires the PreemptionGuard, and the
+    engine's next alive step() DRAINS — in-flight requests deliver,
+    the queue cancels — instead of the scheduler wedging forever."""
+    import threading
+
+    from apex_tpu.observability.metrics import HeartbeatMonitor
+    from apex_tpu.resilience import PreemptionGuard
+    from apex_tpu.serving.scheduler import RequestState
+
+    guard = PreemptionGuard(signals=())
+    hb = HeartbeatMonitor(timeout_s=0.05, on_hang=guard)
+    _, _, eng = _build_engine(
+        tp=1, serving=ServingConfig(max_batch=2, block_size=4,
+                                    max_seq=MAX_SEQ, prefill_len=MAX_SEQ))
+    eng.guard = guard
+    eng.heartbeat = hb
+
+    running = [eng.submit([3, 5, 7], 6), eng.submit([11, 13], 6)]
+    eng.step()                        # healthy tick: beat recorded
+    queued = [eng.submit([17, 19], 4)]
+    assert hb.last_step == 1 and not hb.check_now()
+
+    # park the NEXT decode mid-flight on another thread (the hung
+    # device step); the main thread plays the monitor's poll loop
+    gate = threading.Event()
+    real_decode = eng._decode
+
+    def parked_decode(*args):
+        gate.wait()
+        return real_decode(*args)
+
+    eng._decode = parked_decode
+    t = threading.Thread(target=eng.step, daemon=True)
+    t.start()
+    deadline = time.monotonic() + 10.0
+    while not hb.check_now():         # deterministic poll, no bg thread
+        assert time.monotonic() < deadline, "hang never detected"
+        time.sleep(0.01)
+    assert guard.triggered, "on_hang must fire the guard"
+    # the wedge clears (preempted hosts come back long enough to drain)
+    gate.set()
+    t.join(timeout=30)
+    assert not t.is_alive()
+    eng._decode = real_decode
+    eng.run_until_drained(max_steps=100)
+    assert eng.draining
+    for req in running:
+        assert req.state is RequestState.FINISHED
+        assert len(req.output_tokens) == req.max_new_tokens
+    assert queued[0].state is RequestState.CANCELLED
+    assert hb.hang_count == 1
+    assert int(eng.registry.counter(
+        "serving/preemption_drains").value) == 1
+
+
+def test_engine_timeline_lifecycle_and_goodput():
+    """With a flight recorder armed, every request leaves a complete
+    submit -> admit -> prefill -> decode ticks -> finish trail keyed by
+    rid, and serving_goodput_report closes the books over it."""
+    from apex_tpu.observability import timeline
+    from apex_tpu.observability.goodput import serving_goodput_report
+    from apex_tpu.observability.timeline import FlightRecorder
+
+    rec = timeline.arm(FlightRecorder())
+    try:
+        _, _, eng = _build_engine(
+            tp=1, serving=ServingConfig(max_batch=2, block_size=4,
+                                        max_seq=MAX_SEQ,
+                                        prefill_len=MAX_SEQ))
+        eng.timeline_tick_every = 2
+        reqs = [eng.submit([3, 5, 7], 5), eng.submit([11, 13], 3)]
+        eng.run_until_drained()
+        events = rec.events()
+        for req in reqs:
+            mine = [e for e in events if e.get("rid") == req.rid]
+            kinds = [e["kind"] for e in mine]
+            assert kinds[0] == "request_submit"
+            assert "request_admit" in kinds
+            assert kinds[-1] == "request_finish"
+            assert any(k == "decode_tick" for k in kinds)
+            ticks = [e["tokens"] for e in mine
+                     if e["kind"] == "decode_tick"]
+            assert all(n % 2 == 0 for n in ticks)  # sampled every 2
+        prefills = [e for e in events if e["kind"] == "prefill"]
+        assert prefills and "dur_s" in prefills[0]
+        assert sorted(r for e in prefills for r in e["rids"]) == \
+            sorted(r.rid for r in reqs)
+        rep = serving_goodput_report(events)
+        assert rep["totals"]["finished"] == 2
+        assert rep["totals"]["cancelled"] == 0
+        assert rep["goodput_fraction"] is not None
+        assert 0.0 < rep["goodput_fraction"] <= 1.0
+    finally:
+        timeline.disarm()
+
+
+def test_engine_introspect_and_mfu_reason():
+    """introspect() (the /statusz payload) reports live slots/blocks/
+    queue plus MFU-or-reason; on the CPU test mesh the reason must name
+    the unknown platform peak, never fabricate a number (and the
+    serving/mfu gauge stays unset)."""
+    _, _, eng = _build_engine(
+        tp=1, serving=ServingConfig(max_batch=2, block_size=4,
+                                    max_seq=MAX_SEQ, prefill_len=MAX_SEQ))
+    snap = eng.introspect()
+    assert snap["steps"] == 0 and snap["mfu_reason"] is not None
+    eng.submit([3, 5, 7], 3)
+    eng.step()
+    snap = eng.introspect()
+    assert snap["active_slots"] == 1
+    assert snap["queue_depth"] == 0
+    assert snap["decode_compiles"] == 1
+    assert snap["free_blocks"] < snap["total_blocks"]
+    assert snap["last_decode_ms"] is not None
+    # CPU: flops may exist (XLA:CPU reports them) but the peak is
+    # undefined -> mfu None with the platform named
+    assert snap["mfu"] is None
+    assert "cpu" in snap["mfu_reason"]
+    assert eng.registry.gauge("serving/mfu").value is None
+    eng.run_until_drained()
+    assert eng.introspect()["active_slots"] == 0
+    assert eng.decode_compile_count() == 1, \
+        "the MFU lowering probe must not add a decode compile"
+
+
+def test_engine_statusz_through_debug_server():
+    """The debug server serves the live engine: /statusz carries the
+    introspection dict while requests are in flight."""
+    import json as _json
+    import urllib.request
+
+    from apex_tpu.observability import DebugServer
+    from apex_tpu.observability.metrics import MetricRegistry
+
+    _, _, eng = _build_engine(
+        tp=1, serving=ServingConfig(max_batch=2, block_size=4,
+                                    max_seq=MAX_SEQ, prefill_len=MAX_SEQ))
+    eng.submit([3, 5, 7], 4)
+    eng.step()
+    with DebugServer(registry=eng.registry, engine=eng) as srv:
+        body = _json.loads(urllib.request.urlopen(
+            srv.url("/statusz"), timeout=10).read())
+        metrics = urllib.request.urlopen(
+            srv.url("/metrics"), timeout=10).read().decode()
+    assert body["serving"]["active_slots"] == 1
+    assert body["serving"]["draining"] is False
+    assert "apex_serving_tokens_generated" in metrics
+    assert "apex_serving_active_slots" in metrics
+    eng.run_until_drained()
