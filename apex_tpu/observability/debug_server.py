@@ -1,4 +1,4 @@
-"""Live introspection endpoint — ``/metrics`` + ``/statusz``.
+"""Live introspection endpoint — ``/metrics`` + ``/statusz`` + ``/healthz``.
 
 Opt-in, stdlib-only (``http.server`` on a daemon thread): a long
 training or serving process answers two questions over plain HTTP
@@ -16,6 +16,14 @@ without any agent, sidecar, or dependency the container doesn't have:
   recorder's timeline tail and goodput-so-far, plus the serving
   engine's live state (active slots, free blocks, queue depth,
   draining, MFU or the reason it is undefined) when one is attached.
+- ``GET /healthz`` — the ONE health contract the fleet router and any
+  external probe share (ISSUE 11): liveness is answering at all;
+  readiness is the body's ``status`` — ``ok`` (HTTP 200) vs
+  ``draining``/``down`` (HTTP 503, so a stock HTTP prober needs no
+  JSON parsing).  ``draining`` comes from the attached engine's
+  ``introspect()``; ``down`` means the engine is attached but its
+  introspection raises — the process answers, the runtime inside it is
+  broken.
 
 Security model: binds ``127.0.0.1`` by default and serves read-only
 snapshots — exposing it beyond the host is the operator's deliberate
@@ -140,6 +148,24 @@ class DebugServer:
                 out["serving"] = {"error": repr(e)}
         return out
 
+    def healthz(self) -> tuple:
+        """``(http_code, payload)`` for ``/healthz``: 200 ``ok`` / 503
+        ``draining`` / 503 ``down`` — the readiness half of the health
+        contract (liveness is the connection succeeding at all; a dead
+        process refuses it)."""
+        engine = self.engine
+        if engine is None:
+            # nothing attached: the server answering IS the health fact
+            return 200, {"status": "ok", "engine": False}
+        try:
+            draining = bool(engine.introspect().get("draining"))
+        except Exception as e:  # the runtime behind the probe is broken
+            return 503, {"status": "down", "engine": True,
+                         "error": repr(e)}
+        if draining:
+            return 503, {"status": "draining", "engine": True}
+        return 200, {"status": "ok", "engine": True}
+
     # ------------------------------------------------------------ lifecycle
 
     def start(self) -> "DebugServer":
@@ -167,9 +193,14 @@ class DebugServer:
                                    json.dumps(server.statusz(),
                                               default=str).encode(),
                                    "application/json")
+                    elif self.path.split("?")[0] == "/healthz":
+                        code, payload = server.healthz()
+                        self._send(code, json.dumps(payload).encode(),
+                                   "application/json")
                     elif self.path.split("?")[0] == "/":
                         self._send(200, b"apex_tpu debug server: "
-                                   b"/metrics /statusz\n", "text/plain")
+                                   b"/metrics /statusz /healthz\n",
+                                   "text/plain")
                     else:
                         self._send(404, b"not found\n", "text/plain")
                 except Exception as e:  # a broken scrape never kills us
@@ -192,7 +223,7 @@ class DebugServer:
             daemon=True)
         self._thread.start()
         logger.info("debug server listening on http://%s:%d "
-                    "(/metrics, /statusz)", self.host, self.port)
+                    "(/metrics, /statusz, /healthz)", self.host, self.port)
         return self
 
     def url(self, path: str = "/") -> str:

@@ -157,7 +157,9 @@ def serving_goodput_report(events: Iterable[dict]) -> dict:
 
     For every request id seen: ``queue_wait_s`` (submit → admit),
     ``active_s`` (admit → finish — prefill plus decode, the useful
-    work), or ``drained_s`` (submit → cancel, wholly wasted).  Requests
+    work), or ``drained_s`` (submit → cancel/reject, wholly wasted;
+    rejected requests are also counted in ``totals["rejected"]``).
+    Requests
     still in flight at the end of the log are counted ``open`` and
     excluded from the fraction (their split is not yet known).  A
     terminal request whose ``request_submit`` fell off a wrapped ring
@@ -190,10 +192,13 @@ def serving_goodput_report(events: Iterable[dict]) -> dict:
         elif kind == "request_cancel":
             r = rec(rid)
             r["end"], r["state"] = t, "cancelled"
+        elif kind == "request_reject":
+            r = rec(rid)
+            r["end"], r["state"] = t, "rejected"
 
     per_request = {}
     tot_queue = tot_active = tot_drained = 0.0
-    n_finished = n_cancelled = n_open = 0
+    n_finished = n_cancelled = n_rejected = n_open = 0
     for rid, r in reqs.items():
         sub = r["submit"]
         row = {"state": r["state"], "tokens": r["tokens"]}
@@ -213,6 +218,14 @@ def serving_goodput_report(events: Iterable[dict]) -> dict:
             if sub is not None:
                 row["drained_s"] = round(r["end"] - sub, 6)
                 tot_drained += row["drained_s"]
+        elif r["state"] == "rejected":
+            # refused at submit (drain window / overload shed): a typed
+            # terminal state that holds ~zero request-seconds — counted,
+            # and its sliver of wall lands in the wasted bucket
+            n_rejected += 1
+            if sub is not None:
+                row["drained_s"] = round(r["end"] - sub, 6)
+                tot_drained += row["drained_s"]
         else:
             n_open += 1
         per_request[rid] = row
@@ -222,7 +235,7 @@ def serving_goodput_report(events: Iterable[dict]) -> dict:
         "requests": per_request,
         "totals": {
             "finished": n_finished, "cancelled": n_cancelled,
-            "open": n_open,
+            "rejected": n_rejected, "open": n_open,
             "queue_wait_s": round(tot_queue, 6),
             "active_s": round(tot_active, 6),
             "drained_s": round(tot_drained, 6),

@@ -170,6 +170,7 @@ class Histogram:
 # visibility matters — docs/observability.md has the split table.
 HOST_LOCAL_PREFIXES = (
     "data/", "span_ms/", "heartbeat/", "serving/", "ckpt/", "loader/",
+    "fleet/",
 )
 
 

@@ -52,6 +52,8 @@ kind                   payload (beyond ``t`` / ``dur_s``)
 ``decode_tick``        ``rid``, ``tokens`` — every N generated tokens
 ``request_finish``     ``rid``, ``tokens``
 ``request_cancel``     ``rid``
+``request_reject``     ``rid`` — refused at submit (drain window /
+                       overload shed), never queued
 =====================  ====================================================
 
 Arming is process-global and **opt-in**: the module-level

@@ -26,19 +26,26 @@ or file write is ever interrupted mid-flight by the handler itself.
 
 from __future__ import annotations
 
+import logging
 import signal
 import threading
 from typing import Iterable
 
 __all__ = ["PreemptionGuard"]
 
+logger = logging.getLogger(__name__)
+
 
 class PreemptionGuard:
     """Flag-setting signal handler for graceful preemption.
 
     ``signals`` defaults to SIGTERM (what preemption sends); add SIGINT
-    to make Ctrl-C drain instead of tearing down mid-save.  Install from
-    the **main thread** (a CPython signal-API requirement).  Use as a
+    to make Ctrl-C drain instead of tearing down mid-save.  CPython only
+    allows handler installation from the **main thread**; constructed
+    anywhere else (a fleet router's health-check thread, a replica
+    child's worker thread) the guard degrades gracefully to the
+    programmatic :meth:`trigger` path instead of raising —
+    ``signals_installed`` says which mode this instance got.  Use as a
     context manager or call :meth:`uninstall` to restore the previous
     handlers.
     """
@@ -46,8 +53,31 @@ class PreemptionGuard:
     def __init__(self, signals: Iterable[int] = (signal.SIGTERM,)):
         self._event = threading.Event()
         self._previous = {}
+        self._signals_installed = True
         for sig in signals:
-            self._previous[sig] = signal.signal(sig, self._handle)
+            try:
+                self._previous[sig] = signal.signal(sig, self._handle)
+            except ValueError:
+                # signal.signal raises ValueError BOTH off the main
+                # thread and for an uncatchable/invalid signal number —
+                # only the former gets the graceful fallback; a bad
+                # signal on the main thread is a caller bug and must
+                # keep raising, not produce a guard that silently never
+                # fires.
+                if threading.current_thread() is threading.main_thread():
+                    raise
+                self._signals_installed = False
+                logger.warning(
+                    "PreemptionGuard built off the main thread: signal "
+                    "handlers not installed; only trigger() will trip "
+                    "this guard")
+                break
+
+    @property
+    def signals_installed(self) -> bool:
+        """True when the OS signal handlers are live; False for a guard
+        built off the main thread (programmatic :meth:`trigger` only)."""
+        return self._signals_installed
 
     def _handle(self, signum, frame):
         self._event.set()

@@ -29,6 +29,14 @@ package turns those checkpoints into a *serving* runtime —
   draining on preemption via ``resilience.PreemptionGuard``.
 - :mod:`.loader` — restore-from-training-checkpoint through the PR 6
   ``ShardingSpec`` reshard layer (train on mesh N, serve on mesh M).
+- :mod:`.replica` / :mod:`.fleet` — the fleet layer (ISSUE 11): N
+  engine replicas as separate spawned processes (own mesh, own arenas,
+  data-service process lifecycle) behind a host-side
+  :class:`~apex_tpu.serving.fleet.FleetRouter` with SLO-aware admission
+  (priority classes, weighted tenant fairness, typed shed-on-overload),
+  failover replay (SIGKILLed replica's in-flight requests re-prefix on
+  survivors, greedy-token-identical), and zero-downtime weight rollout
+  through the SIGTERM drain + newest-VERIFIED restore.
 
 See ``docs/serving.md`` for the architecture and cookbook.
 """
@@ -46,11 +54,17 @@ from apex_tpu.serving.paged_attention import (
 from apex_tpu.serving.scheduler import Request, RequestState, Scheduler
 from apex_tpu.serving.engine import ServingConfig, ServingEngine
 from apex_tpu.serving.loader import restore_gpt_for_serving
+from apex_tpu.serving.replica import ReplicaProcess, ReplicaSpec
+from apex_tpu.serving.fleet import FleetRequest, FleetRouter
 
 __all__ = [
     "BlockAllocator",
+    "FleetRequest",
+    "FleetRouter",
     "KVCacheConfig",
     "OutOfBlocksError",
+    "ReplicaProcess",
+    "ReplicaSpec",
     "Request",
     "RequestState",
     "Scheduler",

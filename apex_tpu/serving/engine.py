@@ -23,7 +23,9 @@ docs/serving.md):
 - ``serving/tpot_ms``      histogram (sampled: p50/p99) — inter-token
   interval on the decode path, per token
 - ``serving/tokens_generated`` / ``serving/requests_finished`` /
-  ``serving/requests_cancelled`` counters
+  ``serving/requests_cancelled`` / ``serving/requests_rejected``
+  counters (rejected = refused at submit while draining — a typed
+  terminal state, distinct from accepted-then-drained cancellation)
 - ``serving/active_slots`` / ``serving/free_blocks`` gauges
 - ``serving/preemption_drains`` counter
 - ``serving/mfu``          gauge — decode-step MFU when the device peak
@@ -229,12 +231,13 @@ class ServingEngine:
         timeline.emit("request_submit", rid=req.rid,
                       prompt_tokens=len(req.prompt),
                       max_new_tokens=max_new_tokens)
-        if req.state is RequestState.CANCELLED:
-            # submitted into the drain window: count it like every other
-            # cancellation or the catalog undercounts exactly when the
-            # operator is watching a preemption
-            self.registry.counter("serving/requests_cancelled").inc()
-            timeline.emit("request_cancel", rid=req.rid)
+        if req.state is RequestState.REJECTED:
+            # submitted into the drain window: refused with a typed
+            # terminal state (never queued, never a hang) and counted
+            # apart from drain cancellations — a router re-routes a
+            # REJECTED request, it does not mourn it
+            self.registry.counter("serving/requests_rejected").inc()
+            timeline.emit("request_reject", rid=req.rid)
         return req
 
     # --------------------------------------------------------------- drain

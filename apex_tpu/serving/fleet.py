@@ -1,0 +1,713 @@
+"""Fleet router: N engine replicas behind one SLO-aware front door.
+
+"Millions of users" is not one engine (ROADMAP): a single
+:class:`~apex_tpu.serving.engine.ServingEngine` is a single point of
+failure with no overload policy beyond its FIFO, and it cannot take a
+new checkpoint without going dark.  This module is the host-side router
+over a fleet of :mod:`~apex_tpu.serving.replica` processes — the
+serving half of the TorchTitan "production one-stop" bar (PAPERS.md
+2410.06511), composed entirely from machinery this repo already
+proved: PR 6's restore-anywhere, PR 8's SIGTERM drain, PR 9's
+``introspect()``/debug-server state.
+
+Three promises, all fault-injected (``scripts/fleet_smoke.sh``), never
+asserted:
+
+**Failover replay.**  A replica SIGKILLed mid-decode is detected by
+dead pipe / missed heartbeat (with retry+backoff before the verdict),
+marked down, and its in-flight requests are *replayed*: each one is
+re-submitted to a surviving replica with ``prompt + tokens emitted so
+far`` as the new prompt (through the ordinary packed-prefill path) and
+the remaining token budget.  Greedy decode is a deterministic function
+of the prefix, so the stitched stream is **bitwise identical** to an
+uninterrupted reference — pinned at kill-at-token-k ∈ {0, 1, mid,
+last} in ``tests/test_fleet.py`` and end-to-end in the smoke.
+
+**Shed on overload.**  Once fleet-wide queue depth (router backlog +
+every live replica's reported queue) crosses ``max_queue_depth``,
+``submit`` returns a request in the typed ``REJECTED`` terminal state
+and increments ``serving/requests_rejected`` — an observable refusal,
+never a silent hang.  Below the bound, admission is SLO-aware: strict
+priority classes, and weighted per-tenant fairness (stride scheduling)
+within a class.
+
+**Zero-downtime rollout.**  :meth:`FleetRouter.rollout` walks the
+fleet one replica at a time: SIGTERM (the existing ``PreemptionGuard``
+drain — in-flight requests deliver, queued ones come back to the
+router and are rescheduled), clean exit, replacement spawned restoring
+the newest VERIFIED checkpoint (corrupt-newest falls back — PR 6/8
+machinery), rejoin on handshake — under continuous load, with every
+request reaching a terminal state and p99 TPOT bounded (the smoke's
+staggered-roll matrix).
+
+The router is deliberately **jax-free and transport-agnostic**: it
+drives anything with the replica client surface (``alive``/``poll``/
+``submit``/``begin_drain``/``close``), which is how
+``tests/test_fleet.py`` exercises every policy branch hermetically with
+in-memory fakes.  ``FleetRouter.introspect()`` duck-types the debug
+server's engine slot, so ``DebugServer(engine=router)`` serves live
+fleet state at ``/statusz`` unchanged.
+
+Metric catalog additions (host-local, ``docs/observability.md``):
+``fleet/requests_submitted`` / ``fleet/requests_finished`` /
+``serving/requests_rejected`` counters, ``fleet/replays`` /
+``fleet/failovers`` / ``fleet/reschedules`` / ``fleet/rollouts``
+counters, ``fleet/replicas_live`` / ``fleet/queue_depth`` gauges,
+``fleet/ttft_ms`` / ``fleet/tpot_ms`` histograms (router-observed).
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import itertools
+import logging
+import time
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from apex_tpu.serving.scheduler import RequestState
+
+__all__ = ["FleetRequest", "FleetRouter"]
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class FleetRequest:
+    """One request's fleet-level state (the router's source of truth;
+    replica-side Request objects are per-attempt and disposable)."""
+
+    rid: int
+    prompt: np.ndarray            # int32 [prompt_len] — the ORIGINAL
+    max_new_tokens: int
+    eos_id: Optional[int] = None
+    tenant: str = "default"
+    priority: int = 0             # lower = more urgent (class 0 first)
+
+    state: RequestState = RequestState.WAITING
+    output_tokens: List[int] = dataclasses.field(default_factory=list)
+    replica: Optional[str] = None   # current / last assignment
+    replays: int = 0                # failover re-submissions
+    reschedules: int = 0            # drain-cancel / reject re-routes
+    t_submit: float = 0.0
+    t_first_token: Optional[float] = None
+    t_last_token: Optional[float] = None
+
+    @property
+    def done(self) -> bool:
+        return self.state in (RequestState.FINISHED, RequestState.CANCELLED,
+                              RequestState.REJECTED)
+
+    @property
+    def remaining(self) -> int:
+        return self.max_new_tokens - len(self.output_tokens)
+
+
+class _ReplicaView:
+    """Router-side bookkeeping for one replica client."""
+
+    def __init__(self, client, now: float):
+        self.client = client
+        # a client whose handshake was already consumed out-of-band
+        # (ReplicaProcess.wait_ready before router construction) is
+        # ready on arrival; otherwise the ("ready", meta) event flips it
+        self.meta: Optional[dict] = getattr(client, "meta", None)
+        self.ready = self.meta is not None
+        self.state: Optional[dict] = None   # last introspect snapshot
+        self.last_event_t = now             # any event counts as a beat
+        self.down = False
+        self.down_reason: Optional[str] = None
+        self.draining = False
+        self.drained = False
+        self.rolling = False                # excluded from dispatch
+        self.probes = 0                     # missed-heartbeat probes so far
+        self.next_probe_t: Optional[float] = None
+        self.assigned: Dict[int, FleetRequest] = {}
+
+    @property
+    def name(self) -> str:
+        return self.client.name
+
+    def dispatchable(self) -> bool:
+        return (self.ready and not self.down and not self.draining
+                and not self.rolling and self.client.alive())
+
+    def in_flight(self) -> int:
+        """Replica-side load: everything queued or decoding there.  The
+        replica's own snapshot (queue + active slots) and the router's
+        ``assigned`` map are two views of the same population offset by
+        transport lag — take their max, never their sum (summing
+        double-counts every request between dispatch and the next state
+        heartbeat, which halves the effective limits and over-sheds)."""
+        reported = 0
+        if self.state is not None:
+            reported = (int(self.state.get("queue_depth", 0))
+                        + int(self.state.get("active_slots", 0)))
+        return max(reported, len(self.assigned))
+
+    def backlog(self) -> int:
+        """Replica-side *waiting* load only — what the shed bound sums.
+        A full-but-flowing fleet (every slot decoding, nothing queued)
+        has zero backlog and must not shed; :meth:`in_flight` is the
+        placement ceiling, this is the overload signal.  Same max-not-
+        sum rule: dispatched-but-unreported requests (no first token
+        yet) are the router's view of the same queue the replica
+        reports."""
+        reported = 0
+        if self.state is not None:
+            reported = int(self.state.get("queue_depth", 0))
+        local = sum(1 for r in self.assigned.values()
+                    if r.t_first_token is None)
+        return max(reported, local)
+
+
+class FleetRouter:
+    """Admit, place, replay, and roll requests across engine replicas.
+
+    ``replicas``: clients with the replica surface (see module
+    docstring).  ``max_queue_depth``: the fleet-wide shed bound.
+    ``replica_queue_limit``: per-replica dispatch ceiling (backlog past
+    it stays in the router, where it can still be re-routed).
+    ``heartbeat_timeout_s`` / ``probe_retries`` / ``probe_backoff_s``:
+    failure detection — a silent replica is probed ``probe_retries``
+    times, ``probe_backoff_s`` apart, before the down verdict (a dead
+    pipe / dead process short-circuits the probes).  ``clock`` is
+    injectable so the detection ladder is deterministic under test.
+
+    Drive with :meth:`pump` (one poll+detect+dispatch iteration) from
+    whatever loop owns the host thread; nothing here blocks.
+    """
+
+    def __init__(self, replicas: Sequence, *, max_queue_depth: int = 64,
+                 replica_queue_limit: int = 4,
+                 heartbeat_timeout_s: float = 10.0,
+                 probe_retries: int = 3, probe_backoff_s: float = 0.2,
+                 max_attempts: int = 8, keep_done: int = 4096,
+                 registry=None, clock: Callable[[], float] = time.monotonic):
+        from apex_tpu.observability.metrics import default_registry
+
+        self._clock = clock
+        self.max_queue_depth = max_queue_depth
+        self.replica_queue_limit = replica_queue_limit
+        self.heartbeat_timeout_s = heartbeat_timeout_s
+        self.probe_retries = probe_retries
+        self.probe_backoff_s = probe_backoff_s
+        # a request the fleet keeps bouncing (replica-level rejects,
+        # drain cancels, failover replays) is parked REJECTED after
+        # this many re-routes — a poison request (e.g. one no replica's
+        # pool shape can serve) must reach a terminal state, not
+        # ping-pong forever
+        self.max_attempts = max_attempts
+        self.keep_done = keep_done
+        self.registry = registry if registry is not None else \
+            default_registry()
+        now = clock()
+        self._views: Dict[str, _ReplicaView] = {}
+        for client in replicas:
+            if client.name in self._views:
+                # a silent overwrite would leak the first client's
+                # process (never polled, never closed) — the PR 7
+                # duplicate-dp_ranks precedent: validate, don't collapse
+                raise ValueError(
+                    f"duplicate replica name {client.name!r}")
+            self._views[client.name] = _ReplicaView(client, now)
+        self._ids = itertools.count()
+        self.requests: Dict[int, FleetRequest] = {}
+        # terminal rids in completion order; beyond keep_done the oldest
+        # are evicted from `requests` (callers keep their FleetRequest
+        # handles — eviction only bounds the router's own maps, so a
+        # weeks-long router does not grow per-request state forever)
+        self._done_ring: collections.deque = collections.deque()
+        # pending[(priority, tenant)] -> deque of WAITING requests;
+        # replays go to the LEFT (they already waited their turn)
+        self._pending: Dict[tuple, collections.deque] = {}
+        self._tenant_pass: Dict[str, float] = {}
+        self._tenant_weight: Dict[str, float] = {}
+
+    # ----------------------------------------------------------- tenants
+
+    def set_tenant_weight(self, tenant: str, weight: float) -> None:
+        """Weighted fairness within a priority class: a tenant with
+        weight w gets ~w shares per round of dispatch (stride
+        scheduling — the pass/stride virtual clock)."""
+        if weight <= 0:
+            raise ValueError(f"weight must be > 0, got {weight}")
+        self._tenant_weight[tenant] = float(weight)
+
+    def _see_tenant(self, tenant: str) -> None:
+        """Pin a tenant's virtual clock at first sight (the current
+        minimum): a late arrival starts level with the pack, neither
+        owed the whole history nor forever trailing it."""
+        if tenant not in self._tenant_pass:
+            self._tenant_pass[tenant] = min(
+                self._tenant_pass.values(), default=0.0)
+
+    def _charge(self, tenant: str) -> None:
+        self._see_tenant(tenant)
+        self._tenant_pass[tenant] += \
+            1.0 / self._tenant_weight.get(tenant, 1.0)
+
+    # ------------------------------------------------------------ submit
+
+    def total_queue_depth(self) -> int:
+        """Fleet-wide backlog: router pending + every non-down
+        replica's *waiting* queue.  Deliberately excludes requests
+        already decoding — a fully-utilized fleet with empty queues is
+        healthy, not overloaded, and must not shed."""
+        depth = sum(len(q) for q in self._pending.values())
+        for view in self._views.values():
+            if not view.down:
+                depth += view.backlog()
+        return depth
+
+    def submit(self, prompt: Sequence[int], max_new_tokens: int,
+               eos_id: Optional[int] = None, *, tenant: str = "default",
+               priority: int = 0) -> FleetRequest:
+        """Admit or shed.  Above ``max_queue_depth`` the request comes
+        back REJECTED — a typed terminal state the caller can observe
+        and retry against, never a silent hang — and
+        ``serving/requests_rejected`` counts it."""
+        req = FleetRequest(
+            rid=next(self._ids),
+            prompt=np.asarray(prompt, np.int32),
+            max_new_tokens=int(max_new_tokens), eos_id=eos_id,
+            tenant=tenant, priority=int(priority),
+            t_submit=time.monotonic())
+        self.requests[req.rid] = req
+        self.registry.counter("fleet/requests_submitted").inc()
+        if self.total_queue_depth() >= self.max_queue_depth:
+            self._reject(req)
+            return req
+        self._enqueue(req)
+        return req
+
+    def _reject(self, req: FleetRequest) -> None:
+        req.state = RequestState.REJECTED
+        self.registry.counter("serving/requests_rejected").inc()
+        self._note_done(req)
+
+    def _note_done(self, req: FleetRequest) -> None:
+        """Bound the per-request maps: remember terminal rids in order
+        and evict the oldest past ``keep_done`` (the caller's own
+        FleetRequest handle stays valid — only the router forgets)."""
+        self._done_ring.append(req.rid)
+        while len(self._done_ring) > self.keep_done:
+            self.requests.pop(self._done_ring.popleft(), None)
+
+    def _enqueue(self, req: FleetRequest, *, front: bool = False) -> None:
+        req.state = RequestState.WAITING
+        req.replica = None
+        self._see_tenant(req.tenant)
+        q = self._pending.setdefault((req.priority, req.tenant),
+                                     collections.deque())
+        if front:
+            q.appendleft(req)
+        else:
+            q.append(req)
+
+    # -------------------------------------------------------------- pump
+
+    def pump(self) -> None:
+        """One router iteration: poll events, run failure detection,
+        dispatch what fits.  Non-blocking; call it from the serving
+        host's loop (the smoke pumps at ~1 kHz)."""
+        for view in list(self._views.values()):
+            if not view.down:
+                self._poll_view(view)
+        for view in list(self._views.values()):
+            if not view.down:
+                self._detect_failure(view)
+        self._dispatch()
+        live = sum(1 for v in self._views.values()
+                   if not v.down and v.client.alive())
+        self.registry.gauge("fleet/replicas_live").set(live)
+        self.registry.gauge("fleet/queue_depth").set(
+            self.total_queue_depth())
+
+    # ------------------------------------------------------------- events
+
+    def _poll_view(self, view: _ReplicaView) -> None:
+        try:
+            events = view.client.poll()
+        except Exception as e:  # dead pipe mid-read
+            logger.warning("fleet: replica %s poll failed: %r",
+                           view.name, e)
+            self._mark_down(view, f"dead pipe: {e!r}")
+            return
+        if events:
+            view.last_event_t = self._clock()
+            view.probes = 0
+            view.next_probe_t = None
+        for ev in events:
+            self._handle_event(view, ev)
+
+    def _handle_event(self, view: _ReplicaView, ev: tuple) -> None:
+        kind = ev[0]
+        if kind == "ready":
+            view.ready = True
+            view.meta = ev[1]
+        elif kind == "state":
+            view.state = ev[1]
+            view.draining = bool(ev[1].get("draining"))
+        elif kind == "token":
+            _, frid, token = ev
+            req = self.requests.get(frid)
+            if req is None or req.done:
+                return
+            now = time.monotonic()
+            if req.t_first_token is None:
+                req.t_first_token = now
+                self.registry.histogram(
+                    "fleet/ttft_ms", keep_samples=4096).observe(
+                        (now - req.t_submit) * 1e3)
+            else:
+                self.registry.histogram(
+                    "fleet/tpot_ms", keep_samples=65536).observe(
+                        (now - req.t_last_token) * 1e3)
+            req.t_last_token = now
+            req.output_tokens.append(int(token))
+        elif kind == "finished":
+            req = self.requests.get(ev[1])
+            if req is not None and not req.done:
+                self._finish(req, view)
+        elif kind in ("cancelled", "rejected"):
+            # cancelled: drained out of the replica's queue (rollout /
+            # preemption); rejected: refused at the replica door (drain
+            # window race, or a pool-shape mismatch).  Either way a
+            # fleet-level request is NOT lost — it goes back in the
+            # pool for another replica, until the attempt cap parks it
+            req = self.requests.get(ev[1])
+            view.assigned.pop(ev[1], None)
+            if req is not None and not req.done:
+                req.reschedules += 1
+                self.registry.counter("fleet/reschedules").inc()
+                self._requeue_or_park(req, f"replica {view.name} {kind}")
+        elif kind == "drained":
+            view.drained = True
+            view.draining = True
+        elif kind == "error":
+            logger.warning("fleet: replica %s relayed error: %r",
+                           view.name, ev[1])
+            self._mark_down(view, f"relayed error: {ev[1]!r}")
+
+    def _finish(self, req: FleetRequest, view: Optional[_ReplicaView],
+                ) -> None:
+        req.state = RequestState.FINISHED
+        if view is not None:
+            view.assigned.pop(req.rid, None)
+        self.registry.counter("fleet/requests_finished").inc()
+        self._note_done(req)
+
+    def _requeue_or_park(self, req: FleetRequest, why: str) -> None:
+        """Put a bounced request back in the pool — unless it has burnt
+        ``max_attempts`` re-routes, in which case it is parked in the
+        typed REJECTED terminal state (a poison request every replica
+        refuses must converge, not livelock the dispatch loop)."""
+        if req.replays + req.reschedules >= self.max_attempts:
+            logger.warning(
+                "fleet: request %d exhausted %d attempts (%s); parking "
+                "it REJECTED", req.rid, self.max_attempts, why)
+            self._reject(req)
+            return
+        self._enqueue(req, front=True)
+
+    # ------------------------------------------------- failure detection
+
+    def _detect_failure(self, view: _ReplicaView) -> None:
+        if not view.client.alive():
+            # dead process: consume any events that flushed before
+            # death (tokens generated pre-kill are real), then verdict
+            self._poll_view(view)
+            if view.down:
+                return
+            if view.drained and not view.assigned:
+                # clean rollout exit, not a failure: retire quietly
+                self._mark_down(view, "drained and exited", clean=True)
+            else:
+                self._mark_down(view, "process died")
+            return
+        if not view.ready:
+            return  # startup (engine compile) is wait_ready's business
+        silent_for = self._clock() - view.last_event_t
+        if silent_for <= self.heartbeat_timeout_s:
+            return
+        # missed heartbeat: probe with backoff before the down verdict
+        # (a GC pause or one slow decode step must not trigger a replay
+        # storm — the retry ladder is the difference between failover
+        # and flapping)
+        now = self._clock()
+        if view.next_probe_t is None:
+            view.next_probe_t = now + self.probe_backoff_s
+            return
+        if now < view.next_probe_t:
+            return
+        view.probes += 1
+        view.next_probe_t = now + self.probe_backoff_s
+        logger.warning(
+            "fleet: replica %s silent for %.2fs (probe %d/%d)",
+            view.name, silent_for, view.probes, self.probe_retries)
+        if view.probes >= self.probe_retries:
+            self._mark_down(
+                view, f"missed heartbeat for {silent_for:.2f}s "
+                f"after {view.probes} probes")
+
+    def _mark_down(self, view: _ReplicaView, reason: str,
+                   *, clean: bool = False) -> None:
+        view.down = True
+        view.down_reason = reason
+        if not clean:
+            logger.warning("fleet: replica %s DOWN (%s); replaying %d "
+                           "in-flight request(s)", view.name, reason,
+                           len(view.assigned))
+            self.registry.counter("fleet/failovers").inc()
+        self._replay(view)
+
+    def _replay(self, view: _ReplicaView) -> None:
+        """Failover replay: every request the dead replica held goes
+        back in the pool with its emitted prefix intact; dispatch
+        re-submits ``prompt + prefix`` with the remaining budget."""
+        # reverse rid order + appendleft == oldest request ends up at
+        # the very front: replays keep their original relative order
+        for frid, req in sorted(view.assigned.items(), reverse=True):
+            if req.done:
+                continue
+            if self._stream_complete(req):
+                self._finish(req, None)
+                continue
+            req.replays += 1
+            self.registry.counter("fleet/replays").inc()
+            self._requeue_or_park(req, f"replica {view.name} down")
+        view.assigned.clear()
+
+    def _context_limits(self) -> tuple:
+        """Smallest ``(max_seq, prefill_len)`` any known replica
+        advertised in its ready handshake — ``(None, None)`` when the
+        transport does not say (hermetic fakes need not)."""
+        max_seq = prefill = None
+        for v in self._views.values():
+            m = v.meta or {}
+            if m.get("max_seq") is not None:
+                max_seq = (m["max_seq"] if max_seq is None
+                           else min(max_seq, m["max_seq"]))
+            if m.get("prefill_len") is not None:
+                prefill = (m["prefill_len"] if prefill is None
+                           else min(prefill, m["prefill_len"]))
+        return max_seq, prefill
+
+    def _stream_complete(self, req: FleetRequest) -> bool:
+        """True when the stream needs no more decoding and only the
+        ``finished`` event was lost to the kill: budget exhausted, eos
+        emitted, or the engine's third finish condition — the context
+        cap.  A stream at ``max_seq`` was FINISHED by the engine
+        ("truncation is a response"); and a replay prefix that no
+        longer fits a packed prefill row on any replica cannot be
+        continued anywhere — deliver the truncated stream instead of
+        bouncing the request into REJECTED."""
+        if req.remaining <= 0:
+            return True
+        if (req.eos_id is not None and req.output_tokens
+                and req.output_tokens[-1] == req.eos_id):
+            return True
+        max_seq, prefill = self._context_limits()
+        wire = len(req.prompt) + len(req.output_tokens)
+        if max_seq is not None and wire >= max_seq:
+            return True
+        if prefill is not None and wire > prefill:
+            return True
+        return False
+
+    # ----------------------------------------------------------- dispatch
+
+    def _pick_tenant(self, priority: int) -> Optional[tuple]:
+        keys = [k for k, q in self._pending.items()
+                if k[0] == priority and q]
+        if not keys:
+            return None
+        return min(keys, key=lambda k: (
+            self._tenant_pass.get(k[1], 0.0), k[1]))
+
+    def _pick_replica(self) -> Optional[_ReplicaView]:
+        candidates = [v for v in self._views.values()
+                      if v.dispatchable()
+                      and v.in_flight() < self.replica_queue_limit]
+        if not candidates:
+            return None
+        # most free blocks first (the live admission signal scraped
+        # from introspect()), fewest assigned as the tiebreak
+        def score(v: _ReplicaView):
+            free = (int(v.state.get("free_blocks", 0))
+                    if v.state is not None else 0)
+            return (-free, len(v.assigned), v.name)
+
+        return min(candidates, key=score)
+
+    def _dispatch(self) -> None:
+        while True:
+            priorities = sorted({k[0] for k, q in self._pending.items()
+                                 if q})
+            if not priorities:
+                return
+            key = self._pick_tenant(priorities[0])
+            if key is None:
+                return
+            view = self._pick_replica()
+            if view is None:
+                return  # no capacity anywhere: stays in the router pool
+            req = self._pending[key].popleft()
+            if req.done:
+                continue
+            self._charge(req.tenant)
+            # replay prefix: the engine prefills prompt+emitted tokens
+            # in one packed row — recovery rides the ordinary admission
+            # path, no special-case decode state
+            wire_prompt = list(map(int, req.prompt)) + req.output_tokens
+            req.state = RequestState.RUNNING
+            req.replica = view.name
+            view.assigned[req.rid] = req
+            try:
+                view.client.submit(req.rid, wire_prompt, req.remaining,
+                                   req.eos_id)
+            except Exception as e:  # dead pipe on write
+                logger.warning("fleet: submit to %s failed: %r",
+                               view.name, e)
+                self._mark_down(view, f"dead pipe on submit: {e!r}")
+
+    # ------------------------------------------------------------ rollout
+
+    def rollout(self, factory: Callable[[str], object], *,
+                names: Optional[Sequence[str]] = None,
+                drain_timeout_s: float = 120.0,
+                ready_timeout_s: float = 300.0,
+                poll_s: float = 0.002,
+                on_tick: Optional[Callable[[], None]] = None) -> List[str]:
+        """Zero-downtime weight rollout, one replica at a time.
+
+        For each name: SIGTERM-drain (in-flight requests deliver on the
+        old weights, queued ones reschedule onto the rest of the
+        fleet), wait for the clean exit, spawn ``factory(name)`` (which
+        restores the newest VERIFIED checkpoint), wait for its ready
+        handshake, rejoin.  The router keeps pumping throughout —
+        ``on_tick`` (called every iteration) is where a load generator
+        keeps traffic flowing so the smoke can prove the fleet never
+        went dark.  Returns the rolled replica names.
+
+        A replica that dies mid-drain is handled by the ordinary
+        failover path (its remaining requests replay) and is still
+        replaced — a rollout must converge even through a crash.
+        """
+        rolled = []
+        for name in list(names if names is not None else self._views):
+            view = self._views[name]
+            self.registry.counter("fleet/rollouts").inc()
+            view.rolling = True
+            view.client.begin_drain()
+            # deadlines run on the injected clock (one control-flow
+            # clock domain with failure detection — the timeout paths
+            # are drivable in deterministic tests)
+            deadline = self._clock() + drain_timeout_s
+            while not view.down:
+                self.pump()
+                if on_tick is not None:
+                    on_tick()
+                if view.drained and not view.client.alive():
+                    break
+                if self._clock() > deadline:
+                    logger.warning(
+                        "fleet: %s did not drain in %.0fs; escalating",
+                        name, drain_timeout_s)
+                    self._mark_down(view, "drain timeout")
+                    break
+                time.sleep(poll_s)
+            # retire the old client (reap the exited process) and seat
+            # the replacement under the same name
+            try:
+                view.client.close()
+            except Exception as e:
+                logger.warning("fleet: closing old %s failed: %r",
+                               name, e)
+            if not view.down:
+                self._mark_down(view, "rolled out", clean=True)
+            new_client = factory(name)
+            if new_client.name != name:
+                raise ValueError(
+                    f"rollout factory returned client named "
+                    f"{new_client.name!r} for slot {name!r}")
+            new_view = _ReplicaView(new_client, self._clock())
+            self._views[name] = new_view
+            deadline = self._clock() + ready_timeout_s
+            while not new_view.ready:
+                self.pump()
+                if on_tick is not None:
+                    on_tick()
+                if not new_client.alive() and not new_view.ready:
+                    raise RuntimeError(
+                        f"fleet: replacement replica {name} died before "
+                        f"ready (exitcode "
+                        f"{getattr(new_client, 'exitcode', None)})")
+                if self._clock() > deadline:
+                    raise RuntimeError(
+                        f"fleet: replacement replica {name} not ready "
+                        f"in {ready_timeout_s:.0f}s")
+                time.sleep(poll_s)
+            rolled.append(name)
+        return rolled
+
+    # ------------------------------------------------------- introspection
+
+    def introspect(self) -> dict:
+        """Live fleet state — duck-types the engine slot of
+        :class:`~apex_tpu.observability.debug_server.DebugServer`, so
+        ``DebugServer(engine=router)`` serves the fleet at /statusz."""
+        replicas = {}
+        for name, v in self._views.items():
+            replicas[name] = {
+                "ready": v.ready, "down": v.down,
+                "down_reason": v.down_reason,
+                "draining": v.draining, "rolling": v.rolling,
+                "assigned": len(v.assigned),
+                "in_flight": v.in_flight(),
+                "free_blocks": (v.state or {}).get("free_blocks"),
+                "ckpt_step": (v.meta or {}).get("ckpt_step"),
+            }
+        states = collections.Counter(
+            r.state.value for r in self.requests.values())
+        return {
+            "replicas": replicas,
+            "queue_depth": self.total_queue_depth(),
+            "pending": sum(len(q) for q in self._pending.values()),
+            "requests": dict(states),
+            # the fleet is "draining" only when every replica is —
+            # /healthz on the router stays ok through a staggered roll
+            "draining": bool(self._views) and all(
+                v.draining or v.down for v in self._views.values()),
+        }
+
+    # ---------------------------------------------------------- lifecycle
+
+    def idle(self) -> bool:
+        """True when every submitted request reached a terminal state."""
+        return all(r.done for r in self.requests.values())
+
+    def run_until_idle(self, *, timeout_s: float = 300.0,
+                       poll_s: float = 0.002) -> None:
+        deadline = self._clock() + timeout_s
+        while not self.idle():
+            self.pump()
+            if self._clock() > deadline:
+                open_reqs = [r.rid for r in self.requests.values()
+                             if not r.done]
+                raise RuntimeError(
+                    f"fleet not idle after {timeout_s:.0f}s; open "
+                    f"requests: {open_reqs[:16]}")
+            time.sleep(poll_s)
+
+    def close(self) -> None:
+        """Tear the fleet down (idempotent per client)."""
+        for view in self._views.values():
+            try:
+                view.client.close()
+            except Exception as e:
+                logger.warning("fleet: closing %s failed: %r",
+                               view.name, e)

@@ -98,8 +98,9 @@ def _place_subtree(logical: dict, like, prefix: str):
 
 def restore_gpt_for_serving(ckpt_dir: str, config, *, mesh=None,
                             tp_axis: str = "tp", key: str = "params",
-                            sharded: bool = True, verify: bool = True
-                            ) -> Tuple[object, object]:
+                            sharded: bool = True, verify: bool = True,
+                            with_step: bool = False
+                            ) -> Tuple[object, ...]:
     """Restore the newest intact GPT checkpoint onto the serving mesh.
 
     ``ckpt_dir`` is a :class:`~apex_tpu.resilience.CheckpointManager`
@@ -109,6 +110,11 @@ def restore_gpt_for_serving(ckpt_dir: str, config, *, mesh=None,
     ``(params, specs)`` with the layer stack in the canonical
     ``[L, 1, ...]`` serving form, resharded from whatever
     ``(vpp, pp, tp, dp)`` layout the checkpoint was trained on.
+
+    ``with_step=True`` returns ``(params, specs, step)`` — a fleet
+    replica reports the step it actually serves in its handshake, so a
+    rollout that fell back past a corrupt newest checkpoint is visible
+    to the router and the operator, not silent (ISSUE 11).
     """
     from apex_tpu import checkpoint as ckpt
     from apex_tpu.observability.spans import span
@@ -129,6 +135,8 @@ def restore_gpt_for_serving(ckpt_dir: str, config, *, mesh=None,
                     logger.warning(
                         "serving restore fell back to step %d past %s",
                         step, "; ".join(failures))
+                if with_step:
+                    return params, specs, step
                 return params, specs
             except (ckpt.CheckpointCorruptError, ValueError, OSError,
                     KeyError) as e:

@@ -1,0 +1,421 @@
+"""One serving replica = one spawned process hosting a ServingEngine.
+
+The fleet layer (ISSUE 11) multiplies the PR 8 engine: each replica is
+a **separate process** with its own mesh, compiled programs, KV arenas
+and :class:`~apex_tpu.serving.engine.ServingEngine`, so a replica death
+is a process death — exactly the failure the router is built to
+survive — and a weight rollout is a process replacement.  The process
+lifecycle deliberately mirrors ``data/service.py`` (the one battle-
+tested pattern in this repo for a non-daemonic jax child): a startup
+handshake carrying replica metadata, error relay with a picklability
+pre-test, a ppid orphan watchdog so a SIGKILLed router never leaks
+replicas, and an escalating join→terminate→kill teardown through the
+shared :func:`~apex_tpu.data._producer.reap_process` ladder.
+
+Wire protocol (multiprocessing queues; every payload is plain
+picklable data):
+
+parent → child commands
+    ``("submit", frid, prompt, max_new_tokens, eos_id)``
+    ``("drain",)``      — programmatic drain (tests); production
+                          rollouts send a real **SIGTERM** instead,
+                          through the engine's ``PreemptionGuard``
+    ``("stop",)``       — immediate cooperative exit
+
+child → parent events
+    ``("ready", meta)``        — engine built; ``meta`` has ``pid``,
+                                 ``ckpt_step`` (None for seed init),
+                                 ``max_batch``, ``n_blocks``,
+                                 ``debug_port`` (``/healthz`` etc.)
+    ``("state", snapshot)``    — rate-limited heartbeat: the engine's
+                                 ``introspect()`` dict + ``hb`` stamp;
+                                 the router's liveness AND admission
+                                 signal (free blocks, queue depth,
+                                 draining)
+    ``("token", frid, token)`` — one generated token, in order
+    ``("finished", frid)`` / ``("cancelled", frid)`` /
+    ``("rejected", frid, why)`` — terminal transitions; ``cancelled``
+                                 means drained-out-of-queue (the router
+                                 reschedules it), ``rejected`` means
+                                 refused at submit
+    ``("drained", delivered)`` — the SIGTERM drain completed: every
+                                 in-flight request delivered; the child
+                                 exits 0 right after
+    ``("error", exc)``         — relayed fatal; the child exits
+
+A SIGKILLed child never sends ``drained`` — the router sees the dead
+process/pipe, drains whatever events DID flush (tokens generated before
+the kill are real and kept), and replays the remainder elsewhere
+(``fleet.py``).  Token events are emitted strictly in generation order,
+so the router-side stitched stream is a prefix of the true greedy
+stream at every instant.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import queue as queue_mod
+from typing import Any, Optional, Sequence
+
+__all__ = ["ReplicaSpec", "ReplicaProcess"]
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass(frozen=True)
+class ReplicaSpec:
+    """Everything a child needs to stand up one engine (picklable —
+    crosses the spawn boundary).
+
+    ``ckpt_dir`` set: params come from the newest VERIFIED checkpoint
+    via :func:`~apex_tpu.serving.loader.restore_gpt_for_serving`
+    (corrupt-newest falls back; the restored step is reported in the
+    ready handshake).  ``ckpt_dir`` None: deterministic seed init — two
+    replicas with the same spec serve identical weights.
+    """
+
+    config: Any                      # TransformerConfig
+    serving: Any                     # ServingConfig
+    tp: int = 1
+    ckpt_dir: Optional[str] = None
+    seed: int = 0
+    heartbeat_every_s: float = 0.05  # state-event rate limit
+    idle_sleep_s: float = 0.005      # loop sleep when no work is queued
+    debug_server: bool = True        # /metrics /statusz /healthz
+    warmup: bool = True              # pay the prefill/decode compiles
+    #                                  BEFORE the ready handshake, so the
+    #                                  router's heartbeat timeout never
+    #                                  has to cover an XLA compile
+
+
+def _build_engine(spec: ReplicaSpec, registry, guard):
+    """Child-side engine construction; returns (engine, ckpt_step)."""
+    import jax
+    import jax.numpy as jnp
+
+    from apex_tpu import parallel
+    from apex_tpu.serving.engine import ServingEngine
+    from apex_tpu.serving.loader import restore_gpt_for_serving
+    from apex_tpu.transformer.testing.gpt_parallel_train import build_gpt_3d
+
+    mesh = parallel.initialize_model_parallel(
+        tensor_model_parallel_size=spec.tp,
+        devices=jax.devices()[:max(spec.tp, 1)])
+    step = None
+    if spec.ckpt_dir is not None:
+        params, _, step = restore_gpt_for_serving(
+            spec.ckpt_dir, spec.config, mesh=mesh, with_step=True)
+    else:
+        init_fn, _, _ = build_gpt_3d(
+            spec.config, num_chunks=spec.config.num_layers,
+            num_microbatches=1, mesh=mesh)
+        params, _ = init_fn(jax.random.PRNGKey(spec.seed),
+                            jnp.zeros((2, 2), jnp.int32))
+    engine = ServingEngine(spec.config, spec.serving, params, mesh=mesh,
+                           registry=registry, guard=guard)
+    return engine, step
+
+
+def _replica_worker(spec: ReplicaSpec, name: str, cmd_q, evt_q,
+                    parent_pid: int) -> None:
+    """Replica-process main: build the engine, serve the command stream,
+    relay tokens/state; drain-and-exit on SIGTERM; die on orphanhood."""
+    import os
+    import time
+
+    from apex_tpu.resilience import PreemptionGuard
+
+    def orphaned() -> bool:
+        return os.getppid() != parent_pid
+
+    # the guard installs the real SIGTERM handler (child main thread):
+    # the rollout path is the PR 8 drain, not a new mechanism
+    guard = PreemptionGuard()
+    server = None
+    try:
+        from apex_tpu.observability.metrics import MetricRegistry
+
+        registry = MetricRegistry(rank=0, world=1)
+        engine, ckpt_step = _build_engine(spec, registry, guard)
+        if spec.warmup:
+            # one throwaway token: the jitted prefill + decode programs
+            # compile HERE, inside the wait_ready window, so once this
+            # replica reports ready its step time is steady state and
+            # the router's missed-heartbeat detector sees no compile
+            # stall it could mistake for a wedge
+            engine.submit([1], 1)
+            for _ in range(64):
+                if engine.scheduler.idle:
+                    break
+                engine.step()
+        debug_port = None
+        if spec.debug_server:
+            from apex_tpu.observability.debug_server import DebugServer
+
+            server = DebugServer(registry=registry, engine=engine).start()
+            debug_port = server.port
+        evt_q.put(("ready", {
+            "pid": os.getpid(), "name": name, "ckpt_step": ckpt_step,
+            "max_batch": spec.serving.max_batch,
+            "n_blocks": engine.cache.n_blocks,
+            # context limits: the router needs these to recognize a
+            # stream the engine finished at the context cap (and a
+            # replay prefix no replica could re-prefill) during
+            # failover replay
+            "max_seq": engine.cache.max_seq,
+            "prefill_len": engine.prefill_len,
+            "debug_port": debug_port,
+        }))
+
+        reqs = {}          # frid -> engine Request
+        reported = {}      # frid -> tokens already relayed
+        last_state = 0.0
+
+        def flush() -> None:
+            for frid in list(reqs):
+                req = reqs[frid]
+                toks = req.output_tokens
+                for tok in toks[reported[frid]:]:
+                    evt_q.put(("token", frid, int(tok)))
+                reported[frid] = len(toks)
+                if req.done:
+                    state = req.state.value
+                    if state == "finished":
+                        evt_q.put(("finished", frid))
+                    elif state == "cancelled":
+                        evt_q.put(("cancelled", frid))
+                    else:
+                        evt_q.put(("rejected", frid, state))
+                    del reqs[frid], reported[frid]
+
+        def heartbeat(now: float, force: bool = False) -> float:
+            if force or now - last_state >= spec.heartbeat_every_s:
+                snap = engine.introspect()
+                snap["hb"] = time.time()
+                evt_q.put(("state", snap))
+                return now
+            return last_state
+
+        while not orphaned():
+            try:
+                while True:
+                    cmd = cmd_q.get_nowait()
+                    if cmd[0] == "submit":
+                        _, frid, prompt, max_new, eos = cmd
+                        try:
+                            req = engine.submit(prompt, max_new, eos)
+                        except ValueError as e:
+                            # unserviceable here (too long for this
+                            # replica's pool) — typed refusal, the
+                            # router decides what to do with it
+                            evt_q.put(("rejected", frid, repr(e)))
+                        else:
+                            if req.done:   # rejected in the drain window
+                                evt_q.put(("rejected", frid,
+                                           req.state.value))
+                            else:
+                                reqs[frid] = req
+                                reported[frid] = 0
+                    elif cmd[0] == "drain":
+                        guard.trigger()
+                    elif cmd[0] == "stop":
+                        flush()
+                        return
+            except queue_mod.Empty:
+                pass
+            if not engine.scheduler.idle:
+                engine.step()      # drains itself once guard trips
+            elif guard.triggered:
+                # drain complete: everything delivered, queue empty
+                if not engine.draining:
+                    engine.drain()
+                flush()
+                heartbeat(time.monotonic(), force=True)
+                evt_q.put(("drained", None))
+                return
+            else:
+                time.sleep(spec.idle_sleep_s)
+            flush()
+            last_state = heartbeat(time.monotonic())
+    except BaseException as e:  # noqa: BLE001 — relayed, not eaten
+        import pickle
+
+        try:
+            pickle.dumps(e)
+        except Exception:
+            e = RuntimeError(repr(e))
+        try:
+            evt_q.put(("error", e))
+        except Exception:
+            pass
+    finally:
+        if server is not None:
+            server.close()
+        guard.uninstall()
+
+
+def _shutdown_replica(cmd_q, proc) -> None:
+    """GC/exit finalizer teardown (the data-service pattern: the child
+    is non-daemonic, so an unreaped replica would deadlock interpreter
+    exit under multiprocessing's own atexit join)."""
+    from apex_tpu.data._producer import reap_process
+
+    try:
+        cmd_q.put_nowait(("stop",))
+    except Exception:
+        pass
+    reap_process(proc, 10.0, what="serving replica")
+
+
+class ReplicaProcess:
+    """Router-side handle on one replica child — the process transport
+    behind the :mod:`~apex_tpu.serving.fleet` client duck-type.
+
+    The router talks to this through five methods (``alive``, ``poll``,
+    ``submit``, ``begin_drain``, ``close``) plus ``kill`` for fault
+    injection; ``tests/test_fleet.py`` substitutes an in-memory fake
+    with the same surface, which is what keeps the router's policy
+    logic testable without a single process spawn.
+    """
+
+    def __init__(self, spec: ReplicaSpec, name: str, *,
+                 start_method: str = "spawn"):
+        import multiprocessing as mp
+        import os
+        import weakref
+
+        self.name = name
+        self.meta: Optional[dict] = None
+        self._ctx = mp.get_context(start_method)
+        self._cmd = self._ctx.Queue()
+        self._evt = self._ctx.Queue()
+        self._closed = False
+        # NON-daemonic + ppid watchdog, exactly like DataService: the
+        # child owns compiled XLA programs and a debug server thread; a
+        # daemonic child could not be debugged by spawning helpers, and
+        # orphan safety comes from the watchdog, not daemonism.
+        self._proc = self._ctx.Process(
+            target=_replica_worker,
+            args=(spec, name, self._cmd, self._evt, os.getpid()),
+            daemon=False, name=f"apex-replica-{name}")
+        self._proc.start()
+        self._finalizer = weakref.finalize(
+            self, _shutdown_replica, self._cmd, self._proc)
+
+    # ------------------------------------------------------------ liveness
+
+    def alive(self) -> bool:
+        return self._proc.is_alive()
+
+    @property
+    def pid(self) -> Optional[int]:
+        return self._proc.pid
+
+    @property
+    def exitcode(self) -> Optional[int]:
+        return self._proc.exitcode
+
+    # ------------------------------------------------------------ commands
+
+    def submit(self, frid, prompt: Sequence[int], max_new_tokens: int,
+               eos_id: Optional[int] = None) -> None:
+        self._cmd.put(("submit", frid, [int(t) for t in prompt],
+                       int(max_new_tokens), eos_id))
+
+    def begin_drain(self, *, sigterm: bool = True) -> None:
+        """Start the drain: a real SIGTERM (the production rollout
+        path — same signal a preempted host gets) or the programmatic
+        command when signals are unavailable."""
+        import os
+        import signal as _signal
+
+        if sigterm and self._proc.pid is not None and self.alive():
+            try:
+                os.kill(self._proc.pid, _signal.SIGTERM)
+                return
+            except ProcessLookupError:
+                pass
+        self._cmd.put(("drain",))
+
+    def kill(self) -> None:
+        """SIGKILL — fault injection only (the smoke's dead-replica
+        leg).  No drain, no goodbye: the router must cope."""
+        import os
+        import signal as _signal
+
+        if self._proc.pid is not None:
+            try:
+                os.kill(self._proc.pid, _signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+
+    # -------------------------------------------------------------- events
+
+    def poll(self) -> list:
+        """Drain every event the child has flushed (non-blocking).
+        Readable even after a SIGKILL — whatever reached the pipe
+        before death is real and must be consumed before failover."""
+        events = []
+        while True:
+            try:
+                events.append(self._evt.get_nowait())
+            except queue_mod.Empty:
+                break
+            except (EOFError, OSError):
+                break
+        return events
+
+    def wait_ready(self, timeout: float = 300.0) -> dict:
+        """Block until the startup handshake (engine built); relays a
+        child-side construction error.  Returns (and caches) ``meta``;
+        any events read past the handshake are re-deliverable via
+        :meth:`poll` order — ready is always the FIRST event, so
+        nothing can precede it."""
+        if self.meta is not None:
+            return self.meta
+        try:
+            kind, payload = self._evt.get(timeout=timeout)
+        except queue_mod.Empty:
+            alive = self.alive()
+            raise RuntimeError(
+                f"replica {self.name}: no ready handshake in "
+                f"{timeout:.0f}s (alive={alive}, "
+                f"exitcode={self.exitcode})") from None
+        if kind == "error":
+            raise payload
+        if kind != "ready":
+            raise RuntimeError(
+                f"replica {self.name}: handshake got {kind!r} before "
+                "ready")
+        self.meta = payload
+        return payload
+
+    # ------------------------------------------------------------ teardown
+
+    def close(self, timeout: float = 10.0) -> None:
+        """Cooperative stop + escalating reap (idempotent)."""
+        if self._closed:
+            return
+        self._closed = True
+        self._finalizer.detach()
+        try:
+            self._cmd.put_nowait(("stop",))
+        except Exception:
+            pass
+        # drain events so a child blocked on a full pipe can exit
+        self.poll()
+        from apex_tpu.data._producer import reap_process
+
+        reap_process(self._proc, timeout, what="serving replica")
+        for q in (self._cmd, self._evt):
+            try:
+                q.close()
+            except Exception:
+                pass
+
+    def __enter__(self) -> "ReplicaProcess":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

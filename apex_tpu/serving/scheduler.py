@@ -5,6 +5,7 @@ The state machine the engine drives once per step:
     WAITING --admit (slot + blocks free)--> RUNNING --eos / budget /
         max_seq--> FINISHED
     WAITING --drain--> CANCELLED
+    submit() while draining --> REJECTED   (refused at the door)
 
 - **Admission** is all-or-nothing per request: a free decode slot AND
   the request's *worst-case* block count
@@ -23,7 +24,11 @@ The state machine the engine drives once per step:
 - **Draining** (preemption): no further admissions; RUNNING requests
   decode to completion and deliver their responses; WAITING requests
   are cancelled immediately (the submitter sees a terminal state, not
-  a hang) — the serving analog of the PR 3 drain-then-exit.
+  a hang) — the serving analog of the PR 3 drain-then-exit.  A submit
+  that arrives *during* the drain is REJECTED, not cancelled: the two
+  terminal states answer different routing questions (see
+  ``RequestState``), and the engine counts them separately
+  (``serving/requests_cancelled`` vs ``serving/requests_rejected``).
 """
 
 from __future__ import annotations
@@ -47,6 +52,12 @@ class RequestState(enum.Enum):
     RUNNING = "running"
     FINISHED = "finished"
     CANCELLED = "cancelled"
+    # refused at the door (submitted into a drain window, or shed by the
+    # fleet router on overload) — distinguishable from CANCELLED, which
+    # means "accepted, then drained out of the queue": a router that
+    # sees REJECTED re-routes the request to another replica, while a
+    # CANCELLED request was an accepted casualty of this engine's drain
+    REJECTED = "rejected"
 
 
 @dataclasses.dataclass
@@ -71,7 +82,8 @@ class Request:
 
     @property
     def done(self) -> bool:
-        return self.state in (RequestState.FINISHED, RequestState.CANCELLED)
+        return self.state in (RequestState.FINISHED, RequestState.CANCELLED,
+                              RequestState.REJECTED)
 
     @property
     def last_token(self) -> int:
@@ -121,7 +133,12 @@ class Scheduler:
                 f"{self.allocator.n_blocks}; raise n_blocks or lower "
                 "max_new_tokens")
         if self.draining:
-            req.state = RequestState.CANCELLED
+            # a submit that lands in the drain window is refused with a
+            # typed terminal state, NOT accepted-then-cancelled: the
+            # caller (a fleet router, a retrying client) must be able to
+            # tell "this engine would never have run it" from "it was
+            # queued and the drain killed it"
+            req.state = RequestState.REJECTED
             return req
         self.waiting.append(req)
         return req

@@ -1,0 +1,26 @@
+#!/usr/bin/env bash
+# Fleet-serving smoke (ISSUE 11): the 3-replica fault matrix on the CPU
+# mesh, with real processes and real signals —
+#   - SIGKILL one replica mid-decode: the router detects the dead pipe,
+#     replays its in-flight requests on the survivors, and every stream
+#     stays BITWISE IDENTICAL to an uninterrupted greedy reference;
+#   - submit flood past the fleet bound: typed REJECTED terminal states
+#     + serving/requests_rejected, never a silent hang;
+#   - staggered zero-downtime weight rollout under load: SIGTERM drain
+#     -> restore newest VERIFIED checkpoint (corrupt newest falls back)
+#     -> rejoin, with zero failed requests and bounded p99 TPOT;
+#   - /healthz answers ok on live replicas, refuses on the killed one.
+# Router policy logic is unit-tested hermetically in
+# tests/test_fleet.py; this script is the end-to-end proof.  Wired
+# fast-tier in tests/test_aux_subsystems.py like the PR 8/9 smokes.
+#
+# Usage: scripts/fleet_smoke.sh
+set -euo pipefail
+
+REPO="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+PYTHON="${PYTHON:-python}"
+
+cd "$REPO"
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+  "$PYTHON" apex_tpu/testing/fleet_smoke.py
+echo "PASS" >&2

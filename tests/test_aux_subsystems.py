@@ -1,7 +1,8 @@
 """Aux subsystems: timers export, autoresume protocol, rank logger
 (SURVEY §5 tracing / failure-detection / observability rows), the
-input-pipeline smoke script (ISSUE 8 CI satellite), and the serving
-smoke script (ISSUE 9 CI satellite)."""
+input-pipeline smoke script (ISSUE 8 CI satellite), the serving smoke
+script (ISSUE 9 CI satellite), and the fleet-serving smoke script
+(ISSUE 11 CI satellite)."""
 
 import json
 import logging
@@ -149,6 +150,33 @@ def test_serving_smoke_script():
         f"stderr tail:\n{proc.stderr.decode(errors='replace')[-3000:]}")
     assert b"PASS" in proc.stderr
     assert b"phase A OK" in proc.stderr and b"phase B OK" in proc.stderr
+
+
+def test_fleet_smoke_script():
+    """scripts/fleet_smoke.sh end to end (ISSUE 11): the 3-replica
+    fault matrix with real processes and real signals — SIGKILL one
+    replica mid-decode and the replayed streams stay bitwise identical
+    to the uninterrupted greedy reference; overload sheds with typed
+    REJECTED + serving/requests_rejected; a staggered SIGTERM-drain
+    weight rollout under load restores the newest VERIFIED checkpoint
+    (corrupt newest falls back), finishes every request, and keeps p99
+    TPOT bounded; /healthz answers on live replicas and refuses on the
+    killed one.  Subprocess because the smoke spawns replica processes
+    and owns its own platform pinning (the serving-smoke pattern)."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    env["PYTHON"] = sys.executable
+    proc = subprocess.run(
+        ["bash", os.path.join(repo, "scripts", "fleet_smoke.sh")],
+        cwd=repo, env=env, capture_output=True, timeout=560)
+    assert proc.returncode == 0, (
+        f"fleet_smoke.sh rc={proc.returncode}\n"
+        f"stderr tail:\n{proc.stderr.decode(errors='replace')[-3000:]}")
+    assert b"PASS" in proc.stderr
+    for phase in (b"phase A OK", b"phase B OK", b"phase C OK"):
+        assert phase in proc.stderr
 
 
 def test_obs_smoke_script(tmp_path):

@@ -5,15 +5,16 @@ last JSON line.  Rounds 1-4 all delivered ``parsed: null`` because the
 full record line grew past the tail size.  These tests pin the fix: every
 emission ends with a compact line that (a) is <= 1500 bytes, (b) parses,
 (c) carries the driver contract fields, and (d) survives a simulated
-2000-byte tail even in the worst case (all fifteen BENCH_ORDER rows
+2000-byte tail even in the worst case (all sixteen BENCH_ORDER rows
 verbose — including ``real_data_rn50`` with its ``vs_synthetic``
 composition, ``zero_adam_step`` with ``vs_per_leaf``, ``tp_gpt``
 with its overlap_comm A/B fields (``overlap_tokens_per_sec`` /
 ``vs_monolithic``), ``ckpt_save_restore`` with ``vs_sharded``,
 ``ckpt_reshard`` with ``vs_same_mesh``, ``telemetry_overhead``
-with ``vs_bare``, and ``serving`` with its per-concurrency
-tokens/sec + p50/p99 TPOT sub-rows and ``vs_unfused`` — + embedded
-prior TPU evidence).
+with ``vs_bare``, ``serving`` with its per-concurrency
+tokens/sec + p50/p99 TPOT sub-rows and ``vs_unfused``, and
+``serving_fleet`` with its steady/roll p99-TPOT pair and
+``roll_vs_steady`` — + embedded prior TPU evidence).
 """
 
 import io
@@ -27,12 +28,12 @@ import bench  # noqa: E402
 
 
 def _worst_case_results():
-    """All fifteen BENCH_ORDER rows, each fattened with prose fields,
+    """All sixteen BENCH_ORDER rows, each fattened with prose fields,
     like a CPU-fallback day — the REAL worst case (the pre-fix nine-row
     set under-tested the <=1500-byte guarantee once ``real_data_rn50``,
     ``zero_adam_step``, ``ckpt_save_restore``, ``ckpt_reshard``,
-    ``telemetry_overhead``, and the ``serving`` row with its
-    per-concurrency sub-dicts landed)."""
+    ``telemetry_overhead``, the ``serving`` row with its
+    per-concurrency sub-dicts, and the ``serving_fleet`` row landed)."""
     rows = {
         "resnet50_o2": {"value": 8824.6, "unit": "images/sec/chip"},
         "gpt_flash": {"value": 95167.3, "unit": "tokens/sec/chip",
@@ -60,6 +61,12 @@ def _worst_case_results():
                                           "8": 1843.7},
                     "tpot_p50_ms_at": {"1": 4.11, "4": 4.19, "8": 4.32},
                     "tpot_p99_ms_at": {"1": 6.9, "4": 7.4, "8": 9.8}},
+        "serving_fleet": {"value": 3104.2, "unit": "tokens/sec",
+                          "replicas": 3,
+                          "p99_tpot_ms_steady": 3.4,
+                          "p99_tpot_ms_roll": 4.1,
+                          "roll_vs_steady": 1.206,
+                          "roll_wall_s": 46.7},
         "gpt_flash_fp8": {"value": 4112.3, "unit": "tokens/sec/chip"},
         "gpt_long_context": {"value": 2580.7, "unit": "tokens/sec/chip"},
         "input_pipeline": {
@@ -115,6 +122,12 @@ def test_compact_record_under_1500_bytes():
     assert sv["vs_unfused"] == 1.31
     assert sv["tokens_per_sec_at"]["8"] == 1843.7
     assert sv["tpot_p99_ms_at"]["8"] == 9.8
+    # ISSUE 11 fleet sub-rows survive the distillation (``replicas`` /
+    # ``roll_wall_s`` stay in the full record's config/prose only)
+    fl = compact["rows"]["serving_fleet"]
+    assert fl["p99_tpot_ms_steady"] == 3.4
+    assert fl["p99_tpot_ms_roll"] == 4.1
+    assert fl["roll_vs_steady"] == 1.206
     # ISSUE 8 input-pipeline sub-rows survive the distillation
     ip = compact["rows"]["input_pipeline"]
     assert ip["loader_ips_per_backend"]["process"] == 9685.0

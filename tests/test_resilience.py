@@ -410,3 +410,43 @@ def test_preemption_guard_catches_sigterm_and_drains(tmp_path):
     assert at == 1
     np.testing.assert_array_equal(np.asarray(restored["w"]),
                                   np.full((3,), 2.0))
+
+
+def test_preemption_guard_off_main_thread_falls_back():
+    """CPython forbids signal.signal off the main thread; a guard built
+    there (fleet-router health threads, replica children off-main) must
+    degrade to the programmatic trigger() path, not raise (ISSUE 11
+    satellite)."""
+    import threading
+
+    out = {}
+
+    def build():
+        try:
+            guard = PreemptionGuard()
+        except BaseException as e:  # the pre-fix behavior
+            out["error"] = e
+            return
+        out["guard"] = guard
+
+    t = threading.Thread(target=build)
+    t.start()
+    t.join(timeout=10)
+    assert "error" not in out, repr(out.get("error"))
+    guard = out["guard"]
+    assert guard.signals_installed is False
+    assert not guard.triggered
+    guard.trigger()                 # the fallback path still works
+    assert guard.triggered
+    guard.uninstall()               # idempotent no-op: nothing installed
+    # a main-thread guard keeps full signal installation
+    with PreemptionGuard() as main_guard:
+        assert main_guard.signals_installed is True
+    # the fallback is for thread-affinity ONLY: an invalid/uncatchable
+    # signal on the main thread is a caller bug and must keep raising
+    # (ValueError or OSError depending on the libc), not yield a guard
+    # that silently never fires
+    import signal as _signal
+
+    with pytest.raises((ValueError, OSError)):
+        PreemptionGuard(signals=(_signal.SIGKILL,))

@@ -363,13 +363,16 @@ def test_preemption_drain_delivers_in_flight():
         assert req.state is RequestState.FINISHED
         assert len(req.output_tokens) == 4
     assert queued[0].state is RequestState.CANCELLED
-    # a post-drain submit is refused as cancelled, not queued forever —
-    # and counted like every other cancellation
+    # a post-drain submit is REJECTED at the door (typed, distinct from
+    # the drain cancellation of the already-queued request) and counted
+    # in its own catalog entry — the signal a fleet router re-routes on
     late = eng.submit([2, 4], 2)
-    assert late.state is RequestState.CANCELLED
+    assert late.state is RequestState.REJECTED
+    assert late.done
     # metrics recorded through the registry (catalog: docs/serving.md)
     snap = eng.registry.snapshot()
-    assert snap["serving/requests_cancelled"] == 2.0
+    assert snap["serving/requests_cancelled"] == 1.0
+    assert snap["serving/requests_rejected"] == 1.0
     assert snap["serving/requests_finished"] == 2.0
     assert snap["serving/tpot_ms"]["count"] > 0
 

@@ -312,6 +312,11 @@ class TestGoodput:
             {"t": 0.9, "kind": "request_cancel", "rid": 2},
             {"t": 1.0, "kind": "request_submit", "rid": 3,
              "prompt_tokens": 2, "max_new_tokens": 4},
+            # rid 4: refused at submit (drain window / overload shed,
+            # ISSUE 11) — a typed terminal state holding ~zero seconds
+            {"t": 1.2, "kind": "request_submit", "rid": 4,
+             "prompt_tokens": 2, "max_new_tokens": 4},
+            {"t": 1.2, "kind": "request_reject", "rid": 4},
         ]
         rep = serving_goodput_report(events)
         assert rep["requests"][1] == {
@@ -320,8 +325,10 @@ class TestGoodput:
         assert rep["requests"][2]["state"] == "cancelled"
         assert rep["requests"][2]["drained_s"] == pytest.approx(0.7)
         assert rep["requests"][3]["state"] == "open"
+        assert rep["requests"][4]["state"] == "rejected"
+        assert rep["requests"][4]["drained_s"] == pytest.approx(0.0)
         assert rep["totals"] == {
-            "finished": 1, "cancelled": 1, "open": 1,
+            "finished": 1, "cancelled": 1, "rejected": 1, "open": 1,
             "queue_wait_s": 0.5, "active_s": 1.0,
             "drained_s": pytest.approx(0.7)}
         assert rep["goodput_fraction"] == pytest.approx(1.0 / 2.2,
@@ -503,6 +510,44 @@ class TestDebugServer:
             with pytest.raises(urllib.error.HTTPError) as ei:
                 self._get(srv, "/nope")
             assert ei.value.code == 404
+
+    def test_healthz_ok_draining_down(self):
+        """ISSUE 11 satellite: the one health contract router and
+        external probes share — ok is HTTP 200, draining/down are 503
+        with the status named, so both a stock prober (code only) and
+        the fleet router (JSON) read the same endpoint."""
+
+        class Engine:
+            draining = False
+            broken = False
+
+            def introspect(self):
+                if self.broken:
+                    raise RuntimeError("decode wedged")
+                return {"draining": self.draining}
+
+        eng = Engine()
+        with DebugServer(registry=MetricRegistry(rank=0, world=1),
+                         engine=eng) as srv:
+            body = json.loads(self._get(srv, "/healthz").read())
+            assert body["status"] == "ok" and body["engine"] is True
+            eng.draining = True
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                self._get(srv, "/healthz")
+            assert ei.value.code == 503
+            assert json.loads(ei.value.read())["status"] == "draining"
+            eng.broken = True
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                self._get(srv, "/healthz")
+            assert ei.value.code == 503
+            payload = json.loads(ei.value.read())
+            assert payload["status"] == "down"
+            assert "decode wedged" in payload["error"]
+
+    def test_healthz_without_engine_is_liveness_only(self):
+        with DebugServer(registry=MetricRegistry(rank=0, world=1)) as srv:
+            body = json.loads(self._get(srv, "/healthz").read())
+        assert body == {"status": "ok", "engine": False}
 
     def test_ephemeral_port_and_close(self):
         srv = DebugServer(registry=MetricRegistry(rank=0, world=1)).start()
