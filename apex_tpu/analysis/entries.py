@@ -304,18 +304,20 @@ def _reshard() -> List[Program]:
 
 @_entry("serving_decode")
 def _serving_decode() -> List[Program]:
-    """The ISSUE 9 serving runtime's decode step at tp=2 (jit-stable
-    ``[max_batch, 1]`` continuous-batching shape): the APX204 donation
-    audit is the point — the paged KV arenas are the largest HBM tenant
-    of a serving chip and MUST alias in->out through the step (both
-    leaves, hence the exact floor of 2); a dropped ``donate_argnums``
-    or an aliasing regression on the scatter+Pallas-read path doubles
-    cache HBM silently.  APX201/202/203 run over the same tp decode
-    path (no ring / no sentinel: contracts default off), and the jaxpr
-    tier walks the shard_map body including the Pallas call sites.
-    The packed prefill program rides along jaxpr-tier-only (its HLO
-    contracts are structurally the decode step's; one XLA compile is
-    enough for the tier-1 window)."""
+    """The ISSUE 9/12 serving runtime's decode step at tp=2 (jit-stable
+    ``[max_batch, 1]`` continuous-batching shape, now with the
+    eviction/preemption churn AND the per-slot sampling policies riding
+    as ``[max_batch]`` data): the APX204 donation audit is the point —
+    the paged KV arenas are the largest HBM tenant of a serving chip
+    and MUST alias in->out through the step (both leaves of the arenas
+    tuple, hence the exact floor of 2); a dropped ``donate_argnums`` or
+    an aliasing regression on the scatter+Pallas-read+sampling path
+    doubles cache HBM silently.  APX201/202/203 run over the same tp
+    decode path (no ring / no sentinel: contracts default off), and the
+    jaxpr tier walks the shard_map body including the Pallas call
+    sites.  The chunked-prefill program rides along jaxpr-tier-only
+    (its HLO contracts are structurally the decode step's; one XLA
+    compile is enough for the tier-1 window)."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -340,16 +342,20 @@ def _serving_decode() -> List[Program]:
         params, mesh=mesh)
     b = eng.serving.max_batch
     mb = eng.cache.max_blocks_per_request
+    sampling = (np.zeros((b,), np.float32), np.zeros((b,), np.int32),
+                np.ones((b,), np.float32), np.zeros((b,), np.uint32),
+                np.zeros((b,), np.int32))
     decode_args = (
-        eng.arenas[0], eng.arenas[1], eng.params,
+        eng.arenas, eng.params,
         np.zeros((b, 1), np.int32), np.zeros((b,), np.int32),
-        jnp.zeros((b, mb), jnp.int32), np.zeros((b,), bool))
-    pl_len = eng.prefill_len
+        jnp.zeros((b, mb), jnp.int32), np.zeros((b,), bool)) + sampling
+    T = eng.prefill_len
     prefill_args = (
-        eng.arenas[0], eng.arenas[1], eng.params,
-        np.zeros((1, pl_len), np.int32), np.zeros((1, pl_len), np.int32),
-        np.zeros((1, pl_len), np.int32), np.zeros((pl_len,), np.int32),
-        np.zeros((pl_len,), np.int32))
+        eng.arenas, eng.params,
+        np.zeros((b, T), np.int32), np.zeros((b, T), np.int32),
+        jnp.zeros((b, mb), jnp.int32), np.zeros((b,), np.int32),
+        np.zeros((b, T), np.int32), np.zeros((b, T), np.int32),
+        np.zeros((b, T), np.int32), np.full((b,), T, np.int32)) + sampling
     return [
         Program(name="serving_decode/decode_step",
                 fn=eng._decode, args=decode_args,

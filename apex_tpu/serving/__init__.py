@@ -45,12 +45,16 @@ from apex_tpu.serving.kv_cache import (
     BlockAllocator,
     KVCacheConfig,
     OutOfBlocksError,
+    PrefixCache,
     init_kv_arena,
 )
 from apex_tpu.serving.paged_attention import (
     paged_attention_decode,
     paged_attention_decode_unfused,
+    paged_prefill_attention,
+    paged_prefill_attention_unfused,
 )
+from apex_tpu.serving.sampling import SamplingParams
 from apex_tpu.serving.scheduler import Request, RequestState, Scheduler
 from apex_tpu.serving.engine import ServingConfig, ServingEngine
 from apex_tpu.serving.loader import restore_gpt_for_serving
@@ -63,15 +67,19 @@ __all__ = [
     "FleetRouter",
     "KVCacheConfig",
     "OutOfBlocksError",
+    "PrefixCache",
     "ReplicaProcess",
     "ReplicaSpec",
     "Request",
     "RequestState",
+    "SamplingParams",
     "Scheduler",
     "ServingConfig",
     "ServingEngine",
     "init_kv_arena",
     "paged_attention_decode",
     "paged_attention_decode_unfused",
+    "paged_prefill_attention",
+    "paged_prefill_attention_unfused",
     "restore_gpt_for_serving",
 ]

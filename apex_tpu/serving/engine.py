@@ -1,19 +1,29 @@
 """The serving engine: continuous batching over the paged-cache decode.
 
 One object owns the whole runtime: the compiled prefill/decode programs
-(built ONCE — request churn is data, never shape, so the decode step
-compiles exactly once per process; :meth:`ServingEngine.
-decode_compile_count` pins this in tests), the sharded KV arenas
-(donated through every step so XLA updates them in place — APX204,
-analyzer entry ``serving_decode``), the host scheduler, the PR 5
-metrics, and the PR 3 preemption drain.
+(each built ONCE — request churn, chunked prefill, prefix-cache hits,
+eviction, preemption and per-request sampling policies are all data,
+never shape, so both steps compile exactly once per process;
+:meth:`ServingEngine.decode_compile_count` pins this in tests), the
+sharded KV arenas (donated through every step so XLA updates them in
+place — APX204, analyzer entry ``serving_decode``), the host scheduler,
+the PR 5 metrics, and the PR 3 preemption drain.
 
 Step anatomy (:meth:`ServingEngine.step`)::
 
-    [preemption?] -> admit waiting requests     (slots + blocks)
-                  -> prefill the admitted ones  (packed rows, flash)
-                  -> one batched decode step    (paged attention)
-                  -> append/finish bookkeeping  (host)
+    [preemption?] -> admit waiting requests   (slot + first-chunk
+                                               blocks; prefix-cache
+                                               hits shared, not
+                                               recomputed)
+                  -> one chunked-prefill call  (each prefilling slot
+                                               advances <= prefill_len
+                                               tokens — a long prompt
+                                               never stalls the tick)
+                  -> grow decode blocks        (evict cached LRU, then
+                                               preempt newest)
+                  -> one batched decode step   (paged attention +
+                                               in-graph sampling)
+                  -> append/finish bookkeeping (host)
 
 Metric catalog (rank-aware registry, docs/observability.md +
 docs/serving.md):
@@ -27,21 +37,30 @@ docs/serving.md):
   counters (rejected = refused at submit while draining — a typed
   terminal state, distinct from accepted-then-drained cancellation)
 - ``serving/active_slots`` / ``serving/free_blocks`` gauges
+- ``serving/kv_occupancy`` gauge — fraction of the block pool holding
+  live or cached KV (the occupancy worst-case reservation kept low)
+- ``serving/prefix_cache_hits`` counter — blocks served from the
+  prefix cache instead of recomputed
+- ``serving/preemptions``  counter — requests evicted back to the
+  queue for recompute-on-readmit
+- ``serving/evictions``    counter — prefix-cache blocks returned to
+  the free list under pool pressure
 - ``serving/preemption_drains`` counter
 - ``serving/mfu``          gauge — decode-step MFU when the device peak
   is known (``introspect()["mfu_reason"]`` says why otherwise)
 
 Run-timeline (ISSUE 10): with a flight recorder armed
 (:mod:`apex_tpu.observability.timeline`) the engine additionally logs
-the full request lifecycle keyed by request id — see the class
-docstring and docs/observability.md.
+the full request lifecycle keyed by request id — including
+``request_preempt`` and the re-``request_admit`` of the recompute —
+see the class docstring and docs/observability.md.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import time
-from typing import Any, List, Optional, Sequence
+from typing import Any, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -52,8 +71,10 @@ from apex_tpu.serving.kv_cache import (
     KVCacheConfig,
     arena_partition_spec,
     init_kv_arena,
+    scale_partition_spec,
 )
 from apex_tpu.serving.model import DecodeModel
+from apex_tpu.serving.sampling import SamplingParams
 from apex_tpu.serving.scheduler import Request, RequestState, Scheduler
 
 __all__ = ["ServingConfig", "ServingEngine"]
@@ -61,16 +82,35 @@ __all__ = ["ServingConfig", "ServingEngine"]
 
 @dataclasses.dataclass(frozen=True)
 class ServingConfig:
-    """Static shape of the runtime (everything that pins a compile)."""
+    """Static shape of the runtime (everything that pins a compile).
+
+    ``prefill_len`` is the per-slot chunk width of the batched chunked
+    prefill — the most prompt tokens any one request advances per tick
+    (long prompts slice across ticks and never stall the decode).
+    ``admission`` selects occupancy admission (on-demand growth +
+    eviction + preemption, the production policy) or the PR 8
+    worst-case ``"reserve"`` baseline; ``prefix_caching`` toggles
+    copy-on-write prompt-prefix sharing (occupancy mode only).
+    ``cache_dtype=jnp.int8`` stores the KV arenas quantized with
+    per-row fp32 scales dequantized inside the paged kernels.
+    """
 
     max_batch: int = 8           # concurrent decode slots
     block_size: int = 16         # tokens per KV block
     max_seq: int = 256           # per-request context cap (prompt+output)
     n_blocks: Optional[int] = None   # arena size; default = worst case
-    prefill_len: Optional[int] = None  # packed prefill row; default max_seq
+    prefill_len: Optional[int] = None  # chunk width; default max_seq
     cache_dtype: Any = None      # arena storage dtype; default param dtype
-    fused_attention: bool = True   # Pallas paged kernel vs unfused XLA
+    fused_attention: bool = True   # Pallas paged kernels vs unfused XLA
     fuse_epilogue: bool = True     # fused residual/norm epilogue kernel
+    admission: str = "occupancy"   # or "reserve" (PR 8 worst-case A/B)
+    prefix_caching: bool = True    # share prompt-prefix blocks
+
+    def __post_init__(self):
+        if self.admission not in ("occupancy", "reserve"):
+            raise ValueError(
+                f"admission must be 'occupancy' or 'reserve', got "
+                f"{self.admission!r}")
 
     def resolve_n_blocks(self, max_blocks_per_request: int) -> int:
         if self.n_blocks is not None:
@@ -79,7 +119,7 @@ class ServingConfig:
 
 
 class ServingEngine:
-    """Continuous-batching greedy-decode runtime over a GPT checkpoint.
+    """Continuous-batching decode runtime over a GPT checkpoint.
 
     ``params``: a :class:`~apex_tpu.transformer.testing.
     gpt_parallel_train.GPT3DParams` with the layer stack in the
@@ -102,10 +142,10 @@ class ServingEngine:
 
     ``timeline_tick_every``: when a flight recorder is armed
     (:mod:`apex_tpu.observability.timeline`), every request's lifecycle
-    is logged (submit → admit → prefill → decode ticks → finish/
-    cancel, keyed by ``rid``); decode ticks are sampled every N
-    generated tokens so the hot loop pays one host dict per N tokens,
-    not per token.
+    is logged (submit → admit → prefill chunks → decode ticks →
+    preempt/re-admit → finish/cancel, keyed by ``rid``); decode ticks
+    are sampled every N generated tokens so the hot loop pays one host
+    dict per N tokens, not per token.
     """
 
     def __init__(self, config, serving: ServingConfig, params, *,
@@ -161,29 +201,36 @@ class ServingEngine:
         self.param_specs = type(params)(
             embedding=e_specs, layers=l_specs, final_ln=ln_specs)
 
-        self.arenas = init_kv_arena(self.cache, self.mesh, tp_axis)
+        self.arenas: Tuple[Any, ...] = init_kv_arena(
+            self.cache, self.mesh, tp_axis)
         a_spec = arena_partition_spec(tp_axis)
+        arena_specs: Tuple[Any, ...] = (a_spec, a_spec)
+        if self.cache.quantized:
+            s_spec = scale_partition_spec(tp_axis)
+            arena_specs = (a_spec, a_spec, s_spec, s_spec)
 
         rep = P()
         decode_body = cc.shard_over(
             self.model.decode_step, mesh=self.mesh,
-            in_specs=(a_spec, a_spec, self.param_specs, rep, rep, rep, rep),
-            out_specs=(a_spec, a_spec, P(None), P(None, None)),
+            in_specs=(arena_specs, self.param_specs) + (rep,) * 9,
+            out_specs=(arena_specs, P(None), P(None, None)),
         )
         prefill_body = cc.shard_over(
             self.model.prefill, mesh=self.mesh,
-            in_specs=(a_spec, a_spec, self.param_specs, rep, rep, rep, rep,
-                      rep),
-            out_specs=(a_spec, a_spec, P(None), P(None, None)),
+            in_specs=(arena_specs, self.param_specs) + (rep,) * 13,
+            out_specs=(arena_specs, P(None), P(None, None, None)),
         )
         # the arenas are donated: the KV cache must alias in->out or the
         # biggest HBM tenant of the chip doubles (APX204, entry
         # serving_decode)
-        self._decode = jax.jit(decode_body, donate_argnums=(0, 1))
-        self._prefill = jax.jit(prefill_body, donate_argnums=(0, 1))
+        self._decode = jax.jit(decode_body, donate_argnums=(0,))
+        self._prefill = jax.jit(prefill_body, donate_argnums=(0,))
         self._jnp = jnp
 
-        self.scheduler = Scheduler(self.cache, serving.max_batch)
+        self.scheduler = Scheduler(
+            self.cache, serving.max_batch, chunk_tokens=self.prefill_len,
+            admission=serving.admission,
+            prefix_caching=serving.prefix_caching)
         self.registry = registry if registry is not None else \
             default_registry()
         self.guard = guard
@@ -197,6 +244,9 @@ class ServingEngine:
             (serving.max_batch, self.cache.max_blocks_per_request),
             np.int32)
         self._steps = 0
+        self._counted_preempts = 0     # flushed-so-far deltas
+        self._counted_hits = 0
+        self._counted_evictions = 0
         # MFU bookkeeping (ISSUE 10 satellite): FLOPs of the decode
         # program probed once (lazily, pre-donation), last decode wall
         # time measured each step; serving/mfu flushed as a gauge when
@@ -212,8 +262,14 @@ class ServingEngine:
 
     def decode_compile_count(self) -> int:
         """Compiled-variant count of the decode step (the zero-recompile
-        contract: stays 1 across any request churn)."""
+        contract: stays 1 across any request churn, preemption,
+        eviction, and sampling-policy mix)."""
         return int(self._decode._cache_size())
+
+    def prefill_compile_count(self) -> int:
+        """Compiled-variant count of the chunked prefill (the fixed
+        ``[max_batch, prefill_len]`` chunk shape: also exactly 1)."""
+        return int(self._prefill._cache_size())
 
     @property
     def draining(self) -> bool:
@@ -222,12 +278,13 @@ class ServingEngine:
     # -------------------------------------------------------------- submit
 
     def submit(self, prompt: Sequence[int], max_new_tokens: int,
-               eos_id: Optional[int] = None) -> Request:
-        if len(np.shape(prompt)) != 1 or len(prompt) > self.prefill_len:
+               eos_id: Optional[int] = None,
+               sampling: Optional[SamplingParams] = None) -> Request:
+        if len(np.shape(prompt)) != 1:
             raise ValueError(
-                f"prompt must be 1-D with at most prefill_len="
-                f"{self.prefill_len} tokens, got shape {np.shape(prompt)}")
-        req = self.scheduler.submit(prompt, max_new_tokens, eos_id)
+                f"prompt must be 1-D, got shape {np.shape(prompt)}")
+        req = self.scheduler.submit(prompt, max_new_tokens, eos_id,
+                                    sampling)
         timeline.emit("request_submit", rid=req.rid,
                       prompt_tokens=len(req.prompt),
                       max_new_tokens=max_new_tokens)
@@ -258,27 +315,48 @@ class ServingEngine:
     # ---------------------------------------------------------------- step
 
     def step(self) -> None:
-        """One engine tick: admit + prefill joiners, one decode step."""
+        """One engine tick: admit, advance prefill chunks, one decode
+        step."""
         if (self.guard is not None and self.guard.triggered
                 and not self.draining):
             self.drain()
         admitted = self.scheduler.admit()
         for req in admitted:
             timeline.emit("request_admit", rid=req.rid, slot=req.slot,
-                          blocks=len(req.blocks))
-        for row in self._pack_rows(admitted):
-            self._prefill_row(row)
+                          blocks=len(req.blocks),
+                          hit_blocks=req.hit_blocks)
+        self._prefill_tick()
         self._decode_once()
         self._steps += 1
         self.registry.gauge("serving/active_slots").set(
             len(self.scheduler.running()))
         self.registry.gauge("serving/free_blocks").set(
             self.scheduler.allocator.n_free)
+        self.registry.gauge("serving/kv_occupancy").set(
+            self.scheduler.kv_occupancy())
+        self._flush_occupancy_counters()
         # the beat lands only after this tick's device work materialized
         # — a wedged decode stops the beats and the monitor fires the
         # guard, turning a scheduler wedge into an ordinary drain
         if self.heartbeat is not None:
             self.heartbeat.beat(self._steps)
+
+    def _flush_occupancy_counters(self) -> None:
+        sched = self.scheduler
+        if sched.preemptions > self._counted_preempts:
+            self.registry.counter("serving/preemptions").inc(
+                sched.preemptions - self._counted_preempts)
+            self._counted_preempts = sched.preemptions
+        pc = sched.prefix_cache
+        if pc is not None:
+            if pc.hits > self._counted_hits:
+                self.registry.counter("serving/prefix_cache_hits").inc(
+                    pc.hits - self._counted_hits)
+                self._counted_hits = pc.hits
+            if pc.evictions > self._counted_evictions:
+                self.registry.counter("serving/evictions").inc(
+                    pc.evictions - self._counted_evictions)
+                self._counted_evictions = pc.evictions
 
     def run_until_drained(self, max_steps: int = 100_000) -> None:
         """Drive :meth:`step` until no request is waiting or running
@@ -291,57 +369,95 @@ class ServingEngine:
 
     # ------------------------------------------------------------- prefill
 
-    def _pack_rows(self, reqs: List[Request]) -> List[List[Request]]:
-        """First-fit pack admitted prompts into ``[1, prefill_len]``
-        rows — several requests prefill in one flash pass (segment ids
-        keep them from attending to each other)."""
-        rows: List[List[Request]] = []
-        fill = 0
-        for req in reqs:
-            n = len(req.prompt)
-            if not rows or fill + n > self.prefill_len:
-                rows.append([])
-                fill = 0
-            rows[-1].append(req)
-            fill += n
-        return rows
+    def _refresh_tables(self) -> None:
+        """Rebuild the slot -> physical-block table rows from the live
+        requests (preemption and growth both rewrite block lists; the
+        rebuild is max_batch * max_blocks ints — noise next to a device
+        step)."""
+        self._tables[:] = 0
+        for req in self.scheduler.running():
+            row = self._tables[req.slot]
+            row[:len(req.blocks)] = req.blocks
 
-    def _prefill_row(self, reqs: List[Request]) -> None:
-        L = self.prefill_len
+    def _sampling_arrays(self):
+        """Per-slot sampling-policy data ([max_batch] each, rebuilt per
+        call — policies are data, never shape)."""
+        B = self.serving.max_batch
+        temp = np.zeros((B,), np.float32)
+        top_k = np.zeros((B,), np.int32)
+        top_p = np.ones((B,), np.float32)
+        seeds = np.zeros((B,), np.uint32)
+        steps = np.zeros((B,), np.int32)
+        for req in self.scheduler.running():
+            s = req.sampling
+            temp[req.slot] = s.temperature
+            top_k[req.slot] = s.top_k
+            top_p[req.slot] = s.top_p
+            seeds[req.slot] = s.seed & 0xFFFFFFFF
+            steps[req.slot] = len(req.output_tokens)
+        return temp, top_k, top_p, seeds, steps
+
+    def _prefill_tick(self) -> None:
+        """Advance every prefilling slot by at most one chunk
+        (``prefill_len`` tokens) in ONE fixed-shape device call; slots
+        whose prompt completes this chunk sample their first token
+        in-graph."""
+        B, T = self.serving.max_batch, self.prefill_len
         bs = self.cache.block_size
-        tokens = np.zeros((1, L), np.int32)
-        pos_ids = np.zeros((1, L), np.int32)
-        seg_ids = np.zeros((1, L), np.int32)
-        dest_b = np.full((L,), self.cache.n_blocks, np.int32)  # OOB=dropped
-        dest_o = np.zeros((L,), np.int32)
-        last_index = {}
-        cursor = 0
-        for si, req in enumerate(reqs, start=1):
-            p = len(req.prompt)
-            sl = slice(cursor, cursor + p)
-            tokens[0, sl] = req.prompt
-            pos_ids[0, sl] = np.arange(p)
-            seg_ids[0, sl] = si
-            dest_b[sl] = [req.blocks[t // bs] for t in range(p)]
-            dest_o[sl] = [t % bs for t in range(p)]
-            last_index[req.rid] = cursor + p - 1
-            cursor += p
+        cands = sorted(
+            (r for r in self.scheduler.running() if r.prefilling),
+            key=lambda r: r.admit_seq)
+        plan: List[Tuple[Request, int]] = []
+        for req in cands:
+            if req.slot is None or not req.prefilling:
+                continue    # preempted by an older request's growth
+            chunk = min(req.prefill_target - req.cache_len, T)
+            covered = self.scheduler.try_grow_to(
+                req, req.cache_len + chunk)
+            chunk = min(chunk, covered - req.cache_len)
+            if chunk > 0:
+                plan.append((req, chunk))
+        if not plan:
+            return
 
-        k, v = self.arenas
-        with timeline.scope("prefill", rids=[r.rid for r in reqs],
-                            tokens=cursor):
-            k, v, next_tokens, _ = self._prefill(
-                k, v, self.params, tokens, pos_ids, seg_ids, dest_b, dest_o)
-            self.arenas = (k, v)
+        tokens = np.zeros((B, T), np.int32)
+        pos_ids = np.zeros((B, T), np.int32)
+        limits = np.zeros((B, T), np.int32)
+        lengths = np.zeros((B,), np.int32)
+        dest_b = np.full((B, T), self.cache.n_blocks, np.int32)  # OOB=drop
+        dest_o = np.zeros((B, T), np.int32)
+        sample_index = np.full((B,), T, np.int32)                # OOB=none
+        for req, chunk in plan:
+            s = req.slot
+            wire = req.sequence_tokens()
+            lo = req.cache_len
+            tokens[s, :chunk] = wire[lo:lo + chunk]
+            pos_ids[s, :chunk] = np.arange(lo, lo + chunk)
+            limits[s, :chunk] = np.arange(lo + 1, lo + chunk + 1)
+            lengths[s] = lo + chunk
+            dest_b[s, :chunk] = [req.blocks[(lo + t) // bs]
+                                 for t in range(chunk)]
+            dest_o[s, :chunk] = [(lo + t) % bs for t in range(chunk)]
+            if lo + chunk == req.prefill_target:
+                sample_index[s] = chunk - 1
+        self._refresh_tables()
+        samp = self._sampling_arrays()
+
+        with timeline.scope("prefill", rids=[r.rid for r, _ in plan],
+                            tokens=int(sum(c for _, c in plan))):
+            self.arenas, next_tokens, _ = self._prefill(
+                self.arenas, self.params, tokens, pos_ids,
+                self._jnp.asarray(self._tables), lengths, limits,
+                dest_b, dest_o, sample_index, *samp)
             next_np = np.asarray(next_tokens)
 
         now = time.monotonic()
-        for req in reqs:
-            req.cache_len = len(req.prompt)
-            row = self._tables[req.slot]
-            row[:] = 0
-            row[:len(req.blocks)] = req.blocks
-            self._emit(req, int(next_np[last_index[req.rid]]), now)
+        for req, chunk in plan:
+            self.scheduler.note_prefilled(req, chunk)
+            if not req.prefilling:
+                # prompt complete: the in-graph sample at its last
+                # prompt position is the request's next output token
+                self._emit(req, int(next_np[req.slot]), now)
 
     # -------------------------------------------------------------- decode
 
@@ -350,9 +466,21 @@ class ServingEngine:
         # a request at the context cap cannot write another token:
         # deliver what it has (truncation is a response, not a hang)
         for req in list(self.scheduler.running()):
-            if req.cache_len >= self.cache.max_seq:
+            if not req.prefilling and req.cache_len >= self.cache.max_seq:
                 self._finish(req)
-        reqs = self.scheduler.running()
+        # grow this tick's write blocks oldest-first (evict cached LRU,
+        # then preempt strictly newer requests); a newer request that
+        # cannot grow just sits this tick out — it keeps its cache
+        decoding = sorted(
+            (r for r in self.scheduler.running() if not r.prefilling),
+            key=lambda r: r.admit_seq)
+        reqs: List[Request] = []
+        for req in decoding:
+            if req.slot is None or req.state is not RequestState.RUNNING:
+                continue    # preempted by an older request's growth
+            covered = self.scheduler.try_grow_to(req, req.cache_len + 1)
+            if covered >= req.cache_len + 1:
+                reqs.append(req)
         if not reqs:
             return
         tokens = np.zeros((B, 1), np.int32)
@@ -362,21 +490,21 @@ class ServingEngine:
             tokens[req.slot, 0] = req.last_token
             positions[req.slot] = req.cache_len
             active[req.slot] = True
+        self._refresh_tables()
+        samp = self._sampling_arrays()
 
-        k, v = self.arenas
         tables = self._jnp.asarray(self._tables)
+        args = (self.arenas, self.params, tokens, positions, tables,
+                active) + samp
         if not self._flops_probed:
             # One-time FLOPs probe for the MFU gauge: lowering traces
             # the decode body (no second XLA compile, no execution —
             # the arenas are not donated by a trace) and the HLO cost
             # pass reports the program's FLOPs.  Must happen BEFORE the
             # call below consumes the donated arenas.
-            self._probe_decode_flops(
-                (k, v, self.params, tokens, positions, tables, active))
+            self._probe_decode_flops(args)
         t0 = time.perf_counter()
-        k, v, next_tokens, _ = self._decode(
-            k, v, self.params, tokens, positions, tables, active)
-        self.arenas = (k, v)
+        self.arenas, next_tokens, _ = self._decode(*args)
         next_np = np.asarray(next_tokens)
         self._last_decode_s = time.perf_counter() - t0
         self._refresh_mfu()
@@ -429,15 +557,25 @@ class ServingEngine:
         """Live engine state for ``/statusz`` (read-only snapshot; the
         :class:`~apex_tpu.observability.debug_server.DebugServer`
         duck-types this)."""
+        sched = self.scheduler
+        pc = sched.prefix_cache
         return {
             "steps": self._steps,
-            "active_slots": len(self.scheduler.running()),
-            "free_slots": len(self.scheduler.free_slots()),
-            "free_blocks": self.scheduler.allocator.n_free,
-            "total_blocks": self.scheduler.allocator.n_blocks,
-            "queue_depth": len(self.scheduler.waiting),
+            "active_slots": len(sched.running()),
+            "free_slots": len(sched.free_slots()),
+            "free_blocks": sched.allocator.n_free,
+            "total_blocks": sched.allocator.n_blocks,
+            "queue_depth": len(sched.waiting),
             "draining": self.draining,
             "decode_compiles": self.decode_compile_count(),
+            "admission": sched.admission,
+            "kv_occupancy": round(sched.kv_occupancy(), 4),
+            "prefix_cached_blocks": (pc.n_blocks if pc is not None
+                                     else None),
+            "prefix_cache_hits": (pc.hits if pc is not None else None),
+            "evictions": (pc.evictions if pc is not None else None),
+            "preemptions": sched.preemptions,
+            "cache_dtype": str(np.dtype(self.cache.dtype)),
             "last_decode_ms": (round(self._last_decode_s * 1e3, 3)
                                if self._last_decode_s is not None else None),
             "mfu": self.mfu,
@@ -453,7 +591,7 @@ class ServingEngine:
             self.registry.histogram(
                 "serving/ttft_ms", keep_samples=4096).observe(
                     (now - req.t_submit) * 1e3)
-        else:
+        elif req.t_last_token is not None:
             self.registry.histogram(
                 "serving/tpot_ms", keep_samples=65536).observe(
                     (now - req.t_last_token) * 1e3)

@@ -12,15 +12,37 @@ The vLLM paging model mapped onto the repo's sharded-state conventions:
   (``jax.jit(..., donate_argnums=...)``) so XLA updates it in place: a
   non-donated cache would double the single largest HBM tenant of a
   serving chip (analyzer entry ``serving_decode``, rule APX204, audits
-  exactly this).
+  exactly this).  With an **int8** cache (``cache_dtype=jnp.int8``) a
+  pair of fp32 *scale arenas* ``[n_layers, n_blocks, block_size,
+  kv_heads]`` rides along — one symmetric scale per cached K/V vector,
+  stored block-major beside its block (1/``head_dim`` of the cache's
+  own footprint) and dequantized inside the paged-attention kernel.
 - **Host side** — :class:`BlockAllocator`: a free list of physical
-  block ids with ownership tracking.  Allocation is O(1) per block and
-  *fragmentation-free by construction*: blocks are fixed-size and any
-  free block can serve any request, so the only admission question is
-  ``n_free >= blocks_needed`` — never "is there a contiguous run".
-  Invariants (every block is free XOR owned by exactly one request;
-  double-free and foreign-free raise) are checked by
-  :meth:`BlockAllocator.check` and pinned in ``tests/test_serving.py``.
+  block ids with **refcounted** ownership.  Allocation is O(1) per
+  block and *fragmentation-free by construction*: blocks are fixed-size
+  and any free block can serve any request, so the only admission
+  question is ``n_free >= blocks_needed`` — never "is there a
+  contiguous run".  Copy-on-write prefix sharing rides on the
+  refcounts: :meth:`BlockAllocator.share` adds a holder to a live
+  block, and :meth:`BlockAllocator.free` *decrements* — the block
+  returns to the pool only when its last holder lets go.  (Writes never
+  target shared blocks in this engine: prefix hits are block-aligned
+  and always leave >= 1 prompt token to recompute, so the private tail
+  a request appends into starts past every shared block — the copy
+  step of classic CoW is unreachable by construction, and the
+  refcounts ARE the invariant.)  Invariants (every block is free XOR
+  held by >= 1 owner; double-free and foreign-free raise) are checked
+  by :meth:`BlockAllocator.check` and pinned in ``tests/test_serving.py``.
+- :class:`PrefixCache` — the token-hash index over shared blocks.  A
+  full block of a request's sequence is keyed by the *chain hash* of
+  every token up to and including that block, so a lookup walks
+  block-sized strides of a new prompt and shares the longest cached
+  prefix (capped so at least one token is always recomputed — the
+  recompute produces the first sampled token, and it keeps writes off
+  shared blocks).  Entries hold their own refcount on the block; a
+  finished request's blocks therefore survive it *as cache*, and the
+  eviction sweep (:meth:`PrefixCache.evict_one`, LRU) is what finally
+  returns them to the free list when the pool runs dry.
 
 The per-request *block table* (logical block index -> physical block
 id) lives with the scheduler's request records; the engine packs the
@@ -31,8 +53,9 @@ any shape, which is what keeps the decode step compile-stable.
 
 from __future__ import annotations
 
+import collections
 import dataclasses
-from typing import Any, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
 
 import numpy as np
 
@@ -40,18 +63,27 @@ __all__ = [
     "KVCacheConfig",
     "BlockAllocator",
     "OutOfBlocksError",
+    "PrefixCache",
+    "CACHE_OWNER",
     "init_kv_arena",
     "arena_partition_spec",
+    "scale_partition_spec",
 ]
+
+# the PrefixCache's own hold on a shared block (distinct from any
+# request id, so foreign-free checks see the cache as just another
+# owner — freeing a cached block with a request's id raises)
+CACHE_OWNER = "<prefix-cache>"
 
 
 class OutOfBlocksError(RuntimeError):
     """The arena cannot serve the requested number of blocks.
 
     Admission control is expected to check :meth:`BlockAllocator.can_alloc`
-    first; hitting this during a decode append means the operator sized
-    ``n_blocks`` below ``max_batch * max_blocks_per_request``.
-    """
+    first; hitting this during a decode append means the scheduler's
+    grow path (evict, then preempt) failed to raise ``n_free`` — a bug,
+    since the submit-time whole-pool check guarantees any single
+    request fits."""
 
 
 @dataclasses.dataclass(frozen=True)
@@ -62,6 +94,8 @@ class KVCacheConfig:
     of the served model); under tensor parallelism each rank holds
     ``kv_heads / tp`` of them.  ``max_seq`` rounds up to whole blocks;
     ``max_blocks_per_request`` is the per-request block-table width.
+    ``dtype`` is the arena storage dtype; ``int8`` additionally
+    allocates the per-vector scale arenas (:attr:`quantized`).
     """
 
     n_layers: int
@@ -81,6 +115,11 @@ class KVCacheConfig:
             raise ValueError(f"max_seq must be positive, got {self.max_seq}")
 
     @property
+    def quantized(self) -> bool:
+        """True when the arena stores int8 (scale arenas ride along)."""
+        return np.dtype(self.dtype) == np.dtype(np.int8)
+
+    @property
     def max_blocks_per_request(self) -> int:
         return -(-self.max_seq // self.block_size)
 
@@ -96,22 +135,48 @@ def arena_partition_spec(tp_axis: Optional[str]):
     return P(None, None, None, tp_axis, None)
 
 
-def init_kv_arena(cfg: KVCacheConfig, mesh=None, tp_axis: Optional[str] = "tp"
-                  ) -> Tuple[Any, Any]:
-    """Allocate the zeroed ``(k, v)`` arenas as sharded global arrays.
+def scale_partition_spec(tp_axis: Optional[str]):
+    """PartitionSpec of one int8 scale arena
+    ``[n_layers, n_blocks, block_size, kv_heads]`` — the same heads
+    chop as the arena it scales (a rank dequantizes only rows it owns)."""
+    from jax.sharding import PartitionSpec as P
 
-    Shape ``[n_layers, n_blocks, block_size, kv_heads, head_dim]``,
-    heads sharded over ``tp_axis`` when a mesh is given (the same axis
-    the attention heads are column-parallel over, so the cache rows a
-    rank reads in the paged kernel are exactly the rows it owns).
+    if tp_axis is None:
+        return P()
+    return P(None, None, None, tp_axis)
+
+
+def init_kv_arena(cfg: KVCacheConfig, mesh=None, tp_axis: Optional[str] = "tp"
+                  ) -> Tuple[Any, ...]:
+    """Allocate the zeroed arenas as sharded global arrays.
+
+    Returns ``(k, v)`` — or ``(k, v, k_scales, v_scales)`` for an int8
+    cache — with shape ``[n_layers, n_blocks, block_size, kv_heads,
+    head_dim]`` (scales drop the trailing ``head_dim``), heads sharded
+    over ``tp_axis`` when a mesh is given (the same axis the attention
+    heads are column-parallel over, so the cache rows a rank reads in
+    the paged kernel are exactly the rows it owns).
     """
     import jax
     import jax.numpy as jnp
 
     shape = (cfg.n_layers, cfg.n_blocks, cfg.block_size, cfg.kv_heads,
              cfg.head_dim)
-    k = jnp.zeros(shape, cfg.dtype)
-    v = jnp.zeros(shape, cfg.dtype)
+    arenas = [jnp.zeros(shape, cfg.dtype), jnp.zeros(shape, cfg.dtype)]
+    specs = [arena_partition_spec(tp_axis)] * 2
+    if cfg.quantized:
+        sshape = shape[:-1]
+        # on a size-1 tp axis the scale placement is spelled replicated
+        # (P() — what jit emits for the step outputs there, so the
+        # engine's arena round trip stays jit-cache-stable; at tp > 1
+        # the named spec round-trips intact either way)
+        s_axis = tp_axis
+        if mesh is not None and s_axis is not None \
+                and mesh.shape[s_axis] == 1:
+            s_axis = None
+        arenas += [jnp.ones(sshape, jnp.float32),
+                   jnp.ones(sshape, jnp.float32)]
+        specs += [scale_partition_spec(s_axis)] * 2
     if mesh is not None and tp_axis is not None:
         from jax.sharding import NamedSharding
 
@@ -119,19 +184,22 @@ def init_kv_arena(cfg: KVCacheConfig, mesh=None, tp_axis: Optional[str] = "tp"
             raise ValueError(
                 f"kv_heads ({cfg.kv_heads}) not divisible by tp "
                 f"({mesh.shape[tp_axis]})")
-        sharding = NamedSharding(mesh, arena_partition_spec(tp_axis))
-        k = jax.device_put(k, sharding)
-        v = jax.device_put(v, sharding)
-    return k, v
+        arenas = [jax.device_put(a, NamedSharding(mesh, s))
+                  for a, s in zip(arenas, specs)]
+    return tuple(arenas)
 
 
 class BlockAllocator:
-    """Free-list allocator over the physical block pool.
+    """Refcounted free-list allocator over the physical block pool.
 
     LIFO free list (recently-freed blocks are reused first — their HBM
-    pages are the warmest) plus an ownership map for invariant checking.
-    NOT thread-safe: the scheduler owns it from one thread, matching the
-    engine's single-threaded step loop.
+    pages are the warmest) plus a per-block holder set: a block is free
+    XOR held by one or more owners (a request id, or the prefix cache's
+    :data:`CACHE_OWNER`).  :meth:`share` is the copy-on-write incref —
+    a prefix hit adds the hitting request as a holder; :meth:`free` is
+    the decref — the block returns to the pool only when the last
+    holder releases it.  NOT thread-safe: the scheduler owns it from
+    one thread, matching the engine's single-threaded step loop.
     """
 
     def __init__(self, n_blocks: int):
@@ -139,7 +207,7 @@ class BlockAllocator:
             raise ValueError(f"n_blocks must be positive, got {n_blocks}")
         self.n_blocks = n_blocks
         self._free: List[int] = list(range(n_blocks - 1, -1, -1))
-        self._owner: Dict[int, Any] = {}
+        self._holders: Dict[int, Set[Any]] = {}
 
     @property
     def n_free(self) -> int:
@@ -147,14 +215,20 @@ class BlockAllocator:
 
     @property
     def n_owned(self) -> int:
-        return len(self._owner)
+        """Blocks with at least one holder (shared blocks count once)."""
+        return len(self._holders)
+
+    def refcount(self, block: int) -> int:
+        """Holder count of ``block`` (0 = free)."""
+        return len(self._holders.get(block, ()))
 
     def can_alloc(self, n: int) -> bool:
         return n <= len(self._free)
 
     def alloc(self, n: int, owner: Any = None) -> List[int]:
-        """Take ``n`` blocks for ``owner``; raises :class:`OutOfBlocksError`
-        (allocating nothing) when fewer than ``n`` are free."""
+        """Take ``n`` fresh (refcount-1) blocks for ``owner``; raises
+        :class:`OutOfBlocksError` (allocating nothing) when fewer than
+        ``n`` are free."""
         if n < 0:
             raise ValueError(f"cannot allocate {n} blocks")
         if n > len(self._free):
@@ -163,36 +237,208 @@ class BlockAllocator:
                 f"{self.n_blocks} free")
         blocks = [self._free.pop() for _ in range(n)]
         for b in blocks:
-            self._owner[b] = owner
+            self._holders[b] = {owner}
         return blocks
 
+    def share(self, block: int, owner: Any) -> None:
+        """Copy-on-write incref: add ``owner`` as a holder of a live
+        block (a prefix-cache hit, or the cache registering a freshly
+        prefilled block).  Sharing a free block or double-sharing by
+        the same owner raises — both would corrupt the refcount."""
+        holders = self._holders.get(block)
+        if holders is None:
+            raise ValueError(f"cannot share free block {block}")
+        if owner in holders:
+            raise ValueError(
+                f"owner {owner!r} already holds block {block}")
+        holders.add(owner)
+
     def free(self, blocks: Sequence[int], owner: Any = None) -> None:
-        """Return blocks to the pool.  A block that is already free
-        (double free) or owned by someone else raises — silently
-        recycling a live request's cache rows is the worst failure mode
-        a paged cache has."""
+        """Release ``owner``'s hold on each block.  A shared block
+        merely *decrements* (the other holders — the prefix cache, a
+        sibling request — keep it live); the last release returns it to
+        the pool.  A block that is already free (double free) or not
+        held by ``owner`` (foreign free) raises — silently recycling a
+        live request's cache rows is the worst failure mode a paged
+        cache has."""
         for b in blocks:
-            if b not in self._owner:
+            holders = self._holders.get(b)
+            if holders is None:
                 raise ValueError(f"double free of block {b}")
-            if self._owner[b] != owner:
+            if owner not in holders:
                 raise ValueError(
-                    f"block {b} owned by {self._owner[b]!r}, freed by "
-                    f"{owner!r}")
+                    f"block {b} owned by {sorted(map(repr, holders))}, "
+                    f"freed by {owner!r}")
         for b in blocks:
-            del self._owner[b]
-            self._free.append(b)
+            holders = self._holders[b]
+            holders.discard(owner)
+            if not holders:
+                del self._holders[b]
+                self._free.append(b)
 
     def check(self) -> None:
-        """Assert the pool invariant: free and owned partition the pool
-        (no leak, no double ownership, no phantom ids)."""
+        """Assert the pool invariant: free and held partition the pool
+        (no leak, no double ownership, no phantom ids, no empty holder
+        sets)."""
         free = set(self._free)
-        owned = set(self._owner)
+        held = set(self._holders)
         if len(free) != len(self._free):
             raise AssertionError("duplicate ids on the free list")
-        if free & owned:
+        if free & held:
             raise AssertionError(
-                f"blocks both free and owned: {sorted(free & owned)}")
-        if free | owned != set(range(self.n_blocks)):
+                f"blocks both free and held: {sorted(free & held)}")
+        if free | held != set(range(self.n_blocks)):
             raise AssertionError(
-                f"pool leak: {self.n_blocks - len(free) - len(owned)} "
-                "blocks neither free nor owned")
+                f"pool leak: {self.n_blocks - len(free) - len(held)} "
+                "blocks neither free nor held")
+        empties = [b for b, h in self._holders.items() if not h]
+        if empties:
+            raise AssertionError(f"held blocks with no holders: {empties}")
+
+
+class PrefixCache:
+    """Token-hash index of shareable full blocks (copy-on-write prefix
+    caching).
+
+    Each entry maps the *chain hash* of a sequence's first
+    ``(i + 1) * block_size`` tokens to the physical block holding
+    tokens ``[i * block_size, (i + 1) * block_size)`` of that sequence.
+    The chain construction means a lookup needs no trie: walk the new
+    prompt block by block, rehashing cumulatively, and stop at the
+    first miss — every hit is automatically content- AND
+    position-consistent with the whole prefix before it.
+
+    The cache holds its own refcount (:data:`CACHE_OWNER`) on every
+    indexed block, which is what lets blocks outlive the request that
+    wrote them.  ``evict_one`` frees the least-recently-used entry
+    whose block the cache is the *sole* holder of — evicting a block a
+    live request still shares would free no capacity and lose a hot
+    prefix, so such entries are skipped (they re-enter the evictable
+    set when their last sharer finishes).
+    """
+
+    def __init__(self, allocator: BlockAllocator, block_size: int):
+        self.allocator = allocator
+        self.block_size = block_size
+        # insertion/touch order == LRU order (move_to_end on every hit)
+        self._entries: "collections.OrderedDict[int, int]" = \
+            collections.OrderedDict()
+        self.hits = 0            # blocks served from cache (lifetime)
+        self.evictions = 0       # entries evicted for capacity (lifetime)
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    @property
+    def n_blocks(self) -> int:
+        return len(self._entries)
+
+    def _block_hash(self, prev_hash: int, tokens: Sequence[int],
+                    i: int) -> int:
+        """Chain hash of full block ``i`` given the previous block's."""
+        chunk = tuple(int(t) for t in
+                      tokens[i * self.block_size:(i + 1) * self.block_size])
+        return hash((prev_hash, chunk))
+
+    def lookup(self, tokens: Sequence[int], owner: Any,
+               *, max_blocks: Optional[int] = None) -> List[int]:
+        """Share the longest cached prefix of ``tokens`` with ``owner``.
+
+        Walks full blocks, hashing incrementally and stopping at the
+        first miss (O(hit) host work, never O(prompt)).  The cap is
+        ENFORCED here, not trusted to callers: at most
+        ``(len(tokens) - 1) // block_size`` blocks are ever shared, so
+        at least one token is always left to recompute — the recompute
+        yields the request's next sampled token, and it keeps every
+        write on private blocks (the invariant the whole CoW design
+        rests on; a block-aligned prompt fully served from cache would
+        otherwise append into a shared block).  ``max_blocks`` can only
+        tighten it.  Returns the shared physical blocks in prefix
+        order; the caller owns a refcount on each (released through
+        the ordinary ``free``)."""
+        shared: List[int] = []
+        cap = (len(tokens) - 1) // self.block_size
+        if max_blocks is not None:
+            cap = min(cap, max_blocks)
+        h = 0
+        for i in range(cap):
+            h = self._block_hash(h, tokens, i)
+            block = self._entries.get(h)
+            if block is None:
+                break
+            self.allocator.share(block, owner)
+            self._entries.move_to_end(h)
+            shared.append(block)
+        self.hits += len(shared)
+        return shared
+
+    def insert(self, tokens: Sequence[int], blocks: Sequence[int],
+               upto_tokens: int, *, start_block: int = 0,
+               prev_hash: int = 0) -> int:
+        """Index the full blocks of ``tokens[:upto_tokens]`` (the part
+        whose K/V is already *written* to the arena — indexing a block
+        whose content has not landed would let a same-tick hit read
+        garbage).  Already-indexed keys are skipped: the first physical
+        copy of a prefix wins and duplicates free normally with their
+        writer.
+
+        ``start_block``/``prev_hash`` resume the chain where a previous
+        call stopped (the scheduler threads them through the request,
+        so a prompt advanced chunk by chunk hashes each block ONCE per
+        admission instead of re-hashing the whole prefix per chunk).
+        Returns the chain hash after the last indexed block — the next
+        call's ``prev_hash``."""
+        n_full = min(upto_tokens // self.block_size, len(blocks))
+        h = prev_hash
+        for i in range(start_block, n_full):
+            h = self._block_hash(h, tokens, i)
+            if h in self._entries:
+                continue
+            self.allocator.share(blocks[i], CACHE_OWNER)
+            self._entries[h] = blocks[i]
+        return h
+
+    def evictable(self) -> int:
+        """Blocks an eviction sweep could return to the pool right now
+        (cache is the sole holder)."""
+        return sum(1 for b in self._entries.values()
+                   if self.allocator.refcount(b) == 1)
+
+    def evict_many(self, n: int) -> int:
+        """Free up to ``n`` LRU sole-holder entries in ONE sweep;
+        returns how many blocks went back to the pool.  Entries still
+        shared with a live request are skipped (evicting them would
+        free no capacity and lose a hot prefix) — and skipped once,
+        not once per needed block: the scheduler asks for its whole
+        deficit at a time, so pool pressure costs one pass over the
+        pinned prefix, not ``n``."""
+        freed = 0
+        for key in list(self._entries):
+            if freed >= n:
+                break
+            block = self._entries[key]
+            if self.allocator.refcount(block) == 1:
+                del self._entries[key]
+                self.allocator.free([block], owner=CACHE_OWNER)
+                self.evictions += 1
+                freed += 1
+        return freed
+
+    def evict_one(self) -> Optional[int]:
+        """Free the LRU sole-holder entry; returns its block id, or
+        ``None`` when nothing is evictable (every cached block is
+        shared with a live request, or the cache is empty)."""
+        for key, block in self._entries.items():
+            if self.allocator.refcount(block) == 1:
+                del self._entries[key]
+                self.allocator.free([block], owner=CACHE_OWNER)
+                self.evictions += 1
+                return block
+        return None
+
+    def check(self) -> None:
+        """Every indexed block must be live and held by the cache."""
+        for key, block in self._entries.items():
+            if self.allocator.refcount(block) < 1:
+                raise AssertionError(
+                    f"cache entry {key} indexes free block {block}")

@@ -8,31 +8,39 @@ tensor-parallel modules (``ColumnParallelLinear``/``RowParallelLinear``
 ``FusedLayerNorm``) and the same RoPE tables — but driven through two
 inference-shaped entry points instead of a loss:
 
-- :meth:`DecodeModel.prefill` — one **packed row** ``[1, L]`` holding
-  one or more requests' prompts back to back (host-built segment ids,
-  position ids, and per-token cache destinations).  Attention is the
-  PR 2 flash kernel with ``segment_ids`` — packed multi-request prefill
-  falls out of the varlen mechanism for free — and each layer's K/V
-  are scattered into the paged arena at host-precomputed
-  ``(block, offset)`` destinations.
+- :meth:`DecodeModel.prefill` — **batched chunked prefill**: a fixed
+  ``[max_batch, chunk]`` slice of tokens, one chunk per slot, scattered
+  into the paged arena at host-precomputed ``(block, offset)``
+  destinations and attended with the chunked-prefill paged kernel
+  (:func:`~apex_tpu.serving.paged_attention.paged_prefill_attention`):
+  each token's per-token causal ``limit`` covers the request's whole
+  cached context — prior chunks, shared prefix-cache blocks, and the
+  in-chunk causal triangle — in ONE block sweep, which is what makes a
+  long prompt sliceable across decode ticks (it never stalls a tick)
+  and a prefix-cache hit a pure block-table entry.
 - :meth:`DecodeModel.decode_step` — the jit-stable continuous-batching
   step: fixed ``[max_batch, 1]`` tokens, per-slot positions/tables and
   an active mask; inactive slots are pure data (their cache writes are
   routed out of range and dropped; their attention length is 0), so
-  requests joining/leaving never change a shape and the step **never
-  recompiles**.  Attention over the cache is the fused Pallas
-  paged-attention kernel (:mod:`.paged_attention`), and the
-  residual/norm tail of each block can run as the fused epilogue
-  kernel (:mod:`.fused_ops`) — both A/B-able against their unfused XLA
-  lowerings via the constructor flags.
+  requests joining/leaving/preempting never change a shape and the
+  step **never recompiles**.
+
+Both entry points **sample in-graph** (:mod:`.sampling`): per-slot
+temperature/top-k/top-p/seed/step ride as ``[max_batch]`` data, the
+vocab-sharded logits are gathered over tp before the draw, and the host
+round-trips one int per slot per step, not a logits tensor.  Greedy
+(``temperature == 0``) stays the exact argmax every token-identity
+contract rests on.
+
+With an **int8 cache** the K/V rows are quantized on write (one
+symmetric fp32 scale per row, computed in-graph) and dequantized inside
+the paged kernels — the arenas argument widens to
+``(k, v, k_scales, v_scales)`` and everything else is unchanged.
 
 Both entry points are **shard_map bodies**: run them under
 ``collectives.shard_over`` with the tensor axis bound (the engine does
 this) — the parallel linears then shard exactly as in training, and
-the K/V arena rows a rank touches are the heads it owns.  Greedy
-next-token ids are computed inside (vocab-sharded logits are gathered
-over tp before the argmax), so the host round-trips one int per slot
-per step, not a logits tensor.
+the K/V arena rows a rank touches are the heads it owns.
 """
 
 from __future__ import annotations
@@ -50,11 +58,14 @@ from apex_tpu.serving.kv_cache import KVCacheConfig
 from apex_tpu.serving.paged_attention import (
     paged_attention_decode,
     paged_attention_decode_unfused,
+    paged_prefill_attention,
+    paged_prefill_attention_unfused,
 )
+from apex_tpu.serving.sampling import sample_tokens
 from apex_tpu.transformer.layers.layer_norm import FusedLayerNorm
 from apex_tpu.transformer.rope import (
-    apply_rotary,
     apply_rotary_decode,
+    apply_rotary_packed,
     rotary_cos_sin,
 )
 from apex_tpu.transformer.tensor_parallel import (
@@ -92,6 +103,17 @@ def serving_config(config: TransformerConfig) -> TransformerConfig:
         config, hidden_dropout=0.0, attention_dropout=0.0,
         sequence_parallel=False, overlap_comm=False, context_axis=None,
         fp8=False)
+
+
+def _quantize_rows(x):
+    """Symmetric int8 row quantization: ``x [..., d]`` -> (int8 values,
+    fp32 per-row scales ``[...]``).  ``amax / 127`` with an epsilon
+    floor so an all-zero row round-trips to exact zeros."""
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1)
+    scales = jnp.maximum(amax / 127.0, 1e-8).astype(jnp.float32)
+    q = jnp.clip(jnp.round(xf / scales[..., None]), -127.0, 127.0)
+    return q.astype(jnp.int8), scales
 
 
 class DecodeModel:
@@ -148,20 +170,53 @@ class DecodeModel:
         v = qkv[..., (self.hpg + 1) * d:]
         return q, k, v
 
-    def _layer_stack(self, params, x, k_arena, v_arena, attn_core, rope_fn):
+    def _append_rows(self, layer_arenas, dest_blocks, dest_offsets, k, v):
+        """Scatter K/V rows into one layer's arena slice at
+        ``(block, offset)`` destinations (out-of-range = dropped —
+        inactive slots and padding route there), quantizing on write
+        for the int8 cache (the per-row scales land beside the rows,
+        through the same dropped-scatter indices)."""
+        if self.cache.quantized:
+            k_layer, v_layer, ks_layer, vs_layer = layer_arenas
+            qk, sk = _quantize_rows(k)
+            qv, sv = _quantize_rows(v)
+            k_layer = k_layer.at[dest_blocks, dest_offsets].set(
+                qk, mode="drop")
+            v_layer = v_layer.at[dest_blocks, dest_offsets].set(
+                qv, mode="drop")
+            ks_layer = ks_layer.at[dest_blocks, dest_offsets].set(
+                sk, mode="drop")
+            vs_layer = vs_layer.at[dest_blocks, dest_offsets].set(
+                sv, mode="drop")
+            return (k_layer, v_layer, ks_layer, vs_layer)
+        k_layer, v_layer = layer_arenas
+        k_layer = k_layer.at[dest_blocks, dest_offsets].set(
+            k.astype(k_layer.dtype), mode="drop")
+        v_layer = v_layer.at[dest_blocks, dest_offsets].set(
+            v.astype(v_layer.dtype), mode="drop")
+        return (k_layer, v_layer)
+
+    def _attend_kwargs(self, layer_arenas):
+        """(k, v[, scale kwargs]) of one layer slice for the kernels."""
+        if self.cache.quantized:
+            k_layer, v_layer, ks_layer, vs_layer = layer_arenas
+            return (k_layer, v_layer), dict(k_scales=ks_layer,
+                                            v_scales=vs_layer)
+        return layer_arenas, {}
+
+    def _layer_stack(self, params, x, arenas, attn_core):
         """Scan the ``[L, ...]`` layer stack; each step consumes its own
-        arena slice and emits the updated one (the scan re-stacks them,
-        which XLA aliases into the donated input arena)."""
+        arena slices and emits the updated ones (the scan re-stacks
+        them, which XLA aliases into the donated input arenas)."""
 
         def body(carry, xs):
             x = carry
-            lp, k_layer, v_layer = xs
+            lp, layer_arenas = xs[0], xs[1:]
             ln1 = self.ln.apply({"params": lp["input_layernorm"]}, x)
             qkv = self.qkv.apply(
                 {"params": lp["self_attention"]["query_key_value"]}, ln1)
             q, k, v = self._split_qkv(qkv)
-            q, k = rope_fn(q, k)
-            ctx, k_layer, v_layer = attn_core(q, k, v, k_layer, v_layer)
+            ctx, layer_arenas = attn_core(q, k, v, layer_arenas)
             y, y_bias = self.dense.apply(
                 {"params": lp["self_attention"]["dense"]}, ctx)
             ln2 = lp["post_attention_layernorm"]
@@ -174,18 +229,17 @@ class DecodeModel:
                     y, x, ln2["scale"], ln2["bias"], bias=y_bias,
                     eps=self.cfg.layernorm_epsilon)
             m, m_bias = self.mlp.apply({"params": lp["mlp"]}, ln2_out)
-            return h + m + m_bias, (k_layer, v_layer)
+            return h + m + m_bias, layer_arenas
 
-        x, (k_arena, v_arena) = lax.scan(
-            body, x, (params.layers, k_arena, v_arena))
-        return x, k_arena, v_arena
+        x, arenas = lax.scan(body, x, (params.layers,) + tuple(arenas))
+        return x, arenas
 
     def _head(self, params, x):
-        """Final LN + tied LM head + tp-gathered greedy argmax.
+        """Final LN + tied LM head, vocab gathered over tp.
 
-        Returns ``(next_tokens [s, b], logits [s, b, vocab])`` with the
-        FULL vocab (gathered over tp so the argmax — and the host —
-        see one consistent id space)."""
+        Returns ``logits [s, b, vocab]`` with the FULL vocab (gathered
+        so the in-graph sampler — and the host — see one consistent id
+        space)."""
         cfg = self.cfg
         hidden = self.ln.apply({"params": params.final_ln}, x)
         logits = parallel_lm_logits(
@@ -193,7 +247,7 @@ class DecodeModel:
         if cfg.tensor_axis is not None \
                 and cc.bound_axis_size(cfg.tensor_axis) > 1:
             logits = cc.all_gather(logits, cfg.tensor_axis, concat_axis=-1)
-        return jnp.argmax(logits, axis=-1).astype(jnp.int32), logits
+        return logits
 
     def _rope_tables(self, positions, dtype):
         cfg = self.cfg
@@ -204,16 +258,20 @@ class DecodeModel:
 
     # ---------------------------------------------------------------- entry
 
-    def decode_step(self, k_arena, v_arena, params, tokens, positions,
-                    block_tables, active):
-        """One continuously-batched greedy decode step (shard_map body).
+    def decode_step(self, arenas, params, tokens, positions, block_tables,
+                    active, temperature, top_k, top_p, seeds, steps):
+        """One continuously-batched decode step (shard_map body).
 
+        ``arenas`` — ``(k, v)`` or ``(k, v, k_scales, v_scales)``;
         ``tokens [max_batch, 1]`` (each slot's last sampled/prompt
         token), ``positions [max_batch]`` (the cache index this token
         is written at — the slot's current length), ``block_tables
-        [max_batch, max_blocks]``, ``active [max_batch]`` bool.  Every
-        shape is fixed by the engine config; request churn only changes
-        values.  Returns ``(k_arena, v_arena, next_tokens [max_batch],
+        [max_batch, max_blocks]``, ``active [max_batch]`` bool, and the
+        ``[max_batch]`` sampling-policy arrays (:mod:`.sampling` —
+        ``steps`` is each slot's output-token counter, the seed
+        fold-in).  Every shape is fixed by the engine config; request
+        churn, preemption, eviction and policy changes only move
+        values.  Returns ``(arenas, next_tokens [max_batch],
         logits [max_batch, vocab])``.
         """
         cfg = self.cfg
@@ -238,51 +296,55 @@ class DecodeModel:
         # x: [1, max_batch, hidden]
         rope = self._rope_tables(positions, x.dtype)
 
-        def rope_fn(q, k):
-            if rope is None:
-                return q, k
-            cos, sin = rope
-            return (apply_rotary_decode(q, cos, sin),
-                    apply_rotary_decode(k, cos, sin))
-
         attend = (paged_attention_decode if self.fused_attention
                   else paged_attention_decode_unfused)
 
-        def attn_core(q, k, v, k_layer, v_layer):
+        def attn_core(q, k, v, layer_arenas):
+            if rope is not None:
+                cos, sin = rope
+                q = apply_rotary_decode(q, cos, sin)
+                k = apply_rotary_decode(k, cos, sin)
             # append this token's K/V, then attend over the paged cache
-            k_layer = k_layer.at[phys, offs].set(
-                k[0].astype(k_layer.dtype), mode="drop")
-            v_layer = v_layer.at[phys, offs].set(
-                v[0].astype(v_layer.dtype), mode="drop")
-            ctx = attend(q[0], k_layer, v_layer, block_tables, lengths)
-            return ctx.reshape(1, b, -1).astype(q.dtype), k_layer, v_layer
+            layer_arenas = self._append_rows(
+                layer_arenas, phys, offs, k[0], v[0])
+            kv, sc = self._attend_kwargs(layer_arenas)
+            ctx = attend(q[0], *kv, block_tables, lengths, **sc)
+            return ctx.reshape(1, b, -1).astype(q.dtype), layer_arenas
 
-        x, k_arena, v_arena = self._layer_stack(
-            params, x, k_arena, v_arena, attn_core, rope_fn)
-        next_tokens, logits = self._head(params, x)
-        return k_arena, v_arena, next_tokens[0], logits[0]
+        x, arenas = self._layer_stack(params, x, arenas, attn_core)
+        logits = self._head(params, x)[0]          # [max_batch, vocab]
+        sampled = sample_tokens(logits, temperature, top_k, top_p,
+                                seeds, steps)
+        next_tokens = jnp.where(active, sampled, 0).astype(jnp.int32)
+        return arenas, next_tokens, logits
 
-    def prefill(self, k_arena, v_arena, params, tokens, position_ids,
-                segment_ids, dest_blocks, dest_offsets):
-        """Packed multi-request prefill of one ``[1, L]`` row
+    def prefill(self, arenas, params, tokens, position_ids, block_tables,
+                lengths, limits, dest_blocks, dest_offsets, sample_index,
+                temperature, top_k, top_p, seeds, steps):
+        """Batched chunked prefill of one ``[max_batch, chunk]`` slice
         (shard_map body).
 
-        ``position_ids [1, L]`` — each token's position *within its
-        request* (restarting per segment; also the RoPE angle source,
-        so packing composes with rope); ``segment_ids [1, L]`` — 1-based
-        request ids, 0 = padding (the flash-attention varlen mechanism:
-        causal ∧ same-segment = per-request causal attention);
-        ``dest_blocks/dest_offsets [L]`` — each token's physical cache
-        destination (out-of-range = dropped, used for padding).
-        Returns ``(k_arena, v_arena, next_tokens [L], logits [L,
-        vocab])`` — the greedy next token *at every position*; the host
-        reads each request's last-prompt-position entry as its first
-        generated token.
-        """
-        from apex_tpu.ops.flash_attention import flash_attention
+        Per slot: ``tokens``/``position_ids [max_batch, chunk]`` — this
+        tick's slice of the slot's prompt at its *absolute* positions
+        (also the RoPE angle source, so chunking composes with rope);
+        ``dest_blocks``/``dest_offsets [max_batch, chunk]`` — each
+        token's physical cache destination (out-of-range = dropped,
+        used for padding); ``block_tables [max_batch, max_blocks]`` and
+        ``lengths [max_batch]`` — the slot's table and its total cache
+        length INCLUDING this chunk; ``limits [max_batch, chunk]`` —
+        per-token causal horizons (0 = padding).  Shared prefix-cache
+        blocks and earlier chunks need no special path: they are table
+        entries the per-token limits already reach.
 
+        ``sample_index [max_batch]`` — for slots whose prompt completes
+        this chunk, the in-chunk index of the last prompt token; the
+        logits there are sampled with the slot's policy arrays (the
+        request's FIRST generated token).  Out-of-range = no sample.
+        Returns ``(arenas, next_tokens [max_batch],
+        logits [max_batch, chunk, vocab])``.
+        """
         cfg = self.cfg
-        L = tokens.shape[1]
+        B, T = tokens.shape
         dest_blocks = dest_blocks.astype(jnp.int32)
         dest_offsets = dest_offsets.astype(jnp.int32)
 
@@ -291,34 +353,41 @@ class DecodeModel:
                                  position_ids)
         else:
             x = self.embed.apply({"params": params.embedding}, tokens)
-        # x: [L, 1, hidden]
-        rope = self._rope_tables(position_ids[0], x.dtype)
+        # x: [chunk, max_batch, hidden]
+        rope = None
+        if cfg.position_embedding_type == "rope":
+            cos, sin = self._rope_tables(
+                position_ids.reshape(-1), x.dtype)
+            rope = (cos.reshape(B, T, -1).transpose(1, 0, 2),
+                    sin.reshape(B, T, -1).transpose(1, 0, 2))
 
-        def rope_fn(q, k):
-            if rope is None:
-                return q, k
-            cos, sin = rope
-            return apply_rotary(q, cos, sin), apply_rotary(k, cos, sin)
+        attend = (paged_prefill_attention if self.fused_attention
+                  else paged_prefill_attention_unfused)
 
-        def attn_core(q, k, v, k_layer, v_layer):
-            # q [L, 1, n_local, d]; k/v [L, 1, g_local, d] (compact GQA)
-            k_layer = k_layer.at[dest_blocks, dest_offsets].set(
-                k[:, 0].astype(k_layer.dtype), mode="drop")
-            v_layer = v_layer.at[dest_blocks, dest_offsets].set(
-                v[:, 0].astype(v_layer.dtype), mode="drop")
-            ke, ve = k, v
-            if self.hpg > 1:
-                ke = jnp.repeat(ke, self.hpg, axis=2)
-                ve = jnp.repeat(ve, self.hpg, axis=2)
-            ctx = flash_attention(
-                q.transpose(1, 2, 0, 3), ke.transpose(1, 2, 0, 3),
-                ve.transpose(1, 2, 0, 3), causal=True,
-                segment_ids_q=segment_ids, segment_ids_kv=segment_ids,
-            )  # [1, n_local, L, d]
-            return (ctx.transpose(2, 0, 1, 3).reshape(L, 1, -1)
-                    .astype(q.dtype), k_layer, v_layer)
+        def attn_core(q, k, v, layer_arenas):
+            # q [T, B, n_local, d]; k/v [T, B, g_local, d] (compact GQA)
+            if rope is not None:
+                cos, sin = rope
+                q = apply_rotary_packed(q, cos, sin)
+                k = apply_rotary_packed(k, cos, sin)
+            layer_arenas = self._append_rows(
+                layer_arenas, dest_blocks, dest_offsets,
+                k.transpose(1, 0, 2, 3), v.transpose(1, 0, 2, 3))
+            kv, sc = self._attend_kwargs(layer_arenas)
+            ctx = attend(q.transpose(1, 0, 2, 3), *kv, block_tables,
+                         lengths, limits, **sc)   # [B, T, n, d]
+            return (ctx.transpose(1, 0, 2, 3).reshape(T, B, -1)
+                    .astype(q.dtype), layer_arenas)
 
-        x, k_arena, v_arena = self._layer_stack(
-            params, x, k_arena, v_arena, attn_core, rope_fn)
-        next_tokens, logits = self._head(params, x)
-        return k_arena, v_arena, next_tokens[:, 0], logits[:, 0]
+        x, arenas = self._layer_stack(params, x, arenas, attn_core)
+        logits = self._head(params, x)             # [T, B, vocab]
+        logits = logits.transpose(1, 0, 2)         # [B, T, vocab]
+        idx = jnp.clip(sample_index.astype(jnp.int32), 0, T - 1)
+        last = jnp.take_along_axis(
+            logits, idx[:, None, None], axis=1)[:, 0]   # [B, vocab]
+        sampled = sample_tokens(last, temperature, top_k, top_p,
+                                seeds, steps)
+        valid = (sample_index.astype(jnp.int32) >= 0) & \
+            (sample_index.astype(jnp.int32) < T)
+        next_tokens = jnp.where(valid, sampled, 0).astype(jnp.int32)
+        return arenas, next_tokens, logits

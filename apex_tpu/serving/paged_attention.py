@@ -1,14 +1,24 @@
-"""Fused paged-attention decode kernel (Pallas) + its unfused XLA twin.
+"""Fused paged-attention kernels (Pallas) + their unfused XLA twins.
 
-The decode-step attention of the serving runtime: one query token per
-active slot attends over that request's KV cache, which lives scattered
-across fixed-size blocks of the pooled arena
-(:mod:`apex_tpu.serving.kv_cache`).  The unfused XLA lowering needs a
-big gather (materialising ``[batch, max_seq, heads, head_dim]`` K/V
-copies in HBM) followed by an unfused chain of elementwise/reduction
-ops — exactly the decode profile the operation-fusion paper (PAPERS.md,
-arxiv 2502.17728) measures as the dominant cost.  The fused kernel does
-**gather + online-softmax attention in one pass**:
+The attention of the serving runtime over the paged KV cache
+(:mod:`apex_tpu.serving.kv_cache`), in two shapes:
+
+- **decode** (:func:`paged_attention_decode`) — one query token per
+  active slot attends over that request's cached blocks;
+- **chunked prefill** (:func:`paged_prefill_attention`) — a
+  ``[chunk]``-token slice of each slot's prompt attends over the
+  request's *whole* context so far: the already-cached history blocks
+  (earlier chunks, shared prefix-cache blocks) AND the chunk's own
+  tokens, which the caller scatters into the arena *before* the call —
+  so one block sweep with a per-token causal ``limit`` covers history
+  and in-chunk causality with no second kernel and no softmax merge.
+
+The unfused XLA lowering of either needs a big gather (materialising
+``[batch, max_seq, heads, head_dim]`` K/V copies in HBM) followed by an
+unfused chain of elementwise/reduction ops — exactly the decode profile
+the operation-fusion paper (PAPERS.md, arxiv 2502.17728) measures as
+the dominant cost.  The fused kernels do **gather + online-softmax
+attention in one pass**:
 
 - grid ``(batch, max_blocks)`` with the block index innermost; the
   K/V **index maps read the block table** (scalar prefetch —
@@ -24,7 +34,13 @@ arxiv 2502.17728) measures as the dominant cost.  The fused kernel does
   O(block) state however long the context.
 - K/V are read in their **storage dtype** and upcast to fp32 inside
   the kernel (the fused-dequant convention — a bf16 cache moves half
-  the HBM bytes and the dequant rides the same VMEM residency).
+  the HBM bytes and the dequant rides the same VMEM residency).  An
+  **int8 cache** passes the per-vector scale arenas
+  (``k_scales``/``v_scales``, one fp32 scale per cached row, stored
+  block-major beside the block): the scale blocks ride the same
+  table-indexed index maps and the dequant is a VMEM multiply —
+  quarter the HBM bytes of fp32, half of bf16, for one extra
+  ``1/head_dim``-sized read.
 - grouped-query attention: the arena stores the compact ``kv_heads``
   (= query groups); the kernel broadcasts each group across its query
   heads *in VMEM* — the GQA bandwidth saving is precisely the point of
@@ -32,12 +48,16 @@ arxiv 2502.17728) measures as the dominant cost.  The fused kernel does
 
 Layouts::
 
-    q:            [batch, n_heads, head_dim]      (one token per slot)
+    decode   q:   [batch, n_heads, head_dim]      (one token per slot)
+    prefill  q:   [batch, chunk, n_heads, head_dim]
     k/v arena:    [n_blocks, block_size, kv_heads, head_dim]
+    k/v scales:   [n_blocks, block_size, kv_heads]  fp32 (int8 cache)
     block_tables: [batch, max_blocks]  int32  (entries past the live
                   range may be anything in-range; they are clamped)
     lengths:      [batch] int32  (tokens in cache; 0 = inactive slot)
-    out:          [batch, n_heads, head_dim]  (zeros for length 0)
+    limits:       [batch, chunk] int32 (prefill: each token attends
+                  cache positions < limit; 0 = padding token)
+    out:          same leading shape as q  (zeros for length/limit 0)
 
 ``interpret=True`` is selected automatically off-TPU so the same code
 runs on the CPU test mesh (the flash-attention convention).
@@ -53,7 +73,12 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-__all__ = ["paged_attention_decode", "paged_attention_decode_unfused"]
+__all__ = [
+    "paged_attention_decode",
+    "paged_attention_decode_unfused",
+    "paged_prefill_attention",
+    "paged_prefill_attention_unfused",
+]
 
 NEG_INF = -1e30
 _LANES = 128
@@ -67,8 +92,22 @@ def _resolve(scale: Optional[float], d: int) -> float:
     return (1.0 / (d ** 0.5)) if scale is None else scale
 
 
-def _kernel(tab_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
-            m_sc, l_sc, acc_sc, *, scale: float, block_size: int, hpg: int):
+def _dequant(k_ref, scale_ref):
+    """Storage dtype -> fp32 in VMEM; int8 multiplies its row scales."""
+    k = k_ref[0].astype(jnp.float32)            # [bs, g, d]
+    if scale_ref is not None:
+        k = k * scale_ref[0][..., None]         # [bs, g] row scales
+    return k
+
+
+def _decode_kernel(tab_ref, len_ref, q_ref, k_ref, v_ref, *rest,
+                   scale: float, block_size: int, hpg: int,
+                   has_scales: bool):
+    if has_scales:
+        ks_ref, vs_ref, o_ref, m_sc, l_sc, acc_sc = rest
+    else:
+        ks_ref = vs_ref = None
+        o_ref, m_sc, l_sc, acc_sc = rest
     i = pl.program_id(0)
     j = pl.program_id(1)
     num_blocks = pl.num_programs(1)
@@ -83,9 +122,9 @@ def _kernel(tab_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
     @pl.when(j * block_size < length)
     def _body():
         q = q_ref[0].astype(jnp.float32)            # [n, d]
-        # in-kernel dequant: storage dtype (bf16/fp32 cache) -> fp32
-        k = k_ref[0].astype(jnp.float32)            # [bs, g, d]
-        v = v_ref[0].astype(jnp.float32)
+        # in-kernel dequant: storage dtype (bf16/int8 cache) -> fp32
+        k = _dequant(k_ref, ks_ref)                 # [bs, g, d]
+        v = _dequant(v_ref, vs_ref)
         if hpg > 1:                                  # GQA broadcast in VMEM
             k = jnp.repeat(k, hpg, axis=1)           # [bs, n, d]
             v = jnp.repeat(v, hpg, axis=1)
@@ -116,27 +155,41 @@ def _kernel(tab_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
         o_ref[0] = (acc_sc[...] / l_safe[:, None]).astype(o_ref.dtype)
 
 
+def _check_arena(q_d, k_arena, n, g, k_scales, v_scales):
+    if k_arena.shape[-1] != q_d:
+        raise ValueError(
+            f"head_dim mismatch: q {q_d}, arena {k_arena.shape[-1]}")
+    if n % g:
+        raise ValueError(f"n_heads ({n}) not a multiple of kv_heads ({g})")
+    if (k_scales is None) != (v_scales is None):
+        raise ValueError("pass both k_scales and v_scales or neither")
+    if k_scales is not None and k_scales.shape != k_arena.shape[:-1]:
+        raise ValueError(
+            f"scale arena shape {k_scales.shape} != arena rows "
+            f"{k_arena.shape[:-1]}")
+
+
 def paged_attention_decode(q, k_arena, v_arena, block_tables, lengths, *,
+                           k_scales=None, v_scales=None,
                            block_size: Optional[int] = None,
                            scale: Optional[float] = None):
-    """One fused gather+attention pass over the paged cache.
+    """One fused gather+dequant+attention pass over the paged cache.
 
     See the module docstring for layouts.  ``block_tables`` entries are
     clamped into the live range, so unused table columns may hold any
     value (the scheduler leaves them 0); a slot with ``lengths == 0``
-    produces a zero output row.
+    produces a zero output row.  ``k_scales``/``v_scales`` (int8 cache)
+    are the per-row fp32 scale arenas.
     """
     b, n, d = q.shape
     n_blocks, bs, g, dk = k_arena.shape
     if block_size is not None and block_size != bs:
         raise ValueError(
             f"block_size ({block_size}) != arena block dim ({bs})")
-    if dk != d:
-        raise ValueError(f"head_dim mismatch: q {d}, arena {dk}")
-    if n % g:
-        raise ValueError(f"n_heads ({n}) not a multiple of kv_heads ({g})")
+    _check_arena(d, k_arena, n, g, k_scales, v_scales)
     hpg = n // g
     max_blocks = block_tables.shape[1]
+    has_scales = k_scales is not None
 
     def kv_idx(i, j, tab_ref, len_ref):
         # clamp skipped blocks to the last live one: Pallas re-references
@@ -145,17 +198,27 @@ def paged_attention_decode(q, k_arena, v_arena, block_tables, lengths, *,
         live = jnp.maximum((len_ref[i] - 1) // bs, 0)
         return (tab_ref[i, jnp.minimum(j, live)], 0, 0, 0)
 
+    def sc_idx(i, j, tab_ref, len_ref):
+        live = jnp.maximum((len_ref[i] - 1) // bs, 0)
+        return (tab_ref[i, jnp.minimum(j, live)], 0, 0)
+
     def q_idx(i, j, tab_ref, len_ref):
         return (i, 0, 0)
 
+    in_specs = [
+        pl.BlockSpec((1, n, d), q_idx),
+        pl.BlockSpec((1, bs, g, d), kv_idx),
+        pl.BlockSpec((1, bs, g, d), kv_idx),
+    ]
+    operands = [q, k_arena, v_arena]
+    if has_scales:
+        in_specs += [pl.BlockSpec((1, bs, g), sc_idx),
+                     pl.BlockSpec((1, bs, g), sc_idx)]
+        operands += [k_scales, v_scales]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(b, max_blocks),
-        in_specs=[
-            pl.BlockSpec((1, n, d), q_idx),
-            pl.BlockSpec((1, bs, g, d), kv_idx),
-            pl.BlockSpec((1, bs, g, d), kv_idx),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, n, d), q_idx),
         scratch_shapes=[
             pltpu.VMEM((n, _LANES), jnp.float32),
@@ -163,8 +226,9 @@ def paged_attention_decode(q, k_arena, v_arena, block_tables, lengths, *,
             pltpu.VMEM((n, d), jnp.float32),
         ],
     )
-    kernel = functools.partial(_kernel, scale=_resolve(scale, d),
-                               block_size=bs, hpg=hpg)
+    kernel = functools.partial(_decode_kernel, scale=_resolve(scale, d),
+                               block_size=bs, hpg=hpg,
+                               has_scales=has_scales)
     return pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
@@ -172,7 +236,7 @@ def paged_attention_decode(q, k_arena, v_arena, block_tables, lengths, *,
         compiler_params=_compiler_params(),
         interpret=_interpret(),
     )(block_tables.astype(jnp.int32), lengths.astype(jnp.int32),
-      q, k_arena, v_arena)
+      *operands)
 
 
 def _compiler_params():
@@ -183,8 +247,31 @@ def _compiler_params():
     return params_cls(dimension_semantics=("parallel", "arbitrary"))
 
 
+def _gathered_kv(q, k_arena, v_arena, block_tables, k_scales, v_scales):
+    """The unfused twins' shared gather: materialise per-slot K/V (and
+    apply int8 row scales) in HBM — the cost the fused kernels avoid."""
+    b, n, d = q.shape
+    _, bs, g, _ = k_arena.shape
+    hpg = n // g
+    k = jnp.take(k_arena, block_tables, axis=0).astype(jnp.float32)
+    v = jnp.take(v_arena, block_tables, axis=0).astype(jnp.float32)
+    if k_scales is not None:
+        ks = jnp.take(k_scales, block_tables, axis=0)
+        vs = jnp.take(v_scales, block_tables, axis=0)
+        k = k * ks[..., None]
+        v = v * vs[..., None]
+    t = block_tables.shape[1] * bs
+    k = k.reshape(b, t, g, d)
+    v = v.reshape(b, t, g, d)
+    if hpg > 1:
+        k = jnp.repeat(k, hpg, axis=2)
+        v = jnp.repeat(v, hpg, axis=2)
+    return k, v, t
+
+
 def paged_attention_decode_unfused(q, k_arena, v_arena, block_tables,
-                                   lengths, *, scale: Optional[float] = None):
+                                   lengths, *, k_scales=None, v_scales=None,
+                                   scale: Optional[float] = None):
     """The plain-XLA lowering of the same computation — the A/B baseline
     (bench ``serving.vs_unfused``) and the parity reference.
 
@@ -193,17 +280,9 @@ def paged_attention_decode_unfused(q, k_arena, v_arena, block_tables,
     unfused decode profile the Pallas kernel exists to beat.
     """
     b, n, d = q.shape
-    _, bs, g, _ = k_arena.shape
-    hpg = n // g
-    # gather the whole table per slot: [b, max_blocks, bs, g, d]
-    k = jnp.take(k_arena, block_tables, axis=0).astype(jnp.float32)
-    v = jnp.take(v_arena, block_tables, axis=0).astype(jnp.float32)
-    t = block_tables.shape[1] * bs
-    k = k.reshape(b, t, g, d)
-    v = v.reshape(b, t, g, d)
-    if hpg > 1:
-        k = jnp.repeat(k, hpg, axis=2)
-        v = jnp.repeat(v, hpg, axis=2)
+    _check_arena(d, k_arena, n, k_arena.shape[2], k_scales, v_scales)
+    k, v, t = _gathered_kv(q, k_arena, v_arena, block_tables,
+                           k_scales, v_scales)
     s = jnp.einsum("bnd,btnd->bnt", q.astype(jnp.float32), k)
     s = s * _resolve(scale, d)
     mask = jnp.arange(t)[None, None, :] < lengths[:, None, None]
@@ -213,4 +292,155 @@ def paged_attention_decode_unfused(q, k_arena, v_arena, block_tables,
     p = jnp.exp(s - m_safe)
     l = jnp.sum(p, axis=-1, keepdims=True)
     out = jnp.einsum("bnt,btnd->bnd", p, v) / jnp.where(l == 0.0, 1.0, l)
+    return out.astype(q.dtype)
+
+
+# --------------------------------------------------- chunked prefill
+
+
+def _prefill_kernel(tab_ref, len_ref, q_ref, lim_ref, k_ref, v_ref, *rest,
+                    scale: float, block_size: int, hpg: int,
+                    has_scales: bool):
+    if has_scales:
+        ks_ref, vs_ref, o_ref, m_sc, l_sc, acc_sc = rest
+    else:
+        ks_ref = vs_ref = None
+        o_ref, m_sc, l_sc, acc_sc = rest
+    i = pl.program_id(0)
+    j = pl.program_id(1)
+    num_blocks = pl.num_programs(1)
+    length = len_ref[i]
+
+    @pl.when(j == 0)
+    def _init():
+        m_sc[...] = jnp.full_like(m_sc, NEG_INF)
+        l_sc[...] = jnp.zeros_like(l_sc)
+        acc_sc[...] = jnp.zeros_like(acc_sc)
+
+    @pl.when(j * block_size < length)
+    def _body():
+        q = q_ref[0].astype(jnp.float32)            # [T, n, d]
+        lim = lim_ref[0]                            # [T] per-token limits
+        k = _dequant(k_ref, ks_ref)                 # [bs, g, d]
+        v = _dequant(v_ref, vs_ref)
+        if hpg > 1:
+            k = jnp.repeat(k, hpg, axis=1)           # [bs, n, d]
+            v = jnp.repeat(v, hpg, axis=1)
+        s = jnp.einsum("tnd,snd->tns", q, k) * scale  # [T, n, bs]
+        cols = j * block_size + jax.lax.broadcasted_iota(
+            jnp.int32, (1, 1, block_size), 2)
+        # per-token causal limit: token t sees cache positions < lim[t]
+        # (its own row, scattered before the call, is position lim[t]-1)
+        s = jnp.where(cols < lim[:, None, None], s, NEG_INF)
+
+        m = m_sc[...]                                # [T, n]
+        l = l_sc[...]
+        m_new = jnp.maximum(m, jnp.max(s, axis=2))
+        m_safe = jnp.where(m_new <= NEG_INF * 0.5, 0.0, m_new)
+        p = jnp.exp(s - m_safe[..., None])
+        alpha = jnp.exp(jnp.minimum(m - m_new, 0.0))
+        l_new = l * alpha + jnp.sum(p, axis=2)
+        acc_new = acc_sc[...] * alpha[..., None] + jnp.einsum(
+            "tns,snd->tnd", p, v)
+        m_sc[...] = m_new
+        l_sc[...] = l_new
+        acc_sc[...] = acc_new
+
+    @pl.when(j == num_blocks - 1)
+    def _finalize():
+        l_fin = l_sc[...]
+        l_safe = jnp.where(l_fin == 0.0, 1.0, l_fin)
+        o_ref[0] = (acc_sc[...] / l_safe[..., None]).astype(o_ref.dtype)
+
+
+def paged_prefill_attention(q, k_arena, v_arena, block_tables, lengths,
+                            limits, *, k_scales=None, v_scales=None,
+                            scale: Optional[float] = None):
+    """Fused chunked-prefill attention: each slot's ``[chunk]`` query
+    tokens attend over the slot's paged context in one block sweep.
+
+    ``q [batch, chunk, n, d]``; ``lengths [batch]`` — the slot's total
+    live cache length INCLUDING the chunk's own just-scattered rows
+    (the block-sweep bound); ``limits [batch, chunk]`` — per-token
+    causal horizon (token attends positions ``< limit``; 0 = padding
+    row, which produces zeros).  History blocks and the chunk's own
+    destination blocks are all just table entries — prefix-cache hits,
+    earlier chunks, and in-chunk causality need no separate paths.
+    """
+    b, T, n, d = q.shape
+    n_blocks, bs, g, dk = k_arena.shape
+    _check_arena(d, k_arena, n, g, k_scales, v_scales)
+    hpg = n // g
+    max_blocks = block_tables.shape[1]
+    has_scales = k_scales is not None
+
+    def kv_idx(i, j, tab_ref, len_ref):
+        live = jnp.maximum((len_ref[i] - 1) // bs, 0)
+        return (tab_ref[i, jnp.minimum(j, live)], 0, 0, 0)
+
+    def sc_idx(i, j, tab_ref, len_ref):
+        live = jnp.maximum((len_ref[i] - 1) // bs, 0)
+        return (tab_ref[i, jnp.minimum(j, live)], 0, 0)
+
+    def row_idx(i, j, tab_ref, len_ref):
+        return (i, 0)
+
+    def q_idx(i, j, tab_ref, len_ref):
+        return (i, 0, 0, 0)
+
+    in_specs = [
+        pl.BlockSpec((1, T, n, d), q_idx),
+        pl.BlockSpec((1, T), row_idx),
+        pl.BlockSpec((1, bs, g, d), kv_idx),
+        pl.BlockSpec((1, bs, g, d), kv_idx),
+    ]
+    operands = [q, limits.astype(jnp.int32), k_arena, v_arena]
+    if has_scales:
+        in_specs += [pl.BlockSpec((1, bs, g), sc_idx),
+                     pl.BlockSpec((1, bs, g), sc_idx)]
+        operands += [k_scales, v_scales]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, max_blocks),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, T, n, d), q_idx),
+        scratch_shapes=[
+            pltpu.VMEM((T, n), jnp.float32),
+            pltpu.VMEM((T, n), jnp.float32),
+            pltpu.VMEM((T, n, d), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(_prefill_kernel, scale=_resolve(scale, d),
+                               block_size=bs, hpg=hpg,
+                               has_scales=has_scales)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, T, n, d), q.dtype),
+        compiler_params=_compiler_params(),
+        interpret=_interpret(),
+    )(block_tables.astype(jnp.int32), lengths.astype(jnp.int32),
+      *operands)
+
+
+def paged_prefill_attention_unfused(q, k_arena, v_arena, block_tables,
+                                    lengths, limits, *, k_scales=None,
+                                    v_scales=None,
+                                    scale: Optional[float] = None):
+    """Plain-XLA chunked-prefill lowering (A/B baseline + parity
+    reference): gather each slot's whole table, mask per token."""
+    b, T, n, d = q.shape
+    _check_arena(d, k_arena, n, k_arena.shape[2], k_scales, v_scales)
+    k, v, t = _gathered_kv(q[:, 0], k_arena, v_arena, block_tables,
+                           k_scales, v_scales)
+    s = jnp.einsum("btnd,bsnd->btns", q.astype(jnp.float32), k)
+    s = s * _resolve(scale, d)
+    mask = jnp.arange(t)[None, None, None, :] < limits[:, :, None, None]
+    s = jnp.where(mask, s, NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    m_safe = jnp.where(m <= NEG_INF * 0.5, 0.0, m)
+    p = jnp.exp(s - m_safe)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    out = jnp.einsum("btns,bsnd->btnd", p, v) / \
+        jnp.where(l == 0.0, 1.0, l)
     return out.astype(q.dtype)

@@ -17,6 +17,12 @@ picklable data):
 
 parent → child commands
     ``("submit", frid, prompt, max_new_tokens, eos_id)``
+    ``("submit_many", [(frid, prompt, max_new_tokens, eos_id), ...])``
+                        — batched admission: N requests in ONE queue
+                          put/pickle round trip (the router batches a
+                          pump's dispatches per replica; at fleet
+                          arrival rates the per-command transport
+                          overhead was the router's dominant cost)
     ``("drain",)``      — programmatic drain (tests); production
                           rollouts send a real **SIGTERM** instead,
                           through the engine's ``PreemptionGuard``
@@ -159,12 +165,14 @@ def _replica_worker(spec: ReplicaSpec, name: str, cmd_q, evt_q,
             "pid": os.getpid(), "name": name, "ckpt_step": ckpt_step,
             "max_batch": spec.serving.max_batch,
             "n_blocks": engine.cache.n_blocks,
-            # context limits: the router needs these to recognize a
-            # stream the engine finished at the context cap (and a
-            # replay prefix no replica could re-prefill) during
-            # failover replay
+            # context limit: the router needs this to recognize a
+            # stream the engine finished at the context cap during
+            # failover replay.  prefill_len is None since chunked
+            # prefill (ISSUE 12): any prefix short of max_seq can be
+            # re-prefilled — the chunk width is a tick-latency knob,
+            # not an admission limit
             "max_seq": engine.cache.max_seq,
-            "prefill_len": engine.prefill_len,
+            "prefill_len": None,
             "debug_port": debug_port,
         }))
 
@@ -197,26 +205,30 @@ def _replica_worker(spec: ReplicaSpec, name: str, cmd_q, evt_q,
                 return now
             return last_state
 
+        def admit_one(frid, prompt, max_new, eos) -> None:
+            try:
+                req = engine.submit(prompt, max_new, eos)
+            except ValueError as e:
+                # unserviceable here (too long for this replica's
+                # pool) — typed refusal, the router decides what to
+                # do with it
+                evt_q.put(("rejected", frid, repr(e)))
+            else:
+                if req.done:   # rejected in the drain window
+                    evt_q.put(("rejected", frid, req.state.value))
+                else:
+                    reqs[frid] = req
+                    reported[frid] = 0
+
         while not orphaned():
             try:
                 while True:
                     cmd = cmd_q.get_nowait()
                     if cmd[0] == "submit":
-                        _, frid, prompt, max_new, eos = cmd
-                        try:
-                            req = engine.submit(prompt, max_new, eos)
-                        except ValueError as e:
-                            # unserviceable here (too long for this
-                            # replica's pool) — typed refusal, the
-                            # router decides what to do with it
-                            evt_q.put(("rejected", frid, repr(e)))
-                        else:
-                            if req.done:   # rejected in the drain window
-                                evt_q.put(("rejected", frid,
-                                           req.state.value))
-                            else:
-                                reqs[frid] = req
-                                reported[frid] = 0
+                        admit_one(*cmd[1:])
+                    elif cmd[0] == "submit_many":
+                        for item in cmd[1]:
+                            admit_one(*item)
                     elif cmd[0] == "drain":
                         guard.trigger()
                     elif cmd[0] == "stop":
@@ -322,6 +334,15 @@ class ReplicaProcess:
                eos_id: Optional[int] = None) -> None:
         self._cmd.put(("submit", frid, [int(t) for t in prompt],
                        int(max_new_tokens), eos_id))
+
+    def submit_many(self, items: Sequence[tuple]) -> None:
+        """Batched admission: ``items`` of ``(frid, prompt,
+        max_new_tokens, eos_id)`` cross the transport as ONE command
+        (one queue put, one pickle) instead of N — the router batches
+        each pump's dispatches per replica through this."""
+        self._cmd.put(("submit_many", [
+            (frid, [int(t) for t in prompt], int(max_new), eos)
+            for frid, prompt, max_new, eos in items]))
 
     def begin_drain(self, *, sigterm: bool = True) -> None:
         """Start the drain: a real SIGTERM (the production rollout

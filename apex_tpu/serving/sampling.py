@@ -1,0 +1,113 @@
+"""Jit-stable sampling policies: temperature / top-k / top-p, per-request
+seeds.
+
+The continuous-batching contract extends to sampling: every request can
+carry its own policy, but the decode step compiles ONCE — so the
+policies are ``[max_batch]`` *data* arrays (temperature, k, p, seed,
+step counter), never shapes or Python branches.  A slot's policy
+changing between ticks (request churn) re-runs the same executable.
+
+Determinism is load-bearing twice over:
+
+- **greedy** (``temperature == 0``, the default) must be the exact
+  ``argmax`` the fleet's failover replay and the smoke's token-identity
+  checks rest on — the sampled branch is computed and discarded, the
+  ``where`` keeps greedy bit-for-bit;
+- **seeded sampling** keys each draw with
+  ``fold_in(PRNGKey(seed), step)`` where ``step`` is the request's
+  output-token index.  A preempted request replayed through prefill
+  resumes at the same counter, so recompute-on-readmit (and the fleet's
+  failover replay) reproduces the *same stochastic stream* — sampling
+  does not break the bitwise-stitched-stream story, it joins it.
+
+Filter order is the conventional temperature -> top-k -> top-p (p
+renormalizes over the k survivors).  ``top_k <= 0`` and
+``top_p >= 1`` disable their filters; ``top_k == 1`` degenerates to
+greedy by construction (only the argmax survives).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["SamplingParams", "sample_tokens"]
+
+_NEG = -1e30
+
+
+@dataclasses.dataclass(frozen=True)
+class SamplingParams:
+    """One request's sampling policy (host-side; packed to device as
+    ``[max_batch]`` data by the engine).
+
+    ``temperature == 0`` is exact greedy argmax (the default — and what
+    every token-identity contract in the serving stack assumes);
+    ``top_k <= 0`` / ``top_p >= 1`` leave those filters off. ``seed``
+    plus the request's output-token counter key every draw, so the same
+    request replayed (preemption recompute, fleet failover) redraws the
+    same stream.
+    """
+
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+    seed: int = 0
+
+    def __post_init__(self):
+        if self.temperature < 0.0:
+            raise ValueError(
+                f"temperature must be >= 0, got {self.temperature}")
+        if not 0.0 < self.top_p <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {self.top_p}")
+
+
+def _sample_one(logits, temperature, top_k, top_p, seed, step):
+    """One slot's draw; vmapped over the batch."""
+    vocab = logits.shape[0]
+    x = logits / jnp.maximum(temperature, 1e-6)
+    # top-k: threshold at the kth-largest logit (k <= 0 disables)
+    sorted_desc = jnp.sort(x)[::-1]
+    kth = sorted_desc[jnp.clip(top_k - 1, 0, vocab - 1)]
+    x = jnp.where((top_k > 0) & (x < kth), _NEG, x)
+    # top-p (nucleus): keep the smallest prefix of the sorted
+    # distribution whose mass reaches p; the argmax always survives
+    # (cumsum - own prob < p holds for the head token whenever p > 0)
+    probs = jax.nn.softmax(x)
+    order = jnp.argsort(-x)
+    csum = jnp.cumsum(probs[order])
+    keep_sorted = (csum - probs[order]) < top_p
+    keep = jnp.zeros_like(keep_sorted).at[order].set(keep_sorted)
+    x = jnp.where(keep, x, _NEG)
+    key = jax.random.fold_in(jax.random.PRNGKey(seed), step)
+    return jax.random.categorical(key, x).astype(jnp.int32)
+
+
+def sample_tokens(logits, temperature, top_k, top_p, seeds, steps):
+    """Sample one token per slot from ``logits [max_batch, vocab]``.
+
+    All policy arguments are ``[max_batch]`` arrays (data, never
+    shape).  Slots with ``temperature == 0`` return the exact fp32
+    argmax — the sampled branch is fully masked out by the ``where``,
+    so greedy serving stays bitwise deterministic.  The whole drawn
+    branch sits under one ``lax.cond`` on ``any(temperature > 0)``
+    (a data predicate — still one compile): an all-greedy batch, the
+    common production shape and every token-identity contract, pays
+    one argmax and zero sort/scatter work per step.  The branch holds
+    no collectives (the logits arrive tp-gathered), so the cond is
+    APX102-clean by construction.
+    """
+    logits = logits.astype(jnp.float32)
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    def drawn(_):
+        sampled = jax.vmap(_sample_one)(
+            logits, temperature.astype(jnp.float32),
+            top_k.astype(jnp.int32), top_p.astype(jnp.float32),
+            seeds.astype(jnp.uint32), steps.astype(jnp.int32))
+        return jnp.where(temperature <= 0.0, greedy, sampled)
+
+    return jax.lax.cond(jnp.any(temperature > 0.0), drawn,
+                        lambda _: greedy, None)

@@ -1,34 +1,67 @@
-"""Host-side continuous-batching scheduler.
+"""Host-side continuous-batching scheduler — admission by *actual*
+occupancy.
 
-The state machine the engine drives once per step:
+The state machine the engine drives once per step::
 
-    WAITING --admit (slot + blocks free)--> RUNNING --eos / budget /
-        max_seq--> FINISHED
+    WAITING --admit (slot + first-chunk blocks)--> RUNNING
+        RUNNING (prefilling: cache_len < prefill_target)
+        RUNNING (decoding) --eos / budget / max_seq--> FINISHED
+    RUNNING --pool pressure--> WAITING   (preempted: blocks freed,
+                                          recompute-on-readmit)
     WAITING --drain--> CANCELLED
     submit() while draining --> REJECTED   (refused at the door)
 
-- **Admission** is all-or-nothing per request: a free decode slot AND
-  the request's *worst-case* block count
-  (``blocks_for(min(prompt + max_new_tokens, max_seq))``) must both be
-  available.  Reserving the worst case up front means a running
-  request can never fail a mid-flight block append — the pool is a
-  hard admission control, not an eviction policy (documented trade:
-  lower occupancy than optimistic allocation + preemption, but no
-  request ever restarts).  Blocks are fixed-size so this is a pure
-  counter check — fragmentation cannot strand capacity
-  (``kv_cache.BlockAllocator``).
-- **Slots** are indices into the engine's fixed ``[max_batch]`` decode
-  arrays; a request keeps one slot from admission to finish.  Churn
-  rewrites the slot's row of the block-table/length arrays — data,
-  never shape, which is what the zero-recompile contract rests on.
-- **Draining** (preemption): no further admissions; RUNNING requests
-  decode to completion and deliver their responses; WAITING requests
-  are cancelled immediately (the submitter sees a terminal state, not
-  a hang) — the serving analog of the PR 3 drain-then-exit.  A submit
-  that arrives *during* the drain is REJECTED, not cancelled: the two
-  terminal states answer different routing questions (see
-  ``RequestState``), and the engine counts them separately
-  (``serving/requests_cancelled`` vs ``serving/requests_rejected``).
+PR 8 admitted by **worst-case reservation** — a request held
+``blocks_for(prompt + max_new_tokens)`` from admission to finish, so
+the pool ran far below real occupancy (most requests never reach their
+horizon, and the reserved tail blocks sat idle).  This scheduler closes
+that gap the way production engines do:
+
+- **Admission** needs a free decode slot and blocks for the request's
+  *first prefill chunk only* — after the prefix cache
+  (:class:`~apex_tpu.serving.kv_cache.PrefixCache`) has been consulted:
+  shared prompt-prefix blocks are refcount-incremented, not
+  re-allocated or re-computed.  Fixed-size blocks keep this a pure
+  counter check (fragmentation cannot strand capacity).
+- **Growth is on demand**: a request crossing into a new block during
+  prefill or decode allocates it then.  When the free list is empty the
+  scheduler first **evicts** least-recently-used prefix-cache blocks
+  (finished requests' cached KV — capacity held only as an
+  optimization), and only then **preempts**: the *newest-admitted*
+  victim frees every block (its cached full blocks are first indexed
+  into the prefix cache, so its work is not lost) and returns to the
+  front of the queue.  On readmission it *recomputes* — its prompt plus
+  every token it already emitted replays through the ordinary chunked
+  prefill path (the PR 10 fleet-replay mechanics, one process inward) —
+  and typically hits its own just-cached blocks, so the recompute
+  prefills only what eviction actually took.
+- Victims are always strictly newer than the request growing, so the
+  oldest running request can never be preempted: it finishes, frees
+  its blocks, and everything behind it readmits — every admitted
+  request terminates even at heavy pool oversubscription (pinned at 2x
+  in ``tests/test_serving.py``).
+- The submit-time guard keeps one hard reservation rule: a request
+  whose worst case exceeds the WHOLE pool is rejected at the door (it
+  could otherwise preempt the fleet forever and still never finish).
+- ``admission="reserve"`` keeps the PR 8 worst-case policy as the A/B
+  baseline (bench ``serving_occupancy.vs_reserve``): no sharing, no
+  growth, no preemption — admission is the whole horizon or nothing.
+
+**Slots** are indices into the engine's fixed ``[max_batch]`` decode
+arrays; a request keeps one slot from admission to finish or
+preemption.  Churn rewrites the slot's row of the block-table/length
+arrays — data, never shape, which is what the zero-recompile contract
+rests on.
+
+**Draining** (preemption of the whole engine): no further admissions;
+RUNNING requests decode to completion and deliver their responses;
+WAITING requests — including preempted ones, whose partial streams were
+already delivered — are cancelled immediately (the submitter sees a
+terminal state, not a hang).  A submit that arrives *during* the drain
+is REJECTED, not cancelled: the two terminal states answer different
+routing questions (see ``RequestState``), and the engine counts them
+separately (``serving/requests_cancelled`` vs
+``serving/requests_rejected``).
 """
 
 from __future__ import annotations
@@ -42,7 +75,12 @@ from typing import Deque, List, Optional, Sequence
 
 import numpy as np
 
-from apex_tpu.serving.kv_cache import BlockAllocator, KVCacheConfig
+from apex_tpu.serving.kv_cache import (
+    BlockAllocator,
+    KVCacheConfig,
+    PrefixCache,
+)
+from apex_tpu.serving.sampling import SamplingParams
 
 __all__ = ["Request", "RequestState", "Scheduler"]
 
@@ -68,12 +106,20 @@ class Request:
     prompt: np.ndarray                  # int32 [prompt_len]
     max_new_tokens: int
     eos_id: Optional[int] = None
+    sampling: SamplingParams = dataclasses.field(
+        default_factory=SamplingParams)
 
     state: RequestState = RequestState.WAITING
     output_tokens: List[int] = dataclasses.field(default_factory=list)
     blocks: List[int] = dataclasses.field(default_factory=list)
     slot: Optional[int] = None
     cache_len: int = 0                  # tokens currently in the paged cache
+    prefill_target: int = 0             # tokens the prefill must cover
+    hit_blocks: int = 0                 # prefix-cache blocks shared (last admit)
+    pc_blocks: int = 0                  # full blocks chain-hashed so far
+    pc_hash: int = 0                    # chain hash after block pc_blocks-1
+    preemptions: int = 0                # times evicted back to the queue
+    admit_seq: int = -1                 # admission order (victim selection)
 
     # wall-clock marks for the latency metrics (engine-stamped)
     t_submit: float = 0.0
@@ -86,28 +132,56 @@ class Request:
                               RequestState.REJECTED)
 
     @property
+    def prefilling(self) -> bool:
+        """RUNNING but with prompt tokens still to land in the cache."""
+        return (self.state is RequestState.RUNNING
+                and self.cache_len < self.prefill_target)
+
+    @property
     def last_token(self) -> int:
         if self.output_tokens:
             return self.output_tokens[-1]
         return int(self.prompt[-1])
 
+    def sequence_tokens(self) -> List[int]:
+        """Every token this request has: prompt + emitted stream (the
+        readmission wire, and the content key of its cache blocks)."""
+        return list(map(int, self.prompt)) + self.output_tokens
+
 
 class Scheduler:
     """Slot + block bookkeeping for the continuous batch."""
 
-    def __init__(self, cache: KVCacheConfig, max_batch: int):
+    def __init__(self, cache: KVCacheConfig, max_batch: int, *,
+                 chunk_tokens: Optional[int] = None,
+                 admission: str = "occupancy",
+                 prefix_caching: bool = True):
+        if admission not in ("occupancy", "reserve"):
+            raise ValueError(
+                f"admission must be 'occupancy' or 'reserve', got "
+                f"{admission!r}")
         self.cache = cache
         self.max_batch = max_batch
+        self.admission = admission
+        self.chunk_tokens = chunk_tokens or cache.max_seq
         self.allocator = BlockAllocator(cache.n_blocks)
+        # reserve mode cannot share (a reservation is exclusive by
+        # definition), so the cache only exists under occupancy admission
+        self.prefix_cache: Optional[PrefixCache] = (
+            PrefixCache(self.allocator, cache.block_size)
+            if prefix_caching and admission == "occupancy" else None)
         self.slots: List[Optional[Request]] = [None] * max_batch
         self.waiting: Deque[Request] = collections.deque()
         self._ids = itertools.count()
+        self._admit_seq = itertools.count()
         self.draining = False
+        self.preemptions = 0            # lifetime count (engine flushes)
 
     # ------------------------------------------------------------- submit
 
     def submit(self, prompt: Sequence[int], max_new_tokens: int,
-               eos_id: Optional[int] = None) -> Request:
+               eos_id: Optional[int] = None,
+               sampling: Optional[SamplingParams] = None) -> Request:
         prompt = np.asarray(prompt, np.int32)
         if prompt.ndim != 1 or prompt.size == 0:
             raise ValueError("prompt must be a non-empty 1-D token list")
@@ -119,13 +193,15 @@ class Scheduler:
             raise ValueError("max_new_tokens must be >= 1")
         req = Request(rid=next(self._ids), prompt=prompt,
                       max_new_tokens=max_new_tokens, eos_id=eos_id,
+                      sampling=sampling or SamplingParams(),
                       t_submit=time.monotonic())
         need = self._worst_case_blocks(req)
         if need > self.allocator.n_blocks:
-            # admission is the only allocation point, so a request the
-            # WHOLE pool cannot cover would sit at the head of the FIFO
-            # queue forever, starving everything behind it — reject it
-            # at the door instead
+            # the one reservation rule occupancy admission keeps: a
+            # request the WHOLE pool cannot cover would either starve
+            # the FIFO head forever (reserve mode) or preempt every
+            # neighbour and still never finish (occupancy mode) —
+            # reject it at the door instead
             raise ValueError(
                 f"request needs {need} blocks worst-case "
                 f"(prompt {prompt.size} + max_new_tokens "
@@ -153,34 +229,174 @@ class Scheduler:
     def free_slots(self) -> List[int]:
         return [i for i, r in enumerate(self.slots) if r is None]
 
+    def _ensure_free(self, n: int) -> bool:
+        """Raise ``n_free`` to ``n`` by evicting prefix-cache LRU blocks
+        (capacity held only as an optimization — the whole deficit is
+        swept in one pass); False when the cache runs out first."""
+        deficit = n - self.allocator.n_free
+        if deficit > 0 and self.prefix_cache is not None:
+            self.prefix_cache.evict_many(deficit)
+        return self.allocator.n_free >= n
+
     def admit(self) -> List[Request]:
         """Move WAITING requests into free slots while capacity lasts
         (FIFO — no request starves behind a later, smaller one).
-        Returns the newly-admitted requests; the engine prefills them."""
+        Returns the newly-admitted requests; the engine prefills them.
+
+        Occupancy admission: consult the prefix cache (shared blocks
+        are refcounted, their tokens never recomputed), then require
+        blocks for the first prefill chunk only — evicting cached
+        blocks to make room, but never preempting (running requests
+        outrank arrivals).  Reserve admission (the PR 8 baseline):
+        the whole worst-case horizon or nothing."""
         admitted: List[Request] = []
         if self.draining:
             return admitted
         free = self.free_slots()
         while self.waiting and free:
             req = self.waiting[0]
-            need = self._worst_case_blocks(req)
-            if not self.allocator.can_alloc(need):
-                break
+            wire = req.sequence_tokens()
+            if self.admission == "reserve":
+                need = self._worst_case_blocks(req)
+                if not self.allocator.can_alloc(need):
+                    break
+                shared: List[int] = []
+            else:
+                shared = []
+                if self.prefix_cache is not None:
+                    # cap: always leave >= 1 token to recompute — the
+                    # recompute emits the request's next sampled token,
+                    # and it keeps every write on private blocks
+                    shared = self.prefix_cache.lookup(
+                        wire, req.rid,
+                        max_blocks=(len(wire) - 1)
+                        // self.cache.block_size)
+                hit_len = len(shared) * self.cache.block_size
+                chunk = min(len(wire) - hit_len, self.chunk_tokens)
+                need = self.cache.blocks_for(hit_len + chunk) - len(shared)
+                if not self._ensure_free(need):
+                    # not even the first chunk fits: the FIFO head
+                    # blocks (hand the shared refs back — the entries
+                    # stay cached for the retry — and roll the hit
+                    # count back: nothing was *served*, and a head
+                    # stuck behind a full pool for N ticks must not
+                    # inflate serving/prefix_cache_hits N times)
+                    if shared:
+                        self.allocator.free(shared, owner=req.rid)
+                        self.prefix_cache.hits -= len(shared)
+                    break
             self.waiting.popleft()
-            req.blocks = self.allocator.alloc(need, owner=req.rid)
+            req.blocks = shared + self.allocator.alloc(need, owner=req.rid)
+            req.hit_blocks = len(shared)
+            req.pc_blocks = 0
+            req.pc_hash = 0
+            req.cache_len = len(shared) * self.cache.block_size
+            req.prefill_target = len(wire)
             req.slot = free.pop(0)
             req.state = RequestState.RUNNING
-            req.cache_len = 0
+            req.admit_seq = next(self._admit_seq)
             self.slots[req.slot] = req
             admitted.append(req)
         return admitted
 
+    # ------------------------------------------------------------- growth
+
+    def try_grow_to(self, req: Request, n_tokens: int) -> int:
+        """Grow ``req.blocks`` toward covering ``n_tokens`` of cache,
+        taking blocks on demand: free list first, then prefix-cache
+        eviction, then preemption of strictly *newer* requests.
+        Returns the token count the request's blocks now cover — a
+        newer request with nothing left to preempt simply waits its
+        turn (the engine skips its chunk/decode this tick), while the
+        oldest running request always reaches its target (everything
+        else is evictable or preemptable), which is what makes every
+        admitted request terminate under oversubscription."""
+        target = self.cache.blocks_for(n_tokens)
+        while len(req.blocks) < target:
+            want = target - len(req.blocks)
+            if self._ensure_free(1):
+                got = self.allocator.alloc(
+                    min(want, self.allocator.n_free), owner=req.rid)
+                req.blocks.extend(got)
+                continue
+            victim = self._pick_victim(exclude=req)
+            if victim is None:
+                break
+            self.preempt(victim)
+        return len(req.blocks) * self.cache.block_size
+
+    def _pick_victim(self, exclude: Request) -> Optional[Request]:
+        """Newest-admitted running request other than ``exclude`` —
+        preempting strictly newer work is what guarantees the oldest
+        request always completes (no preemption livelock)."""
+        candidates = [r for r in self.slots
+                      if r is not None and r is not exclude
+                      and r.admit_seq > exclude.admit_seq]
+        if not candidates:
+            return None
+        return max(candidates, key=lambda r: r.admit_seq)
+
+    def preempt(self, req: Request) -> None:
+        """Evict a RUNNING request back to the queue: its full cache
+        blocks are first indexed into the prefix cache (the work
+        already done is kept as *evictable* capacity, and the
+        readmission usually hits it), every block ref is released, and
+        the request returns to the FRONT of the queue to recompute —
+        prompt + emitted tokens replay through the ordinary chunked
+        prefill path on readmission."""
+        if req.state is not RequestState.RUNNING:
+            raise ValueError(f"preempt() on {req.state} request {req.rid}")
+        from apex_tpu.observability import timeline
+
+        self._index_into_cache(req)
+        self.allocator.free(req.blocks, owner=req.rid)
+        req.blocks = []
+        self.slots[req.slot] = None
+        req.slot = None
+        req.cache_len = 0
+        req.prefill_target = 0
+        req.state = RequestState.WAITING
+        req.preemptions += 1
+        self.preemptions += 1
+        self.waiting.appendleft(req)
+        timeline.emit("request_preempt", rid=req.rid,
+                      tokens=len(req.output_tokens))
+
+    def _index_into_cache(self, req: Request) -> None:
+        if self.prefix_cache is None:
+            return
+        # content actually in the arena: the first cache_len tokens of
+        # the stream (the last sampled token is emitted before it is
+        # written, so it is NOT cache content yet).  The chain-hash
+        # cursor rides the request, so each full block is hashed ONCE
+        # per admission however many chunks the prompt takes.
+        n_full = min(req.cache_len // self.cache.block_size,
+                     len(req.blocks))
+        if n_full <= req.pc_blocks:
+            return
+        req.pc_hash = self.prefix_cache.insert(
+            req.sequence_tokens()[:req.cache_len], req.blocks,
+            req.cache_len, start_block=req.pc_blocks,
+            prev_hash=req.pc_hash)
+        req.pc_blocks = n_full
+
+    def note_prefilled(self, req: Request, n_tokens: int) -> None:
+        """Account a prefill chunk landing in the arena; newly complete
+        full blocks become shareable prefix-cache entries (a same-tick
+        arrival with the same template already hits them)."""
+        req.cache_len += n_tokens
+        self._index_into_cache(req)
+
     # ------------------------------------------------------------- finish
 
     def finish(self, req: Request) -> None:
-        """Release a RUNNING request's slot and blocks."""
+        """Release a RUNNING request's slot and blocks; its full blocks
+        stay behind as prefix-cache entries (evictable capacity — a
+        follow-up request extending this stream prefills almost
+        nothing)."""
         if req.state is not RequestState.RUNNING:
             raise ValueError(f"finish() on {req.state} request {req.rid}")
+        self._index_into_cache(req)
         self.allocator.free(req.blocks, owner=req.rid)
         req.blocks = []
         self.slots[req.slot] = None
@@ -192,9 +408,10 @@ class Scheduler:
         return [r for r in self.slots if r is not None]
 
     def drain(self) -> List[Request]:
-        """Stop admissions and cancel the queue; running requests keep
-        their slots (the engine decodes them to completion).  Returns
-        the cancelled requests."""
+        """Stop admissions and cancel the queue (including preempted
+        requests — their partial streams were already delivered);
+        running requests keep their slots (the engine decodes them to
+        completion).  Returns the cancelled requests."""
         self.draining = True
         cancelled = list(self.waiting)
         self.waiting.clear()
@@ -205,3 +422,8 @@ class Scheduler:
     @property
     def idle(self) -> bool:
         return not self.waiting and all(r is None for r in self.slots)
+
+    def kv_occupancy(self) -> float:
+        """Fraction of the pool holding live or cached KV (the number
+        worst-case reservation kept artificially low)."""
+        return 1.0 - self.allocator.n_free / self.allocator.n_blocks

@@ -5,15 +5,22 @@ Drives the full ``apex_tpu.serving`` stack on the virtual CPU mesh
 
 1. **Correctness under churn** — N requests with staggered arrivals and
    varied prompt/output lengths, continuously batched (requests join
-   and leave mid-flight, prompts pack into shared prefill rows), must
-   produce greedy outputs **token-identical** to a per-request
-   full-forward argmax reference (the degraded single-rank modules over
-   the gathered host params, re-running the whole prefix for every
-   generated token — O(n²) and unbatched, which is exactly why the
-   paged runtime exists).
+   and leave mid-flight, prompts advance through the chunked prefill)
+   over a **bf16 KV cache**, must produce greedy outputs
+   **token-identical** to a per-request full-forward argmax reference
+   (the degraded single-rank modules over the gathered host params,
+   re-running the whole prefix for every generated token — O(n²) and
+   unbatched, which is exactly why the paged runtime exists).
 2. **Zero decode recompiles** — the decode executable compiles once;
    every join/leave is data.  Pinned via the jit cache size.
-3. **Clean drain on SIGTERM** — a real ``SIGTERM`` mid-stream (through
+3. **int8 cache at occupancy (ISSUE 12)** — the same wave replayed on
+   an **int8 KV cache** engine whose pool is deliberately
+   undersized (roughly half the worst-case demand), so eviction and
+   preemption-with-recompute actually fire mid-run: every request
+   still finishes and every output stream is token-identical to the
+   bf16 leg — quantization and occupancy pressure change the HBM
+   story, never the tokens.
+4. **Clean drain on SIGTERM** — a real ``SIGTERM`` mid-stream (through
    ``resilience.PreemptionGuard``) stops admissions, the in-flight
    requests keep decoding and DELIVER their full responses, the queued
    ones are cancelled (a terminal state, not a hang), and the process
@@ -135,7 +142,8 @@ def main() -> int:
     heartbeat = HeartbeatMonitor(timeout_s=120.0, registry=registry)
     eng = ServingEngine(
         cfg, ServingConfig(max_batch=3, block_size=4, max_seq=MAX_SEQ,
-                           prefill_len=MAX_SEQ),
+                           prefill_len=MAX_SEQ,
+                           cache_dtype=jnp.bfloat16),
         params, mesh=mesh, registry=registry, heartbeat=heartbeat)
     rng = np.random.RandomState(7)
     wave = [(rng.randint(1, VOCAB - 1, size=rng.randint(2, 14)).tolist(),
@@ -197,11 +205,47 @@ def main() -> int:
         log(f"FAIL: serving goodput_fraction {sgp['goodput_fraction']}")
         return 1
     log(f"phase A OK: {len(wave)} requests token-identical to the "
-        f"full-forward reference, {total} tokens, 1 decode compile, "
+        f"full-forward reference over the bf16 cache, {total} tokens, "
+        f"1 decode compile, "
         f"tpot p50={tpot.percentile(50):.1f}ms p99={tpot.percentile(99):.1f}ms, "
         f"serving goodput {sgp['goodput_fraction']:.3f} "
         f"(active {sgp['totals']['active_s']:.3f}s / queue "
         f"{sgp['totals']['queue_wait_s']:.3f}s)")
+
+    # ---- phase A2: int8 cache at occupancy pressure (ISSUE 12) -------
+    # Same wave on an int8-quantized cache with the pool undersized to
+    # ~half the worst-case demand: eviction + preemption/recompute fire
+    # mid-run, and the streams must STILL be token-identical to the
+    # bf16 leg above (which phase A proved identical to the reference).
+    reg8 = MetricRegistry()
+    eng8 = ServingEngine(
+        cfg, ServingConfig(max_batch=3, block_size=4, max_seq=MAX_SEQ,
+                           prefill_len=MAX_SEQ, n_blocks=8,
+                           cache_dtype=jnp.int8),
+        params, mesh=mesh, registry=reg8)
+    reqs8 = [eng8.submit(p, n) for p, n in wave]
+    eng8.run_until_drained(max_steps=2000)
+    for r8, ra in zip(reqs8, reqs):
+        if r8.state.value != "finished" or \
+                r8.output_tokens != ra.output_tokens:
+            log(f"FAIL: int8 request {r8.rid} {r8.state.value} "
+                f"{r8.output_tokens} != bf16 {ra.output_tokens}")
+            return 1
+    if eng8.decode_compile_count() != 1:
+        log("FAIL: int8 engine recompiled decode under "
+            "eviction/preemption churn")
+        return 1
+    eng8.scheduler.allocator.check()
+    preempts = eng8.scheduler.preemptions
+    evicts = eng8.scheduler.prefix_cache.evictions
+    if preempts + evicts == 0:
+        log("FAIL: the undersized pool exercised neither eviction nor "
+            "preemption — the occupancy leg tested nothing")
+        return 1
+    log(f"phase A2 OK: int8 cache token-identical to bf16 at 8/15-block "
+        f"oversubscription ({preempts} preemptions, {evicts} evictions, "
+        f"{eng8.scheduler.prefix_cache.hits} prefix hits, 1 decode "
+        "compile)")
 
     # ---- phase B: SIGTERM drain --------------------------------------
     # Same engine (same compiled programs — phase B costs zero extra

@@ -30,7 +30,8 @@ from typing import Tuple
 
 import jax.numpy as jnp
 
-__all__ = ["rotary_cos_sin", "apply_rotary", "apply_rotary_decode"]
+__all__ = ["rotary_cos_sin", "apply_rotary", "apply_rotary_decode",
+           "apply_rotary_packed"]
 
 
 def rotary_cos_sin(positions, rotary_dim: int, base: float = 10000.0,
@@ -70,6 +71,18 @@ def apply_rotary(x, cos, sin):
     past ``rotary_dim`` pass through (``rotary_percent < 1``)."""
     # cos/sin [s, half]: broadcast over [b, n]
     return _rotate(x, cos[:, None, None, :], sin[:, None, None, :])
+
+
+def apply_rotary_packed(x, cos, sin):
+    """Chunked-prefill rotation: ``x [s, b, n, d]`` where every
+    ``(position, slot)`` pair sits at its own sequence index —
+    ``cos``/``sin`` ``[s, b, half]`` from
+    ``rotary_cos_sin(positions.reshape(-1), ...)`` reshaped back.  The
+    serving runtime's batched-chunk prefill form: each slot's chunk
+    starts at that request's own absolute offset, so the tables vary
+    along both the position and the batch dim and broadcast only over
+    heads."""
+    return _rotate(x, cos[:, :, None, :], sin[:, :, None, :])
 
 
 def apply_rotary_decode(x, cos, sin):

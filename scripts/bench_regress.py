@@ -72,6 +72,9 @@ TOLERANCES = {
     "real_data_rn50": 0.8,    # ~0.6 images/sec absolute on CPU
     "input_pipeline": 0.7,    # scales with the host's free cores
     "tp_gpt": 0.6,            # 8-way shard_map on a shared CPU
+    # preemption/recompute cadence is host-load sensitive on CPU (the
+    # interpret-mode prefill dominates the recompute cost)
+    "serving_occupancy": 0.6,
 }
 
 # Hard ceilings on whitelist fields — standing acceptance gates, not

@@ -5,14 +5,16 @@ last JSON line.  Rounds 1-4 all delivered ``parsed: null`` because the
 full record line grew past the tail size.  These tests pin the fix: every
 emission ends with a compact line that (a) is <= 1500 bytes, (b) parses,
 (c) carries the driver contract fields, and (d) survives a simulated
-2000-byte tail even in the worst case (all sixteen BENCH_ORDER rows
+2000-byte tail even in the worst case (all seventeen BENCH_ORDER rows
 verbose — including ``real_data_rn50`` with its ``vs_synthetic``
 composition, ``zero_adam_step`` with ``vs_per_leaf``, ``tp_gpt``
 with its overlap_comm A/B fields (``overlap_tokens_per_sec`` /
 ``vs_monolithic``), ``ckpt_save_restore`` with ``vs_sharded``,
 ``ckpt_reshard`` with ``vs_same_mesh``, ``telemetry_overhead``
 with ``vs_bare``, ``serving`` with its per-concurrency
-tokens/sec + p50/p99 TPOT sub-rows and ``vs_unfused``, and
+tokens/sec + p50/p99 TPOT sub-rows and ``vs_unfused``,
+``serving_occupancy`` with its per-oversubscription curve,
+``vs_reserve`` and the prefix-cache TTFT A/B, and
 ``serving_fleet`` with its steady/roll p99-TPOT pair and
 ``roll_vs_steady`` — + embedded prior TPU evidence).
 """
@@ -28,7 +30,7 @@ import bench  # noqa: E402
 
 
 def _worst_case_results():
-    """All sixteen BENCH_ORDER rows, each fattened with prose fields,
+    """All seventeen BENCH_ORDER rows, each fattened with prose fields,
     like a CPU-fallback day — the REAL worst case (the pre-fix nine-row
     set under-tested the <=1500-byte guarantee once ``real_data_rn50``,
     ``zero_adam_step``, ``ckpt_save_restore``, ``ckpt_reshard``,
@@ -61,6 +63,18 @@ def _worst_case_results():
                                           "8": 1843.7},
                     "tpot_p50_ms_at": {"1": 4.11, "4": 4.19, "8": 4.32},
                     "tpot_p99_ms_at": {"1": 6.9, "4": 7.4, "8": 9.8}},
+        "serving_occupancy": {"value": 1211.4, "unit": "tokens/sec",
+                              "vs_reserve": 1.402,
+                              "tokens_per_sec_at": {"1x": 1104.0,
+                                                    "2x": 1211.4,
+                                                    "4x": 1160.5},
+                              "tpot_p99_ms_at": {"1x": 9.6, "2x": 10.9,
+                                                 "4x": 24.9},
+                              "preemptions_at": {"1x": 0, "2x": 4,
+                                                 "4x": 10},
+                              "ttft_cold_ms": 69.98,
+                              "ttft_hit_ms": 35.39,
+                              "ttft_hit_vs_cold": 0.506},
         "serving_fleet": {"value": 3104.2, "unit": "tokens/sec",
                           "replicas": 3,
                           "p99_tpot_ms_steady": 3.4,
@@ -122,6 +136,12 @@ def test_compact_record_under_1500_bytes():
     assert sv["vs_unfused"] == 1.31
     assert sv["tokens_per_sec_at"]["8"] == 1843.7
     assert sv["tpot_p99_ms_at"]["8"] == 9.8
+    # ISSUE 12 occupancy sub-rows survive the distillation
+    # (``preemptions_at`` stays in the full record only)
+    oc = compact["rows"]["serving_occupancy"]
+    assert oc["vs_reserve"] == 1.402
+    assert oc["tokens_per_sec_at"]["4x"] == 1160.5
+    assert oc["ttft_hit_vs_cold"] == 0.506
     # ISSUE 11 fleet sub-rows survive the distillation (``replicas`` /
     # ``roll_wall_s`` stay in the full record's config/prose only)
     fl = compact["rows"]["serving_fleet"]

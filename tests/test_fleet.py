@@ -182,6 +182,58 @@ def test_single_request_round_trip():
     assert req.replays == 0 and req.replica == "a"
 
 
+def test_batched_submit_one_transport_command(monkeypatch):
+    """ISSUE 12 satellite: N requests dispatched to one replica in one
+    pump cross the transport as ONE submit_many command (not N submit
+    commands), land in order, and finish identically to per-request
+    submits."""
+    rep = FakeReplica("a", max_batch=8)
+    commands = []
+    real_submit = rep.submit
+
+    def submit_many(items):
+        commands.append(("submit_many", len(items)))
+        for item in items:
+            real_submit(*item)
+
+    def submit_one(*args):
+        commands.append(("submit", 1))
+        real_submit(*args)
+
+    rep.submit_many = submit_many
+    rep.submit = submit_one
+    router = make_router([rep], replica_queue_limit=8)
+    prompts = [[3, 5, 7], [2, 4], [9, 9, 1], [6]]
+    reqs = [router.submit(p, 4) for p in prompts]
+    router.pump()       # one pump seats all four
+    assert commands == [("submit_many", 4)], commands
+    drive(router, [rep])
+    for req, p in zip(reqs, prompts):
+        assert req.state is RequestState.FINISHED
+        assert req.output_tokens == reference(p, 4)
+    assert int(router.registry.counter(
+        "fleet/batched_submits").value) == 1
+    # a single dispatch still goes through the plain submit path (no
+    # pointless one-element batch command)
+    solo = router.submit([1, 2], 3)
+    router.pump()
+    assert commands[-1] == ("submit", 1)
+    drive(router, [rep])
+    assert solo.state is RequestState.FINISHED
+
+
+def test_batched_submit_falls_back_without_client_support():
+    """A transport without submit_many (an old replica) still works:
+    the router falls back to per-request submits."""
+    rep = FakeReplica("a", max_batch=8)   # FakeReplica has no submit_many
+    router = make_router([rep], replica_queue_limit=8)
+    reqs = [router.submit(p, 3) for p in ([1, 2], [3, 4], [5, 6])]
+    drive(router, [rep])
+    for req in reqs:
+        assert req.state is RequestState.FINISHED
+    assert len(rep.submissions) == 3
+
+
 def test_eos_stops_the_stream():
     prompt = [2, 4]
     full = reference(prompt, 8)

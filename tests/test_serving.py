@@ -1,11 +1,16 @@
 """apex_tpu.serving — paged KV cache, fused decode kernels, engine.
 
 Fast tier: kernel parity (fused Pallas vs unfused XLA vs a dense
-reference, GQA + bf16-dequant included), the fused residual/norm
-epilogue, block-allocator invariants, decode-vs-prefill logits parity
-at tp=1, zero-recompile churn, and programmatic preemption drain (the
-real-SIGTERM drain lives in scripts/serving_smoke.sh).  Slow tier: the
-tp=2 parity leg and the train-mesh -> serve-mesh restore.
+reference — GQA, bf16 dequant, int8 per-row-scale dequant, and the
+chunked-prefill kernel pair included), the fused residual/norm
+epilogue, block-allocator refcount/copy-on-write invariants, the
+prefix cache, decode-vs-prefill logits parity at tp=1, zero-recompile
+churn, occupancy admission (eviction + preemption with
+recompute-on-readmit at 2x pool oversubscription), chunked prefill,
+the sampling policies, the int8 cache, and programmatic preemption
+drain (the real-SIGTERM drain lives in scripts/serving_smoke.sh).
+Slow tier: the tp=2 parity leg and the train-mesh -> serve-mesh
+restore.
 """
 
 import time
@@ -19,6 +24,8 @@ from apex_tpu import parallel
 from apex_tpu.serving import (
     BlockAllocator,
     OutOfBlocksError,
+    PrefixCache,
+    SamplingParams,
     ServingConfig,
     ServingEngine,
 )
@@ -29,11 +36,21 @@ from apex_tpu.serving.fused_ops import (
 from apex_tpu.serving.paged_attention import (
     paged_attention_decode,
     paged_attention_decode_unfused,
+    paged_prefill_attention,
+    paged_prefill_attention_unfused,
 )
 from apex_tpu.transformer.testing import TransformerConfig
 from apex_tpu.transformer.testing.gpt_parallel_train import build_gpt_3d
 
 VOCAB, MAX_SEQ = 64, 32
+
+
+def _int8_quantize(arr):
+    """Host-side mirror of the in-graph per-row symmetric quant."""
+    amax = np.abs(arr).max(-1)
+    scales = np.maximum(amax / 127.0, 1e-8).astype(np.float32)
+    q = np.clip(np.round(arr / scales[..., None]), -127, 127)
+    return q.astype(np.int8), scales
 
 
 # ---------------------------------------------------------------- kernels
@@ -109,6 +126,105 @@ class TestPagedAttentionKernel:
             q, ka, va, jnp.asarray(poisoned), lengths)
         ref = _dense_paged_reference(q, ka, va, tables, lengths, bs)
         np.testing.assert_allclose(np.asarray(out), ref, atol=2e-5)
+
+    def test_int8_per_row_scale_dequant(self):
+        """ISSUE 12: int8 arenas with per-row fp32 scales — the fused
+        in-kernel dequant must match the unfused twin exactly and the
+        fp32 cache closely (the quantization error bound, not kernel
+        error)."""
+        q, ka, va, tables, lengths, bs = self._case(
+            g=4, cache_dtype=jnp.float32)
+        ka_np, va_np = np.asarray(ka), np.asarray(va)
+        qk, sk = _int8_quantize(ka_np)
+        qv, sv = _int8_quantize(va_np)
+        fused = paged_attention_decode(
+            q, jnp.asarray(qk), jnp.asarray(qv), tables, lengths,
+            k_scales=jnp.asarray(sk), v_scales=jnp.asarray(sv))
+        unfused = paged_attention_decode_unfused(
+            q, jnp.asarray(qk), jnp.asarray(qv), tables, lengths,
+            k_scales=jnp.asarray(sk), v_scales=jnp.asarray(sv))
+        np.testing.assert_allclose(np.asarray(fused), np.asarray(unfused),
+                                   atol=2e-5)
+        ref = _dense_paged_reference(q, ka, va, tables, lengths, bs)
+        np.testing.assert_allclose(np.asarray(fused), ref, atol=0.05)
+        # scale arenas must pair up
+        with pytest.raises(ValueError, match="both k_scales"):
+            paged_attention_decode(q, jnp.asarray(qk), jnp.asarray(qv),
+                                   tables, lengths,
+                                   k_scales=jnp.asarray(sk))
+
+
+class TestPagedPrefillKernel:
+    """The chunked-prefill sweep: per-token causal limits over history
+    + the chunk's own just-scattered rows (ISSUE 12)."""
+
+    def _case(self, g=4, dtype=jnp.float32):
+        rng = np.random.RandomState(4)
+        b, T, n, d, bs, n_blocks, mb = 3, 5, 8, 16, 4, 12, 4
+        q = jnp.asarray(rng.randn(b, T, n, d), jnp.float32)
+        ka = jnp.asarray(rng.randn(n_blocks, bs, g, d), dtype)
+        va = jnp.asarray(rng.randn(n_blocks, bs, g, d), dtype)
+        tables = jnp.asarray(
+            rng.permutation(n_blocks)[:b * mb].reshape(b, mb), jnp.int32)
+        hist = np.asarray([3, 0, 7], np.int32)     # cached history
+        chunk = np.asarray([5, 0, 4], np.int32)    # this tick's tokens
+        limits = np.zeros((b, T), np.int32)
+        for i in range(b):
+            for t in range(int(chunk[i])):
+                limits[i, t] = int(hist[i]) + t + 1
+        lengths = jnp.asarray(hist + chunk, jnp.int32)
+        return q, ka, va, tables, lengths, jnp.asarray(limits), bs
+
+    def _reference(self, q, ka, va, tables, limits, bs):
+        b, T, n, d = q.shape
+        g = ka.shape[2]
+        out = np.zeros((b, T, n, d), np.float32)
+        for i in range(b):
+            for t in range(T):
+                L = int(limits[i, t])
+                if L == 0:
+                    continue
+                rk = [np.asarray(ka[int(tables[i, p // bs]), p % bs],
+                                 np.float32) for p in range(L)]
+                rv = [np.asarray(va[int(tables[i, p // bs]), p % bs],
+                                 np.float32) for p in range(L)]
+                k = np.repeat(np.stack(rk), n // g, axis=1)
+                v = np.repeat(np.stack(rv), n // g, axis=1)
+                s = np.einsum("nd,pnd->np",
+                              np.asarray(q[i, t], np.float32), k)
+                s /= np.sqrt(d)
+                p_ = np.exp(s - s.max(-1, keepdims=True))
+                p_ /= p_.sum(-1, keepdims=True)
+                out[i, t] = np.einsum("np,pnd->nd", p_, v)
+        return out
+
+    def test_fused_matches_unfused_and_reference(self):
+        q, ka, va, tables, lengths, limits, bs = self._case()
+        fused = paged_prefill_attention(q, ka, va, tables, lengths,
+                                        limits)
+        unfused = paged_prefill_attention_unfused(
+            q, ka, va, tables, lengths, limits)
+        np.testing.assert_allclose(np.asarray(fused), np.asarray(unfused),
+                                   atol=2e-5)
+        ref = self._reference(q, ka, va, tables, limits, bs)
+        np.testing.assert_allclose(np.asarray(fused), ref, atol=2e-5)
+        # the all-padding slot (limit 0 everywhere) emits exact zeros
+        assert np.abs(np.asarray(fused[1])).max() == 0.0
+
+    def test_int8_scales(self):
+        q, ka, va, tables, lengths, limits, bs = self._case()
+        qk, sk = _int8_quantize(np.asarray(ka))
+        qv, sv = _int8_quantize(np.asarray(va))
+        fused = paged_prefill_attention(
+            q, jnp.asarray(qk), jnp.asarray(qv), tables, lengths, limits,
+            k_scales=jnp.asarray(sk), v_scales=jnp.asarray(sv))
+        unfused = paged_prefill_attention_unfused(
+            q, jnp.asarray(qk), jnp.asarray(qv), tables, lengths, limits,
+            k_scales=jnp.asarray(sk), v_scales=jnp.asarray(sv))
+        np.testing.assert_allclose(np.asarray(fused), np.asarray(unfused),
+                                   atol=2e-5)
+        ref = self._reference(q, ka, va, tables, limits, bs)
+        np.testing.assert_allclose(np.asarray(fused), ref, atol=0.05)
 
 
 class TestFusedEpilogue:
@@ -198,6 +314,167 @@ class TestBlockAllocator:
             al.check()
         assert al.n_free + al.n_owned == 32
 
+    # ------------------------- ISSUE 12: refcount / copy-on-write
+
+    def test_shared_free_decrements_not_releases(self):
+        """The copy-on-write invariant: freeing a shared block removes
+        one holder — the block returns to the pool only from its LAST
+        holder."""
+        al = BlockAllocator(4)
+        (b,) = al.alloc(1, owner="writer")
+        al.share(b, "reader")
+        assert al.refcount(b) == 2
+        al.free([b], owner="writer")      # decrement, NOT release
+        assert al.n_free == 3 and al.refcount(b) == 1
+        al.check()
+        # the writer's hold is gone: a second writer-free is foreign
+        with pytest.raises(ValueError, match="owned by"):
+            al.free([b], owner="writer")
+        al.free([b], owner="reader")      # last holder -> pool
+        assert al.n_free == 4 and al.refcount(b) == 0
+        with pytest.raises(ValueError, match="double free"):
+            al.free([b], owner="reader")
+        al.check()
+
+    def test_share_guards(self):
+        al = BlockAllocator(2)
+        (b,) = al.alloc(1, owner="a")
+        with pytest.raises(ValueError, match="free block"):
+            al.share(1, "a")              # block 1 was never allocated
+        with pytest.raises(ValueError, match="already holds"):
+            al.share(b, "a")              # double hold by one owner
+        al.check()
+
+    def test_churn_with_sharing_strands_no_capacity(self):
+        """200 interleaved alloc/share/free steps: the refcounts must
+        drain exactly — at every step free + held partitions the pool,
+        and full release returns everything."""
+        rng = np.random.RandomState(9)
+        al = BlockAllocator(24)
+        held = {}                # owner -> list of blocks (ref held)
+        for step in range(200):
+            r = rng.rand()
+            if held and (al.n_free == 0 or r < 0.35):
+                key = rng.choice(list(held))
+                al.free(held.pop(key), owner=key)
+            elif held and r < 0.55:
+                # a new owner shares a random existing holder's blocks
+                # (the prefix-cache hit shape)
+                src = rng.choice(list(held))
+                key = f"s{step}"
+                for b in held[src]:
+                    al.share(b, key)
+                held[key] = list(held[src])
+            else:
+                n = int(rng.randint(1, 5))
+                if n <= al.n_free:
+                    key = f"r{step}"
+                    held[key] = al.alloc(n, owner=key)
+            al.check()
+        for key in list(held):
+            al.free(held.pop(key), owner=key)
+        al.check()
+        assert al.n_free == 24 and al.n_owned == 0
+
+
+class TestPrefixCache:
+    """The token-hash index over shared blocks (ISSUE 12)."""
+
+    def test_lookup_shares_longest_chain_and_caps(self):
+        al = BlockAllocator(8)
+        pc = PrefixCache(al, block_size=4)
+        toks = list(range(10, 22))           # 12 tokens = 3 full blocks
+        blocks = al.alloc(3, owner="w")
+        pc.insert(toks, blocks, upto_tokens=12)
+        assert len(pc) == 3
+        # identical prompt: capped so >= 1 token is left to recompute
+        hit = pc.lookup(toks, "r", max_blocks=(len(toks) - 1) // 4)
+        assert hit == blocks[:2] and pc.hits == 2
+        assert all(al.refcount(b) == 3 for b in hit)  # w + cache + r
+        # divergent second block: only the first block chains
+        other = toks[:4] + [99] * 8
+        hit2 = pc.lookup(other, "r2", max_blocks=2)
+        assert hit2 == blocks[:1]
+        al.free(hit, "r")
+        al.free(hit2, "r2")
+        pc.check()
+
+    def test_insert_only_covers_written_tokens(self):
+        """Blocks whose K/V has not landed must not be indexed — a
+        same-tick hit would read garbage."""
+        al = BlockAllocator(8)
+        pc = PrefixCache(al, block_size=4)
+        toks = list(range(8))
+        blocks = al.alloc(2, owner="w")
+        pc.insert(toks, blocks, upto_tokens=5)   # only block 0 complete
+        assert len(pc) == 1
+        pc.insert(toks, blocks, upto_tokens=8)   # chunk 2 lands
+        assert len(pc) == 2
+
+    def test_blocked_admit_rolls_back_hit_accounting(self):
+        """A FIFO head that hits the cache but cannot admit (pool full)
+        hands its shared refs back AND un-counts the hits — a head
+        stuck for N ticks must not inflate serving/prefix_cache_hits N
+        times with blocks that were never served."""
+        from apex_tpu.serving.kv_cache import KVCacheConfig
+        from apex_tpu.serving.scheduler import Scheduler
+
+        cache = KVCacheConfig(n_layers=1, n_blocks=4, block_size=4,
+                              kv_heads=1, head_dim=8, max_seq=32)
+        sched = Scheduler(cache, max_batch=3, chunk_tokens=8)
+        a = sched.submit(list(range(8)), 4)          # 2 full blocks
+        hog = sched.submit(list(range(20, 27)), 4)   # 2 more blocks
+        assert sched.admit() == [a, hog]
+        sched.note_prefilled(a, 8)     # a's 2 prompt blocks now cached
+        assert len(sched.prefix_cache) == 2
+        c = sched.submit(list(range(8)), 4)          # would hit a's chain
+        for _ in range(5):             # pool is full: head blocks
+            assert sched.admit() == []
+        assert sched.prefix_cache.hits == 0, \
+            "phantom hits counted for blocks that were handed back"
+        sched.allocator.check()
+        # capacity appears -> the head admits and the hit finally counts
+        sched.note_prefilled(hog, 7)
+        sched.finish(a)
+        sched.finish(hog)
+        assert sched.admit() == [c]
+        assert c.hit_blocks == 1 and sched.prefix_cache.hits == 1
+
+    def test_evict_is_lru_and_skips_shared(self):
+        al = BlockAllocator(8)
+        pc = PrefixCache(al, block_size=4)
+        # 5-token sequences: one full shareable block each, one token
+        # always left to recompute (the enforced CoW cap)
+        a_toks, b_toks = [1] * 4 + [9], [2] * 4 + [9]
+        (a,) = al.alloc(1, owner="wa")
+        (b,) = al.alloc(1, owner="wb")
+        pc.insert(a_toks, [a], 4)
+        pc.insert(b_toks, [b], 4)
+        al.free([a], "wa")
+        al.free([b], "wb")          # both now cache-only (evictable)
+        assert pc.lookup(a_toks, "reader") == [a]   # a: shared + MRU
+        assert pc.evictable() == 1
+        assert pc.evict_one() == b  # LRU *sole-holder* entry
+        assert pc.evict_one() is None   # a is shared: not evictable
+        assert pc.evict_many(4) == 0    # the sweep skips it too
+        al.free([a], "reader")
+        assert pc.evict_many(4) == 1    # now sole-holder: one sweep
+        assert al.n_free == 8 and pc.evictions == 2
+        pc.check()
+
+    def test_lookup_enforces_the_recompute_cap(self):
+        """A block-aligned prompt must never be fully served from
+        cache — lookup itself caps at (len-1)//block_size even when the
+        caller passes no max_blocks (writes stay off shared blocks by
+        construction)."""
+        al = BlockAllocator(8)
+        pc = PrefixCache(al, block_size=4)
+        toks = list(range(8))                 # exactly 2 full blocks
+        blocks = al.alloc(2, owner="w")
+        pc.insert(toks, blocks, 8)
+        assert pc.lookup(toks, "r") == blocks[:1]   # never both
+        al.free(blocks[:1], "r")
+
 
 # ----------------------------------------------------------------- engine
 
@@ -246,6 +523,13 @@ def _build_engine(tp, serving=None, **cfg_kw):
     return mesh, cfg, eng
 
 
+def _sampling_zeros(B):
+    """Greedy policy arrays (temperature 0) for direct program calls."""
+    return (np.zeros((B,), np.float32), np.zeros((B,), np.int32),
+            np.ones((B,), np.float32), np.zeros((B,), np.uint32),
+            np.zeros((B,), np.int32))
+
+
 def _teacher_forced_parity(eng, seq, prefix_len):
     """Prefill ``seq[:prefix_len]``, then decode the rest teacher-forced;
     every step's logits must match a fresh full prefill of the prefix."""
@@ -253,29 +537,31 @@ def _teacher_forced_parity(eng, seq, prefix_len):
 
     cache = eng.cache
     bs = cache.block_size
-    L = eng.prefill_len
-    blocks = list(range(cache.max_blocks_per_request))
+    B, T = eng.serving.max_batch, eng.prefill_len
+    mb = cache.max_blocks_per_request
+    blocks = list(range(mb))
+    tables = np.zeros((B, mb), np.int32)
+    tables[0, :mb] = blocks
 
-    def prefill_logits(upto, k, v):
-        tokens = np.zeros((1, L), np.int32)
+    def prefill_logits(upto, arenas):
+        tokens = np.zeros((B, T), np.int32)
         tokens[0, :upto] = seq[:upto]
-        pos = np.zeros((1, L), np.int32)
+        pos = np.zeros((B, T), np.int32)
         pos[0, :upto] = np.arange(upto)
-        seg = np.zeros((1, L), np.int32)
-        seg[0, :upto] = 1
-        db = np.full((L,), cache.n_blocks, np.int32)
-        do = np.zeros((L,), np.int32)
-        for t in range(upto):
-            db[t] = blocks[t // bs]
-            do[t] = t % bs
-        return eng._prefill(k, v, eng.params, tokens, pos, seg, db, do)
+        limits = np.zeros((B, T), np.int32)
+        limits[0, :upto] = np.arange(1, upto + 1)
+        lengths = np.zeros((B,), np.int32)
+        lengths[0] = upto
+        db = np.full((B, T), cache.n_blocks, np.int32)
+        do = np.zeros((B, T), np.int32)
+        db[0, :upto] = [blocks[t // bs] for t in range(upto)]
+        do[0, :upto] = [t % bs for t in range(upto)]
+        sample_index = np.full((B,), T, np.int32)
+        return eng._prefill(arenas, eng.params, tokens, pos,
+                            jnp.asarray(tables), lengths, limits, db, do,
+                            sample_index, *_sampling_zeros(B))
 
-    k, v = eng.arenas
-    k, v, _, _ = prefill_logits(prefix_len, k, v)
-    tables = np.zeros((eng.serving.max_batch,
-                       cache.max_blocks_per_request), np.int32)
-    tables[0, :len(blocks)] = blocks
-    B = eng.serving.max_batch
+    arenas, _, _ = prefill_logits(prefix_len, eng.arenas)
     max_err = 0.0
     for t in range(prefix_len, len(seq)):
         toks = np.zeros((B, 1), np.int32)
@@ -284,11 +570,12 @@ def _teacher_forced_parity(eng, seq, prefix_len):
         pos[0] = t
         act = np.zeros((B,), bool)
         act[0] = True
-        k, v, _, logits = eng._decode(k, v, eng.params, toks, pos,
-                                      jnp.asarray(tables), act)
-        k2, v2 = init_kv_arena(cache, eng.mesh, eng.tp_axis)
-        _, _, _, full = prefill_logits(t + 1, k2, v2)
-        err = float(jnp.max(jnp.abs(logits[0] - full[t])))
+        arenas, _, logits = eng._decode(
+            arenas, eng.params, toks, pos, jnp.asarray(tables), act,
+            *_sampling_zeros(B))
+        arenas2 = init_kv_arena(cache, eng.mesh, eng.tp_axis)
+        _, _, full = prefill_logits(t + 1, arenas2)
+        err = float(jnp.max(jnp.abs(logits[0] - full[0, t])))
         max_err = max(max_err, err)
     return max_err
 
@@ -336,9 +623,16 @@ def test_join_leave_churn_zero_recompiles():
                 break
         eng.run_until_drained()
         assert eng.decode_compile_count() == 1
+        assert eng.prefill_compile_count() == 1
         eng.scheduler.allocator.check()
-        assert eng.scheduler.allocator.n_free == \
-            eng.scheduler.allocator.n_blocks
+        # a drained pool is free blocks + prefix-cached blocks (finished
+        # requests' full blocks stay behind as evictable capacity)
+        al = eng.scheduler.allocator
+        pc = eng.scheduler.prefix_cache
+        assert al.n_free + pc.n_blocks == al.n_blocks
+        assert all(al.refcount(b) == 1
+                   for b in pc._entries.values())   # cache-only holds
+        pc.check()
         return [r.output_tokens for r in reqs]
 
     assert run(True) == run(False)
@@ -391,6 +685,170 @@ def test_cache_dtype_bf16_serves():
         return r.output_tokens
 
     assert run(jnp.bfloat16) == run(jnp.float32)
+
+
+# ------------------------------------------------- ISSUE 12: occupancy
+
+
+def _wave(seed=5, n=6):
+    rng = np.random.RandomState(seed)
+    return [(rng.randint(1, VOCAB - 1, size=rng.randint(4, 14)).tolist(),
+             int(rng.randint(6, 14))) for _ in range(n)]
+
+
+def _run_wave(wave, *, n_blocks=None, admission="occupancy",
+              prefill_len=8, sampling=None, cache_dtype=None):
+    _, _, eng = _build_engine(
+        tp=1, serving=ServingConfig(
+            max_batch=4, block_size=4, max_seq=MAX_SEQ,
+            prefill_len=prefill_len, n_blocks=n_blocks,
+            admission=admission, cache_dtype=cache_dtype))
+    reqs = [eng.submit(p, n, sampling=sampling) for p, n in wave]
+    eng.run_until_drained(max_steps=2000)
+    eng.scheduler.allocator.check()
+    assert eng.decode_compile_count() == 1
+    assert eng.prefill_compile_count() == 1
+    return eng, [r.output_tokens for r in reqs]
+
+
+def test_occupancy_2x_oversubscription_finishes_all():
+    """The ISSUE 12 acceptance bar: with the pool at a fraction of the
+    worst-case demand, occupancy admission (grow + evict + preempt with
+    recompute-on-readmit) still FINISHES every admitted request, with
+    streams token-identical to an ample-pool run, zero recompiles, and
+    the preemption machinery demonstrably exercised."""
+    wave = _wave()
+    _, ref = _run_wave(wave)                      # ample pool
+    worst = sum(-(-min(len(p) + n, MAX_SEQ) // 4) for p, n in wave)
+    eng, over = _run_wave(wave, n_blocks=max(8, worst // 4))
+    assert over == ref
+    assert all(r.state.value == "finished"
+               for r in eng.scheduler.running() or []) or \
+        eng.scheduler.idle
+    assert eng.scheduler.preemptions > 0, \
+        "the undersized pool never preempted — the test is not testing"
+    assert eng.scheduler.prefix_cache.evictions > 0
+    snap = eng.registry.snapshot()
+    assert snap["serving/preemptions"] == eng.scheduler.preemptions
+    assert snap["serving/evictions"] == eng.scheduler.prefix_cache.evictions
+
+
+def test_reserve_admission_is_the_pr8_baseline():
+    """admission='reserve' keeps worst-case reservation: same outputs,
+    no prefix cache, zero preemptions (requests just queue longer)."""
+    wave = _wave()
+    _, ref = _run_wave(wave)
+    worst = sum(-(-min(len(p) + n, MAX_SEQ) // 4) for p, n in wave)
+    eng, res = _run_wave(wave, n_blocks=max(8, worst // 4),
+                         admission="reserve")
+    assert res == ref
+    assert eng.scheduler.preemptions == 0
+    assert eng.scheduler.prefix_cache is None
+    assert eng.scheduler.allocator.n_free == \
+        eng.scheduler.allocator.n_blocks      # reserve frees fully
+
+
+def test_prefix_cache_hit_shares_blocks_and_matches_cold():
+    """A repeated prompt prefix hits the cache: blocks shared (counted
+    in serving/prefix_cache_hits), outputs identical to the cold run."""
+    _, _, eng = _build_engine(
+        tp=1, serving=ServingConfig(max_batch=2, block_size=4,
+                                    max_seq=MAX_SEQ, prefill_len=MAX_SEQ))
+    template = [7, 11, 13, 17, 19, 23, 29, 31]     # two full blocks
+    cold = eng.submit(template + [3], 4)
+    eng.run_until_drained()
+    assert cold.hit_blocks == 0
+    warm = eng.submit(template + [5], 4)
+    eng.run_until_drained()
+    assert warm.hit_blocks == 2                    # both template blocks
+    # identical full prompt: the whole prefix short of the cap is shared
+    again = eng.submit(template + [3], 4)
+    eng.run_until_drained()
+    assert again.hit_blocks == 2
+    assert again.output_tokens == cold.output_tokens
+    snap = eng.registry.snapshot()
+    assert snap["serving/prefix_cache_hits"] >= 4
+    assert eng.introspect()["prefix_cached_blocks"] > 0
+    eng.scheduler.prefix_cache.check()
+
+
+def test_chunked_prefill_matches_one_shot():
+    """A prompt longer than the chunk width slices across ticks and
+    produces exactly the one-shot engine's stream (and compiles the
+    prefill exactly once)."""
+    wave = [(list(range(1, 25)), 5), ([30, 31], 3)]   # 24 > chunk of 4
+    _, one_shot = _run_wave(wave, prefill_len=MAX_SEQ)
+    eng, chunked = _run_wave(wave, prefill_len=4)
+    assert chunked == one_shot
+
+
+def test_sampling_policies_reproducible_and_data_only():
+    """Seeded sampling redraws the same stream; top_k=1 degenerates to
+    greedy; mixing policies in one batch is data, never shape (zero
+    decode recompiles across the whole mix)."""
+    wave = [([9, 8, 7], 6), ([4, 5], 6)]
+    sp = SamplingParams(temperature=1.5, top_p=0.9, seed=42)
+    _, a = _run_wave(wave, sampling=sp, prefill_len=MAX_SEQ)
+    _, b = _run_wave(wave, sampling=sp, prefill_len=MAX_SEQ)
+    assert a == b                                   # same seeds, same stream
+    _, greedy = _run_wave(wave, prefill_len=MAX_SEQ)
+    _, k1 = _run_wave(wave, prefill_len=MAX_SEQ,
+                      sampling=SamplingParams(temperature=2.0, top_k=1,
+                                              seed=7))
+    assert k1 == greedy                             # only the argmax survives
+    # mixed policies in ONE engine: churn through greedy + sampled slots
+    _, _, eng = _build_engine(
+        tp=1, serving=ServingConfig(max_batch=4, block_size=4,
+                                    max_seq=MAX_SEQ, prefill_len=MAX_SEQ))
+    r1 = eng.submit([9, 8, 7], 6)
+    r2 = eng.submit([9, 8, 7], 6, sampling=sp)
+    r3 = eng.submit([9, 8, 7], 6,
+                    sampling=SamplingParams(temperature=0.7, top_k=4,
+                                            seed=3))
+    eng.run_until_drained()
+    assert eng.decode_compile_count() == 1
+    assert r1.output_tokens == greedy[0][:6] or len(r1.output_tokens) == 6
+    assert all(0 <= t < VOCAB for r in (r1, r2, r3)
+               for t in r.output_tokens)
+    with pytest.raises(ValueError, match="temperature"):
+        SamplingParams(temperature=-1.0)
+    with pytest.raises(ValueError, match="top_p"):
+        SamplingParams(top_p=0.0)
+
+
+def test_sampled_stream_survives_preemption():
+    """The seeded-counter construction: a preempted sampled request
+    replayed through the chunked prefill redraws the SAME stream —
+    recompute-on-readmit does not fork a stochastic stream."""
+    wave = _wave(seed=12, n=5)
+    sp = SamplingParams(temperature=1.0, top_p=0.95, seed=99)
+    _, ample = _run_wave(wave, sampling=sp)
+    worst = sum(-(-min(len(p) + n, MAX_SEQ) // 4) for p, n in wave)
+    eng, tight = _run_wave(wave, sampling=sp, n_blocks=max(8, worst // 4))
+    assert eng.scheduler.preemptions > 0
+    assert tight == ample
+
+
+def test_int8_cache_greedy_identity():
+    """int8 KV (per-row scales, in-kernel dequant) emits the same
+    greedy tokens as the fp32 cache on this model — including under
+    occupancy pressure."""
+    wave = _wave(seed=3, n=5)
+    _, fp32 = _run_wave(wave)
+    eng, i8 = _run_wave(wave, cache_dtype=jnp.int8)
+    assert i8 == fp32
+    assert eng.cache.quantized and len(eng.arenas) == 4
+    worst = sum(-(-min(len(p) + n, MAX_SEQ) // 4) for p, n in wave)
+    eng2, i8_tight = _run_wave(wave, cache_dtype=jnp.int8,
+                               n_blocks=max(8, worst // 4))
+    assert i8_tight == fp32
+    assert eng2.scheduler.preemptions + \
+        eng2.scheduler.prefix_cache.evictions > 0
+
+
+def test_serving_config_validates_admission():
+    with pytest.raises(ValueError, match="admission"):
+        ServingConfig(admission="optimistic")
 
 
 @pytest.mark.slow
@@ -471,7 +929,9 @@ def test_scheduler_rejects_unserviceable_request():
 
 def test_engine_rejects_oversized_prompt_and_position_table():
     _, cfg, eng = _build_engine(tp=1)
-    with pytest.raises(ValueError, match="prefill_len"):
+    # chunked prefill removed the prefill_len bound (a long prompt just
+    # slices across ticks); the context cap is the one real limit
+    with pytest.raises(ValueError, match="max_seq"):
         eng.submit(list(range(MAX_SEQ + 4)), 2)
     with pytest.raises(ValueError, match="max_seq"):
         ServingEngine(cfg, ServingConfig(max_batch=2, block_size=4,
