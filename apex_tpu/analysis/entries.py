@@ -304,10 +304,12 @@ def _reshard() -> List[Program]:
 
 @_entry("serving_decode")
 def _serving_decode() -> List[Program]:
-    """The ISSUE 9/12 serving runtime's decode step at tp=2 (jit-stable
-    ``[max_batch, 1]`` continuous-batching shape, now with the
-    eviction/preemption churn AND the per-slot sampling policies riding
-    as ``[max_batch]`` data): the APX204 donation audit is the point —
+    """The ISSUE 9/12/13 serving runtime's decode step at tp=2 (the
+    jit-stable continuous-batching shape — since ISSUE 13 the
+    ``[max_batch, k + 1]`` speculative verify, with per-slot draft
+    counts, eviction/preemption churn AND the sampling policies all
+    riding as ``[max_batch]`` data): the APX204 donation audit is the
+    point —
     the paged KV arenas are the largest HBM tenant of a serving chip
     and MUST alias in->out through the step (both leaves of the arenas
     tuple, hence the exact floor of 2); a dropped ``donate_argnums`` or
@@ -323,7 +325,8 @@ def _serving_decode() -> List[Program]:
     import numpy as np
 
     from apex_tpu import parallel
-    from apex_tpu.serving import ServingConfig, ServingEngine
+    from apex_tpu.serving import (
+        ServingConfig, ServingEngine, SpeculativeConfig)
     from apex_tpu.transformer.testing import TransformerConfig
     from apex_tpu.transformer.testing.gpt_parallel_train import build_gpt_3d
 
@@ -338,17 +341,20 @@ def _serving_decode() -> List[Program]:
     params, _ = init_fn(jax.random.PRNGKey(0), jnp.zeros((2, 4), jnp.int32))
     eng = ServingEngine(
         cfg, ServingConfig(max_batch=2, block_size=4, max_seq=16,
-                           prefill_len=16),
+                           prefill_len=16,
+                           speculative=SpeculativeConfig(k=2)),
         params, mesh=mesh)
     b = eng.serving.max_batch
+    S = eng.spec_width
     mb = eng.cache.max_blocks_per_request
     sampling = (np.zeros((b,), np.float32), np.zeros((b,), np.int32),
                 np.ones((b,), np.float32), np.zeros((b,), np.uint32),
                 np.zeros((b,), np.int32))
     decode_args = (
         eng.arenas, eng.params,
-        np.zeros((b, 1), np.int32), np.zeros((b,), np.int32),
-        jnp.zeros((b, mb), jnp.int32), np.zeros((b,), bool)) + sampling
+        np.zeros((b, S), np.int32), np.zeros((b,), np.int32),
+        jnp.zeros((b, mb), jnp.int32), np.zeros((b,), bool),
+        np.zeros((b,), np.int32)) + sampling
     T = eng.prefill_len
     prefill_args = (
         eng.arenas, eng.params,

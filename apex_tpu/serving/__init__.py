@@ -19,9 +19,15 @@ package turns those checkpoints into a *serving* runtime —
   elementwise+reduction HLOs — the operation-fusion paper's decode
   finding, PAPERS.md arxiv 2502.17728).
 - :mod:`.model` — prefill/decode split over the *training* layers:
-  prefill reuses the flash-attention kernel (segment ids give packed
-  multi-request prefill), decode is a fixed-shape ``[max_batch, 1]``
-  step reusing ``ColumnParallelLinear``/``RowParallelLinear`` and RoPE.
+  chunked prefill through the paged multi-query kernel, decode a
+  fixed-shape ``[max_batch, spec_width]`` step reusing
+  ``ColumnParallelLinear``/``RowParallelLinear`` and RoPE — the
+  speculative k+1 verify when drafting is on (ISSUE 13), the classic
+  one-token tick when it is not.
+- :mod:`.speculative` — self-speculative n-gram / prompt-lookup
+  drafting (no second model): host-side proposals verified in-graph
+  with per-slot adaptive back-off; rejection rollback is O(1) pointer
+  and length moves on the paged cache (never a KV copy).
 - :mod:`.scheduler` / :mod:`.engine` — continuous (in-flight)
   batching: requests join and leave mid-flight with ZERO decode-step
   recompiles (all churn is data, never shape), latency
@@ -56,6 +62,11 @@ from apex_tpu.serving.paged_attention import (
 )
 from apex_tpu.serving.sampling import SamplingParams
 from apex_tpu.serving.scheduler import Request, RequestState, Scheduler
+from apex_tpu.serving.speculative import (
+    NGramProposer,
+    SpeculativeConfig,
+    ngram_propose,
+)
 from apex_tpu.serving.engine import ServingConfig, ServingEngine
 from apex_tpu.serving.loader import restore_gpt_for_serving
 from apex_tpu.serving.replica import ReplicaProcess, ReplicaSpec
@@ -66,6 +77,7 @@ __all__ = [
     "FleetRequest",
     "FleetRouter",
     "KVCacheConfig",
+    "NGramProposer",
     "OutOfBlocksError",
     "PrefixCache",
     "ReplicaProcess",
@@ -76,7 +88,9 @@ __all__ = [
     "Scheduler",
     "ServingConfig",
     "ServingEngine",
+    "SpeculativeConfig",
     "init_kv_arena",
+    "ngram_propose",
     "paged_attention_decode",
     "paged_attention_decode_unfused",
     "paged_prefill_attention",

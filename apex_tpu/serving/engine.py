@@ -22,8 +22,16 @@ Step anatomy (:meth:`ServingEngine.step`)::
                   -> grow decode blocks        (evict cached LRU, then
                                                preempt newest)
                   -> one batched decode step   (paged attention +
-                                               in-graph sampling)
-                  -> append/finish bookkeeping (host)
+                                               in-graph sampling; with
+                                               speculation: the k+1
+                                               verify — n-gram drafts
+                                               proposed host-side,
+                                               verified in-graph, the
+                                               accepted prefix emitted
+                                               as 1..k+1 tokens)
+                  -> append/finish bookkeeping (host; rejection = the
+                                               length never advances —
+                                               O(1), no KV copies)
 
 Metric catalog (rank-aware registry, docs/observability.md +
 docs/serving.md):
@@ -46,6 +54,11 @@ docs/serving.md):
 - ``serving/evictions``    counter — prefix-cache blocks returned to
   the free list under pool pressure
 - ``serving/preemption_drains`` counter
+- ``serving/spec_proposed`` / ``serving/spec_accepted`` counters —
+  drafted tokens entering the k+1 verify and the drafts it accepted
+  (ISSUE 13; zero when ``ServingConfig.speculative`` is off)
+- ``serving/spec_acceptance`` gauge — lifetime accepted/proposed ratio
+  (the drafting hit rate the adaptive back-off steers on)
 - ``serving/mfu``          gauge — decode-step MFU when the device peak
   is known (``introspect()["mfu_reason"]`` says why otherwise)
 
@@ -76,6 +89,7 @@ from apex_tpu.serving.kv_cache import (
 from apex_tpu.serving.model import DecodeModel
 from apex_tpu.serving.sampling import SamplingParams
 from apex_tpu.serving.scheduler import Request, RequestState, Scheduler
+from apex_tpu.serving.speculative import NGramProposer, SpeculativeConfig
 
 __all__ = ["ServingConfig", "ServingEngine"]
 
@@ -93,6 +107,11 @@ class ServingConfig:
     copy-on-write prompt-prefix sharing (occupancy mode only).
     ``cache_dtype=jnp.int8`` stores the KV arenas quantized with
     per-row fp32 scales dequantized inside the paged kernels.
+    ``speculative`` (a :class:`~apex_tpu.serving.speculative.
+    SpeculativeConfig`, ISSUE 13) turns the decode step into the
+    ``[max_batch, k + 1]`` self-speculative verify — ``k + 1`` pins the
+    compiled decode shape (one compile; per-slot draft counts are
+    data); ``None`` keeps the plain one-token step.
     """
 
     max_batch: int = 8           # concurrent decode slots
@@ -105,6 +124,7 @@ class ServingConfig:
     fuse_epilogue: bool = True     # fused residual/norm epilogue kernel
     admission: str = "occupancy"   # or "reserve" (PR 8 worst-case A/B)
     prefix_caching: bool = True    # share prompt-prefix blocks
+    speculative: Optional[SpeculativeConfig] = None  # n-gram drafting
 
     def __post_init__(self):
         if self.admission not in ("occupancy", "reserve"):
@@ -166,6 +186,17 @@ class ServingEngine:
             raise ValueError(
                 f"max_seq ({serving.max_seq}) exceeds the learned position "
                 f"table ({config.max_position_embeddings})")
+        # speculative decode (ISSUE 13): the decode step's query width
+        # is k+1 — a compile-time constant; per-slot draft counts are
+        # data, so acceptance churn never recompiles
+        self.spec = serving.speculative
+        self.spec_width = 1 + (self.spec.k if self.spec is not None else 0)
+        if serving.max_seq < self.spec_width:
+            raise ValueError(
+                f"max_seq ({serving.max_seq}) below the speculative "
+                f"width ({self.spec_width})")
+        self.proposer = (NGramProposer(self.spec)
+                         if self.spec is not None else None)
 
         cache_dtype = (serving.cache_dtype if serving.cache_dtype is not None
                        else config.param_dtype)
@@ -212,8 +243,9 @@ class ServingEngine:
         rep = P()
         decode_body = cc.shard_over(
             self.model.decode_step, mesh=self.mesh,
-            in_specs=(arena_specs, self.param_specs) + (rep,) * 9,
-            out_specs=(arena_specs, P(None), P(None, None)),
+            in_specs=(arena_specs, self.param_specs) + (rep,) * 10,
+            out_specs=(arena_specs, P(None, None), P(None),
+                       P(None, None, None)),
         )
         prefill_body = cc.shard_over(
             self.model.prefill, mesh=self.mesh,
@@ -244,9 +276,14 @@ class ServingEngine:
             (serving.max_batch, self.cache.max_blocks_per_request),
             np.int32)
         self._steps = 0
+        self._decode_calls = 0         # device decode/verify invocations
+        self._slot_steps = 0           # per-slot verify participations
+        #                                (mean accept length denominator)
         self._counted_preempts = 0     # flushed-so-far deltas
         self._counted_hits = 0
         self._counted_evictions = 0
+        self.spec_proposed = 0         # drafted tokens (lifetime)
+        self.spec_accepted = 0         # drafts accepted by the verify
         # MFU bookkeeping (ISSUE 10 satellite): FLOPs of the decode
         # program probed once (lazily, pre-donation), last decode wall
         # time measured each step; serving/mfu flushed as a gauge when
@@ -394,7 +431,9 @@ class ServingEngine:
             top_k[req.slot] = s.top_k
             top_p[req.slot] = s.top_p
             seeds[req.slot] = s.seed & 0xFFFFFFFF
-            steps[req.slot] = len(req.output_tokens)
+            # step_offset rebases the draw counter for fleet failover
+            # replays (prompt already carries the emitted prefix)
+            steps[req.slot] = s.step_offset + len(req.output_tokens)
         return temp, top_k, top_p, seeds, steps
 
     def _prefill_tick(self) -> None:
@@ -461,8 +500,22 @@ class ServingEngine:
 
     # -------------------------------------------------------------- decode
 
+    def _propose_drafts(self, req: Request) -> List[int]:
+        """Ask the proposer for this tick's drafts, clamped to the
+        verify width, the context cap, and the remaining budget (the
+        verify's own output covers the final token, so a request one
+        token from its budget drafts nothing)."""
+        if self.proposer is None:
+            return []
+        max_k = min(self.spec_width - 1,
+                    self.cache.max_seq - (req.cache_len + 1),
+                    req.max_new_tokens - len(req.output_tokens) - 1)
+        if max_k <= 0:
+            return []
+        return list(self.proposer.propose(req, max_k))[:max_k]
+
     def _decode_once(self) -> None:
-        B = self.serving.max_batch
+        B, S = self.serving.max_batch, self.spec_width
         # a request at the context cap cannot write another token:
         # deliver what it has (truncation is a response, not a hang)
         for req in list(self.scheduler.running()):
@@ -475,27 +528,44 @@ class ServingEngine:
             (r for r in self.scheduler.running() if not r.prefilling),
             key=lambda r: r.admit_seq)
         reqs: List[Request] = []
+        drafts: dict = {}
         for req in decoding:
             if req.slot is None or req.state is not RequestState.RUNNING:
                 continue    # preempted by an older request's growth
             covered = self.scheduler.try_grow_to(req, req.cache_len + 1)
-            if covered >= req.cache_len + 1:
-                reqs.append(req)
+            if covered < req.cache_len + 1:
+                continue
+            draft = self._propose_drafts(req)
+            if draft:
+                # blocks for drafted rows come from the free list or the
+                # cache LRU only, NEVER preemption: speculation is an
+                # optimization and must not evict a neighbour's real KV.
+                # A short grow just truncates the draft (data, not shape).
+                covered = self.scheduler.try_grow_to(
+                    req, req.cache_len + 1 + len(draft), preempt=False)
+                draft = draft[:max(0, covered - (req.cache_len + 1))]
+            drafts[req.rid] = draft
+            reqs.append(req)
         if not reqs:
             return
-        tokens = np.zeros((B, 1), np.int32)
+        tokens = np.zeros((B, S), np.int32)
         positions = np.zeros((B,), np.int32)
         active = np.zeros((B,), bool)
+        n_draft = np.zeros((B,), np.int32)
         for req in reqs:
+            d = drafts[req.rid]
             tokens[req.slot, 0] = req.last_token
+            if d:
+                tokens[req.slot, 1:1 + len(d)] = d
             positions[req.slot] = req.cache_len
             active[req.slot] = True
+            n_draft[req.slot] = len(d)
         self._refresh_tables()
         samp = self._sampling_arrays()
 
         tables = self._jnp.asarray(self._tables)
         args = (self.arenas, self.params, tokens, positions, tables,
-                active) + samp
+                active, n_draft) + samp
         if not self._flops_probed:
             # One-time FLOPs probe for the MFU gauge: lowering traces
             # the decode body (no second XLA compile, no execution —
@@ -504,15 +574,47 @@ class ServingEngine:
             # call below consumes the donated arenas.
             self._probe_decode_flops(args)
         t0 = time.perf_counter()
-        self.arenas, next_tokens, _ = self._decode(*args)
-        next_np = np.asarray(next_tokens)
+        self.arenas, out_tokens, accepted, _ = self._decode(*args)
+        out_np = np.asarray(out_tokens)
+        acc_np = np.asarray(accepted)
         self._last_decode_s = time.perf_counter() - t0
+        self._decode_calls += 1
+        self._slot_steps += len(reqs)
         self._refresh_mfu()
 
         now = time.monotonic()
+        proposed_total = accepted_total = 0
         for req in reqs:
-            req.cache_len += 1
-            self._emit(req, int(next_np[req.slot]), now)
+            d = drafts[req.rid]
+            acc = int(acc_np[req.slot])
+            if d:
+                proposed_total += len(d)
+                accepted_total += acc
+                if self.proposer is not None:
+                    self.proposer.observe(req, len(d), acc)
+            # rejection rollback is O(1) by construction: positions past
+            # the accepted prefix were written but cache_len simply does
+            # not advance over them — pointer/length moves on the host,
+            # no KV copies; the rows are overwritten by the next tick
+            req.cache_len += 1            # column 0: the real last token
+            for j in range(acc + 1):
+                if j > 0:
+                    req.cache_len += 1    # draft j == the token just
+                    #                       emitted — its row is real
+                self._emit(req, int(out_np[req.slot, j]), now)
+                if req.state is not RequestState.RUNNING:
+                    break                 # eos/budget: drop the rest
+        if proposed_total:
+            self.registry.counter("serving/spec_proposed").inc(
+                proposed_total)
+            self.spec_proposed += proposed_total
+        if accepted_total:
+            self.registry.counter("serving/spec_accepted").inc(
+                accepted_total)
+            self.spec_accepted += accepted_total
+        if self.spec_proposed:
+            self.registry.gauge("serving/spec_acceptance").set(
+                self.spec_accepted / self.spec_proposed)
 
     # ------------------------------------------------------------------ mfu
 
@@ -575,6 +677,13 @@ class ServingEngine:
             "prefix_cache_hits": (pc.hits if pc is not None else None),
             "evictions": (pc.evictions if pc is not None else None),
             "preemptions": sched.preemptions,
+            "spec_width": self.spec_width,
+            "spec_proposed": self.spec_proposed,
+            "spec_accepted": self.spec_accepted,
+            "spec_acceptance": (
+                round(self.spec_accepted / self.spec_proposed, 4)
+                if self.spec_proposed else None),
+            "decode_calls": self._decode_calls,
             "cache_dtype": str(np.dtype(self.cache.dtype)),
             "last_decode_ms": (round(self._last_decode_s * 1e3, 3)
                                if self._last_decode_s is not None else None),

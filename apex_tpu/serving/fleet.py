@@ -40,6 +40,17 @@ machinery), rejoin on handshake — under continuous load, with every
 request reaching a terminal state and p99 TPOT bounded (the smoke's
 staggered-roll matrix).
 
+ISSUE 13 satellites: **per-request sampling over the wire** (the PR 11
+``SamplingParams`` engine API fleet-routed; failover replay rebases the
+seeded draw counter by the emitted prefix, so a sampled stream is
+stitched bitwise like a greedy one), **prefix-cache affinity** (a
+tenant's requests prefer the replica whose ``PrefixCache`` plausibly
+holds their template blocks — a placement tie-break read off the
+``prefix_cache_hits``/``kv_occupancy`` state heartbeats, never
+overriding free-blocks/queue-depth pressure), and the **streaming
+client API** (:meth:`FleetRouter.stream` — an iterator over a
+request's tokens as events arrive, closed by the terminal state).
+
 The router is deliberately **jax-free and transport-agnostic**: it
 drives anything with the replica client surface (``alive``/``poll``/
 ``submit``/``begin_drain``/``close``), which is how
@@ -67,6 +78,7 @@ from typing import Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
+from apex_tpu.serving.sampling import SamplingParams
 from apex_tpu.serving.scheduler import RequestState
 
 __all__ = ["FleetRequest", "FleetRouter"]
@@ -85,6 +97,7 @@ class FleetRequest:
     eos_id: Optional[int] = None
     tenant: str = "default"
     priority: int = 0             # lower = more urgent (class 0 first)
+    sampling: Optional[SamplingParams] = None   # None = greedy
 
     state: RequestState = RequestState.WAITING
     output_tokens: List[int] = dataclasses.field(default_factory=list)
@@ -185,6 +198,7 @@ class FleetRouter:
                  heartbeat_timeout_s: float = 10.0,
                  probe_retries: int = 3, probe_backoff_s: float = 0.2,
                  max_attempts: int = 8, keep_done: int = 4096,
+                 affinity_occupancy_cap: float = 0.95,
                  registry=None, clock: Callable[[], float] = time.monotonic):
         from apex_tpu.observability.metrics import default_registry
 
@@ -225,6 +239,12 @@ class FleetRouter:
         self._pending: Dict[tuple, collections.deque] = {}
         self._tenant_pass: Dict[str, float] = {}
         self._tenant_weight: Dict[str, float] = {}
+        # prefix-cache affinity: tenant -> the replica that last served
+        # it (whose PrefixCache plausibly holds the tenant's template
+        # blocks); a placement tie-break, gated on the replica's
+        # heartbeat-reported kv_occupancy staying under the cap
+        self.affinity_occupancy_cap = affinity_occupancy_cap
+        self._tenant_affinity: Dict[str, str] = {}
 
     # ----------------------------------------------------------- tenants
 
@@ -264,16 +284,24 @@ class FleetRouter:
 
     def submit(self, prompt: Sequence[int], max_new_tokens: int,
                eos_id: Optional[int] = None, *, tenant: str = "default",
-               priority: int = 0) -> FleetRequest:
+               priority: int = 0,
+               sampling: Optional[SamplingParams] = None) -> FleetRequest:
         """Admit or shed.  Above ``max_queue_depth`` the request comes
         back REJECTED — a typed terminal state the caller can observe
         and retry against, never a silent hang — and
-        ``serving/requests_rejected`` counts it."""
+        ``serving/requests_rejected`` counts it.
+
+        ``sampling`` rides the replica wire per request (the PR 11
+        engine API, fleet-routed).  Failover replay stays stream-exact:
+        the engine keys draw i at ``seed, step_offset + i``, and every
+        dispatch rebases ``step_offset`` by the emitted prefix it
+        re-prefills — a survivor continues the SAME stochastic stream
+        the dead replica was emitting."""
         req = FleetRequest(
             rid=next(self._ids),
             prompt=np.asarray(prompt, np.int32),
             max_new_tokens=int(max_new_tokens), eos_id=eos_id,
-            tenant=tenant, priority=int(priority),
+            tenant=tenant, priority=int(priority), sampling=sampling,
             t_submit=time.monotonic())
         self.requests[req.rid] = req
         self.registry.counter("fleet/requests_submitted").inc()
@@ -528,18 +556,31 @@ class FleetRouter:
         return min(keys, key=lambda k: (
             self._tenant_pass.get(k[1], 0.0), k[1]))
 
-    def _pick_replica(self) -> Optional[_ReplicaView]:
+    def _pick_replica(self, tenant: Optional[str] = None
+                      ) -> Optional[_ReplicaView]:
         candidates = [v for v in self._views.values()
                       if v.dispatchable()
                       and v.in_flight() < self.replica_queue_limit]
         if not candidates:
             return None
-        # most free blocks first (the live admission signal scraped
-        # from introspect()), fewest assigned as the tiebreak
+        # Prefix-cache affinity (ISSUE 13 satellite): the replica that
+        # last served this tenant plausibly still holds the tenant's
+        # template blocks in its PrefixCache, so landing there turns
+        # the prefill into block shares (`serving/prefix_cache_hits`
+        # climbing on the state heartbeats is the visible effect).
+        # Strictly a TIE-BREAK: free blocks and queue depth dominate,
+        # and a replica whose reported kv_occupancy is past the cap is
+        # under pool pressure — affinity yields rather than force
+        # evictions of hotter blocks.
+        warm = self._tenant_affinity.get(tenant)
+
         def score(v: _ReplicaView):
-            free = (int(v.state.get("free_blocks", 0))
-                    if v.state is not None else 0)
-            return (-free, len(v.assigned), v.name)
+            state = v.state or {}
+            free = int(state.get("free_blocks", 0))
+            occ = float(state.get("kv_occupancy") or 0.0)
+            affine = (v.name == warm
+                      and occ < self.affinity_occupancy_cap)
+            return (-free, len(v.assigned), 0 if affine else 1, v.name)
 
         return min(candidates, key=score)
 
@@ -560,7 +601,7 @@ class FleetRouter:
             key = self._pick_tenant(priorities[0])
             if key is None:
                 break
-            view = self._pick_replica()
+            view = self._pick_replica(key[1])
             if view is None:
                 break  # no capacity anywhere: stays in the router pool
             req = self._pending[key].popleft()
@@ -571,11 +612,21 @@ class FleetRouter:
             # through the ordinary chunked-prefill admission path —
             # recovery needs no special-case decode state
             wire_prompt = list(map(int, req.prompt)) + req.output_tokens
+            # the replayed prefix consumed draw counters 0..len(emitted)
+            # on the dead replica; rebase the survivor's counter so the
+            # sampled stream CONTINUES instead of restarting
+            sampling = req.sampling
+            if sampling is not None and req.output_tokens:
+                sampling = dataclasses.replace(
+                    sampling, step_offset=sampling.step_offset
+                    + len(req.output_tokens))
             req.state = RequestState.RUNNING
             req.replica = view.name
             view.assigned[req.rid] = req
+            self._tenant_affinity[req.tenant] = view.name
             batches.setdefault(view.name, (view, []))[1].append(
-                (req.rid, wire_prompt, req.remaining, req.eos_id))
+                (req.rid, wire_prompt, req.remaining, req.eos_id,
+                 sampling))
         for view, items in batches.values():
             try:
                 if len(items) > 1 and hasattr(view.client, "submit_many"):
@@ -684,12 +735,16 @@ class FleetRouter:
                 "assigned": len(v.assigned),
                 "in_flight": v.in_flight(),
                 "free_blocks": (v.state or {}).get("free_blocks"),
+                "kv_occupancy": (v.state or {}).get("kv_occupancy"),
+                "prefix_cache_hits": (v.state or {}).get(
+                    "prefix_cache_hits"),
                 "ckpt_step": (v.meta or {}).get("ckpt_step"),
             }
         states = collections.Counter(
             r.state.value for r in self.requests.values())
         return {
             "replicas": replicas,
+            "tenant_affinity": dict(self._tenant_affinity),
             "queue_depth": self.total_queue_depth(),
             "pending": sum(len(q) for q in self._pending.values()),
             "requests": dict(states),
@@ -700,6 +755,51 @@ class FleetRouter:
         }
 
     # ---------------------------------------------------------- lifecycle
+
+    def stream(self, req, *, poll_s: float = 0.002,
+               timeout_s: float = 300.0):
+        """Iterate a request's tokens as router events surface them —
+        the streaming client API (ROADMAP fleet follow-on): callers
+        stop polling result buffers and consume the stream.
+
+        ``req``: a :class:`FleetRequest` or its rid.  Each iteration
+        **pumps the router** (the single-threaded driving model —
+        consuming a stream keeps the whole fleet moving, exactly like
+        :meth:`run_until_idle`), yields any newly-surfaced tokens, and
+        closes when the request reaches a terminal state.  Tokens
+        survive failover transparently: a replay appends to the same
+        ``output_tokens``, so the iterator just keeps yielding the
+        stitched (bitwise-identical) stream.  A shed/parked REJECTED
+        request yields nothing and closes immediately — the terminal
+        state is the caller's signal, same as the non-streaming path.
+        ``timeout_s`` is an **inactivity** bound — it resets on every
+        surfaced token, so a long healthy stream never trips it; only a
+        stream that goes silent (and that failover/attempt-parking has
+        not already driven to a terminal state) raises.
+        """
+        if not hasattr(req, "output_tokens"):
+            found = self.requests.get(req)
+            if found is None:
+                raise KeyError(f"unknown or evicted fleet request {req!r}")
+            req = found
+        sent = 0
+        deadline = self._clock() + timeout_s
+        while True:
+            progressed = sent < len(req.output_tokens)
+            while sent < len(req.output_tokens):
+                yield req.output_tokens[sent]
+                sent += 1
+            if req.done:
+                return
+            self.pump()
+            if progressed:
+                deadline = self._clock() + timeout_s
+            elif self._clock() > deadline:
+                raise RuntimeError(
+                    f"stream of request {req.rid} surfaced no token and "
+                    f"no terminal state for {timeout_s:.0f}s")
+            if poll_s and not progressed:
+                time.sleep(poll_s)
 
     def idle(self) -> bool:
         """True when every submitted request reached a terminal state."""

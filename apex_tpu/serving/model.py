@@ -19,11 +19,28 @@ inference-shaped entry points instead of a loss:
   long prompt sliceable across decode ticks (it never stalls a tick)
   and a prefix-cache hit a pure block-table entry.
 - :meth:`DecodeModel.decode_step` — the jit-stable continuous-batching
-  step: fixed ``[max_batch, 1]`` tokens, per-slot positions/tables and
-  an active mask; inactive slots are pure data (their cache writes are
-  routed out of range and dropped; their attention length is 0), so
-  requests joining/leaving/preempting never change a shape and the
-  step **never recompiles**.
+  step: fixed ``[max_batch, spec_width]`` tokens (``spec_width = k + 1``
+  with speculative decoding, 1 without — a compile-time constant of the
+  engine config), per-slot positions/tables, an active mask and a
+  per-slot ``n_draft``; inactive slots and unused draft positions are
+  pure data (their cache writes are routed out of range and dropped;
+  their attention limit is 0), so requests joining/leaving/preempting
+  and per-tick draft counts anywhere in ``[0, k]`` never change a shape
+  and the step **never recompiles**.
+
+  With drafts the step is the **fused k+1 verify** (ISSUE 13): each
+  slot's real last token plus its k drafted continuations attend in one
+  multi-query block sweep with per-position causal limits
+  (:func:`~apex_tpu.serving.paged_attention.paged_attention_decode`
+  with 4-D q), every position samples with the request's policy at its
+  own output index, and the accepted count — the longest prefix of
+  drafts matching the step's own outputs — is computed in-graph.
+  Accepted tokens are bitwise the tokens sequential decode would have
+  produced (each verified position is teacher-forced on an accepted
+  prefix), so speculation never changes a stream, only its arrival
+  rate.  Rejected drafts cost nothing to undo: their K/V rows sit past
+  the host-side length that was never advanced (the O(1) rollback —
+  pointer/length moves, no copies), and the next tick overwrites them.
 
 Both entry points **sample in-graph** (:mod:`.sampling`): per-slot
 temperature/top-k/top-p/seed/step ride as ``[max_batch]`` data, the
@@ -259,64 +276,115 @@ class DecodeModel:
     # ---------------------------------------------------------------- entry
 
     def decode_step(self, arenas, params, tokens, positions, block_tables,
-                    active, temperature, top_k, top_p, seeds, steps):
-        """One continuously-batched decode step (shard_map body).
+                    active, n_draft, temperature, top_k, top_p, seeds,
+                    steps):
+        """One continuously-batched decode/verify step (shard_map body).
 
         ``arenas`` — ``(k, v)`` or ``(k, v, k_scales, v_scales)``;
-        ``tokens [max_batch, 1]`` (each slot's last sampled/prompt
-        token), ``positions [max_batch]`` (the cache index this token
-        is written at — the slot's current length), ``block_tables
-        [max_batch, max_blocks]``, ``active [max_batch]`` bool, and the
-        ``[max_batch]`` sampling-policy arrays (:mod:`.sampling` —
-        ``steps`` is each slot's output-token counter, the seed
-        fold-in).  Every shape is fixed by the engine config; request
-        churn, preemption, eviction and policy changes only move
-        values.  Returns ``(arenas, next_tokens [max_batch],
-        logits [max_batch, vocab])``.
+        ``tokens [max_batch, S]`` where ``S = spec_width`` (column 0 is
+        each slot's last sampled/prompt token, columns ``1..n_draft``
+        its drafted continuations, the rest padding), ``positions
+        [max_batch]`` (the cache index column 0 is written at — the
+        slot's current length), ``block_tables
+        [max_batch, max_blocks]``, ``active [max_batch]`` bool,
+        ``n_draft [max_batch]`` (0..S-1, per-slot draft count — DATA),
+        and the ``[max_batch]`` sampling-policy arrays (:mod:`.sampling`
+        — ``steps`` is each slot's output-token counter, the seed
+        fold-in; verify position t draws at counter ``steps + t``).
+        Every shape is fixed by the engine config; request churn,
+        preemption, eviction, draft counts and policy changes only move
+        values.  Returns ``(arenas, out_tokens [max_batch, S],
+        accepted [max_batch], logits [max_batch, S, vocab])`` —
+        ``accepted`` is the longest prefix of drafts matching the
+        step's own outputs, so the host emits ``out_tokens[:, :a + 1]``
+        and advances lengths by ``a + 1`` (rejection is a length that
+        simply never advances — nothing to copy back).
         """
         cfg = self.cfg
         cache = self.cache
         bs = cache.block_size
-        b = tokens.shape[0]
+        B, S = tokens.shape
         positions = positions.astype(jnp.int32)
-        lengths = jnp.where(active, positions + 1, 0).astype(jnp.int32)
-        # this step's cache write destination; inactive slots write out
-        # of range and the scatter drops them
-        logical = positions // bs
-        phys = jnp.take_along_axis(
-            block_tables, logical[:, None], axis=1)[:, 0]
-        phys = jnp.where(active, phys, cache.n_blocks).astype(jnp.int32)
-        offs = (positions % bs).astype(jnp.int32)
+        n_draft = n_draft.astype(jnp.int32)
+        offsets = lax.broadcasted_iota(jnp.int32, (B, S), 1)
+        pos_ids = positions[:, None] + offsets          # [B, S]
+        live = active[:, None] & (offsets <= n_draft[:, None])
+        # per-position causal horizon: verify token t sees cache
+        # positions < pos + t + 1 (its own row included — scattered
+        # below, before the attention, the prefill convention)
+        limits = jnp.where(live, pos_ids + 1, 0).astype(jnp.int32)
+        lengths = jnp.where(active, positions + n_draft + 1,
+                            0).astype(jnp.int32)
+        # cache write destinations; inactive slots and padding columns
+        # write out of range and the scatter drops them
+        logical = jnp.clip(pos_ids // bs, 0, block_tables.shape[1] - 1)
+        phys = jnp.take_along_axis(block_tables, logical, axis=1)
+        dest_blocks = jnp.where(live, phys,
+                                cache.n_blocks).astype(jnp.int32)
+        dest_offsets = (pos_ids % bs).astype(jnp.int32)
 
         if cfg.position_embedding_type == "learned":
             x = self.embed.apply({"params": params.embedding}, tokens,
-                                 positions[:, None])
+                                 pos_ids)
         else:
             x = self.embed.apply({"params": params.embedding}, tokens)
-        # x: [1, max_batch, hidden]
-        rope = self._rope_tables(positions, x.dtype)
+        # x: [S, max_batch, hidden]
+        rope = None
+        if cfg.position_embedding_type == "rope":
+            if S == 1:
+                rope = self._rope_tables(positions, x.dtype)
+            else:
+                cos, sin = self._rope_tables(pos_ids.reshape(-1), x.dtype)
+                rope = (cos.reshape(B, S, -1).transpose(1, 0, 2),
+                        sin.reshape(B, S, -1).transpose(1, 0, 2))
 
         attend = (paged_attention_decode if self.fused_attention
                   else paged_attention_decode_unfused)
 
         def attn_core(q, k, v, layer_arenas):
+            # q [S, B, n_local, d]; k/v [S, B, g_local, d]
             if rope is not None:
                 cos, sin = rope
-                q = apply_rotary_decode(q, cos, sin)
-                k = apply_rotary_decode(k, cos, sin)
-            # append this token's K/V, then attend over the paged cache
+                rot = apply_rotary_decode if S == 1 else apply_rotary_packed
+                q = rot(q, cos, sin)
+                k = rot(k, cos, sin)
+            # append the K/V rows, then attend over the paged cache
             layer_arenas = self._append_rows(
-                layer_arenas, phys, offs, k[0], v[0])
+                layer_arenas, dest_blocks, dest_offsets,
+                k.transpose(1, 0, 2, 3), v.transpose(1, 0, 2, 3))
             kv, sc = self._attend_kwargs(layer_arenas)
-            ctx = attend(q[0], *kv, block_tables, lengths, **sc)
-            return ctx.reshape(1, b, -1).astype(q.dtype), layer_arenas
+            if S == 1:
+                # the single-token kernel: the non-speculative engine
+                # keeps exactly the PR 8 decode program
+                ctx = attend(q[0], *kv, block_tables, lengths, **sc)
+            else:
+                ctx = attend(q.transpose(1, 0, 2, 3), *kv, block_tables,
+                             lengths, limits=limits, **sc)  # [B, S, n, d]
+                ctx = ctx.transpose(1, 0, 2, 3)
+            return (ctx.reshape(S, B, -1).astype(q.dtype), layer_arenas)
 
         x, arenas = self._layer_stack(params, x, arenas, attn_core)
-        logits = self._head(params, x)[0]          # [max_batch, vocab]
-        sampled = sample_tokens(logits, temperature, top_k, top_p,
-                                seeds, steps)
-        next_tokens = jnp.where(active, sampled, 0).astype(jnp.int32)
-        return arenas, next_tokens, logits
+        logits = self._head(params, x)             # [S, B, vocab]
+        logits = logits.transpose(1, 0, 2)         # [B, S, vocab]
+        # every position samples with its slot's policy at its own
+        # output counter — accepted draws are the draws the sequential
+        # path would have made (same key, same teacher-forced logits)
+        rep = lambda a: jnp.repeat(a, S, axis=0)   # noqa: E731
+        sampled = sample_tokens(
+            logits.reshape(B * S, -1), rep(temperature), rep(top_k),
+            rep(top_p), rep(seeds),
+            (steps[:, None] + offsets).reshape(-1))
+        out = jnp.where(live, sampled.reshape(B, S), 0).astype(jnp.int32)
+        if S > 1:
+            # accepted = longest prefix with draft t == output t-1
+            match = (tokens[:, 1:].astype(jnp.int32) == out[:, :-1]) \
+                & (offsets[:, 1:] <= n_draft[:, None])
+            accepted = jnp.cumprod(
+                match.astype(jnp.int32), axis=1).sum(axis=1)
+        else:
+            accepted = jnp.zeros((B,), jnp.int32)
+        accepted = jnp.where(active, accepted, 0).astype(jnp.int32)
+        return arenas, out, accepted, logits
 
     def prefill(self, arenas, params, tokens, position_ids, block_tables,
                 lengths, limits, dest_blocks, dest_offsets, sample_index,

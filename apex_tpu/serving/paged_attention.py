@@ -4,7 +4,13 @@ The attention of the serving runtime over the paged KV cache
 (:mod:`apex_tpu.serving.kv_cache`), in two shapes:
 
 - **decode** (:func:`paged_attention_decode`) — one query token per
-  active slot attends over that request's cached blocks;
+  active slot attends over that request's cached blocks; with a 4-D
+  ``q`` the same entry point is the **speculative k+1 verify step**
+  (ISSUE 13): ``k + 1`` query positions per slot — the slot's real
+  last token plus k drafted continuations — attend with per-position
+  causal ``limits`` riding the same scalar-prefetch block-table index
+  maps, so draft and verify never bounce through HBM between proposal
+  and check (the operation-fusion finding, PAPERS.md 2502.17728);
 - **chunked prefill** (:func:`paged_prefill_attention`) — a
   ``[chunk]``-token slice of each slot's prompt attends over the
   request's *whole* context so far: the already-cached history blocks
@@ -12,6 +18,10 @@ The attention of the serving runtime over the paged KV cache
   tokens, which the caller scatters into the arena *before* the call —
   so one block sweep with a per-token causal ``limit`` covers history
   and in-chunk causality with no second kernel and no softmax merge.
+
+The k+1 verify and the chunked prefill are the *same* multi-query
+block sweep (``_multi_query_attention``): a verify step is a
+self-proposed chunk whose per-token limits happen to be consecutive.
 
 The unfused XLA lowering of either needs a big gather (materialising
 ``[batch, max_seq, heads, head_dim]`` K/V copies in HBM) followed by an
@@ -49,6 +59,7 @@ attention in one pass**:
 Layouts::
 
     decode   q:   [batch, n_heads, head_dim]      (one token per slot)
+    verify   q:   [batch, k+1, n_heads, head_dim] (+ per-token limits)
     prefill  q:   [batch, chunk, n_heads, head_dim]
     k/v arena:    [n_blocks, block_size, kv_heads, head_dim]
     k/v scales:   [n_blocks, block_size, kv_heads]  fp32 (int8 cache)
@@ -170,7 +181,7 @@ def _check_arena(q_d, k_arena, n, g, k_scales, v_scales):
 
 
 def paged_attention_decode(q, k_arena, v_arena, block_tables, lengths, *,
-                           k_scales=None, v_scales=None,
+                           limits=None, k_scales=None, v_scales=None,
                            block_size: Optional[int] = None,
                            scale: Optional[float] = None):
     """One fused gather+dequant+attention pass over the paged cache.
@@ -180,7 +191,25 @@ def paged_attention_decode(q, k_arena, v_arena, block_tables, lengths, *,
     value (the scheduler leaves them 0); a slot with ``lengths == 0``
     produces a zero output row.  ``k_scales``/``v_scales`` (int8 cache)
     are the per-row fp32 scale arenas.
+
+    **Speculative k+1 verify** (ISSUE 13): with ``q`` of shape
+    ``[batch, k+1, n, d]`` and per-position ``limits [batch, k+1]``
+    (token t attends cache positions ``< limits[:, t]``; 0 = padding —
+    a slot drafting fewer than k tokens, or none), the call is the
+    fused verify step: all k+1 positions of every slot attend in ONE
+    block sweep over the same table-indexed scalar-prefetch index maps,
+    with ``lengths`` bounding the sweep at the slot's cache length
+    *including* the just-scattered draft rows.
     """
+    if q.ndim == 4:
+        if limits is None:
+            raise ValueError(
+                "4-D q (the k+1 verify step) needs per-position limits")
+        return _multi_query_attention(
+            q, k_arena, v_arena, block_tables, lengths, limits,
+            k_scales=k_scales, v_scales=v_scales, scale=scale)
+    if limits is not None:
+        raise ValueError("limits only apply to a 4-D (multi-query) q")
     b, n, d = q.shape
     n_blocks, bs, g, dk = k_arena.shape
     if block_size is not None and block_size != bs:
@@ -270,15 +299,27 @@ def _gathered_kv(q, k_arena, v_arena, block_tables, k_scales, v_scales):
 
 
 def paged_attention_decode_unfused(q, k_arena, v_arena, block_tables,
-                                   lengths, *, k_scales=None, v_scales=None,
+                                   lengths, *, limits=None, k_scales=None,
+                                   v_scales=None,
                                    scale: Optional[float] = None):
     """The plain-XLA lowering of the same computation — the A/B baseline
     (bench ``serving.vs_unfused``) and the parity reference.
 
     Materialises the gathered ``[batch, max_blocks*block, heads, d]``
     K/V copies in HBM and lets XLA lower the softmax chain — the
-    unfused decode profile the Pallas kernel exists to beat.
+    unfused decode profile the Pallas kernel exists to beat.  A 4-D
+    ``q`` + ``limits`` is the unfused k+1 verify (the fused twin's
+    contract, lowered through the prefill-shaped gather).
     """
+    if q.ndim == 4:
+        if limits is None:
+            raise ValueError(
+                "4-D q (the k+1 verify step) needs per-position limits")
+        return paged_prefill_attention_unfused(
+            q, k_arena, v_arena, block_tables, lengths, limits,
+            k_scales=k_scales, v_scales=v_scales, scale=scale)
+    if limits is not None:
+        raise ValueError("limits only apply to a 4-D (multi-query) q")
     b, n, d = q.shape
     _check_arena(d, k_arena, n, k_arena.shape[2], k_scales, v_scales)
     k, v, t = _gathered_kv(q, k_arena, v_arena, block_tables,
@@ -367,6 +408,17 @@ def paged_prefill_attention(q, k_arena, v_arena, block_tables, lengths,
     destination blocks are all just table entries — prefix-cache hits,
     earlier chunks, and in-chunk causality need no separate paths.
     """
+    return _multi_query_attention(
+        q, k_arena, v_arena, block_tables, lengths, limits,
+        k_scales=k_scales, v_scales=v_scales, scale=scale)
+
+
+def _multi_query_attention(q, k_arena, v_arena, block_tables, lengths,
+                           limits, *, k_scales=None, v_scales=None,
+                           scale: Optional[float] = None):
+    """The shared fused multi-query block sweep behind the chunked
+    prefill AND the speculative k+1 verify (see the module docstring —
+    a verify step is a self-proposed chunk)."""
     b, T, n, d = q.shape
     n_blocks, bs, g, dk = k_arena.shape
     _check_arena(d, k_arena, n, g, k_scales, v_scales)

@@ -16,8 +16,19 @@ Wire protocol (multiprocessing queues; every payload is plain
 picklable data):
 
 parent → child commands
-    ``("submit", frid, prompt, max_new_tokens, eos_id)``
-    ``("submit_many", [(frid, prompt, max_new_tokens, eos_id), ...])``
+    ``("submit", frid, prompt, max_new_tokens, eos_id, sampling)``
+                        — ``sampling`` is the request's per-request
+                          :class:`~apex_tpu.serving.sampling.
+                          SamplingParams` (or None for greedy): the
+                          fleet satellite of ISSUE 13 routes the PR 11
+                          engine API over the wire.  Replay stays
+                          deterministic by the seeded-counter
+                          construction — the router rebases
+                          ``step_offset`` by the emitted prefix it
+                          re-prefills, so a survivor redraws the SAME
+                          stochastic stream.
+    ``("submit_many", [(frid, prompt, max_new_tokens, eos_id,
+                        sampling), ...])``
                         — batched admission: N requests in ONE queue
                           put/pickle round trip (the router batches a
                           pump's dispatches per replica; at fleet
@@ -205,9 +216,10 @@ def _replica_worker(spec: ReplicaSpec, name: str, cmd_q, evt_q,
                 return now
             return last_state
 
-        def admit_one(frid, prompt, max_new, eos) -> None:
+        def admit_one(frid, prompt, max_new, eos, sampling=None) -> None:
             try:
-                req = engine.submit(prompt, max_new, eos)
+                req = engine.submit(prompt, max_new, eos,
+                                    sampling=sampling)
             except ValueError as e:
                 # unserviceable here (too long for this replica's
                 # pool) — typed refusal, the router decides what to
@@ -331,18 +343,21 @@ class ReplicaProcess:
     # ------------------------------------------------------------ commands
 
     def submit(self, frid, prompt: Sequence[int], max_new_tokens: int,
-               eos_id: Optional[int] = None) -> None:
+               eos_id: Optional[int] = None, sampling=None) -> None:
+        """``sampling``: the request's
+        :class:`~apex_tpu.serving.sampling.SamplingParams` (picklable,
+        crosses the wire as data) or None for greedy."""
         self._cmd.put(("submit", frid, [int(t) for t in prompt],
-                       int(max_new_tokens), eos_id))
+                       int(max_new_tokens), eos_id, sampling))
 
     def submit_many(self, items: Sequence[tuple]) -> None:
         """Batched admission: ``items`` of ``(frid, prompt,
-        max_new_tokens, eos_id)`` cross the transport as ONE command
-        (one queue put, one pickle) instead of N — the router batches
-        each pump's dispatches per replica through this."""
+        max_new_tokens, eos_id, sampling)`` cross the transport as ONE
+        command (one queue put, one pickle) instead of N — the router
+        batches each pump's dispatches per replica through this."""
         self._cmd.put(("submit_many", [
-            (frid, [int(t) for t in prompt], int(max_new), eos)
-            for frid, prompt, max_new, eos in items]))
+            (frid, [int(t) for t in prompt], int(max_new), eos, samp)
+            for frid, prompt, max_new, eos, samp in items]))
 
     def begin_drain(self, *, sigterm: bool = True) -> None:
         """Start the drain: a real SIGTERM (the production rollout

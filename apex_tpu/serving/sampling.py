@@ -49,12 +49,23 @@ class SamplingParams:
     plus the request's output-token counter key every draw, so the same
     request replayed (preemption recompute, fleet failover) redraws the
     same stream.
+
+    ``step_offset`` rebases that counter: the engine keys draw i of a
+    request at ``fold_in(PRNGKey(seed), step_offset + i)``.  In-process
+    it stays 0 — a preempted request keeps its ``output_tokens``, so the
+    counter continues by itself.  Across the fleet wire a failover
+    replay re-submits ``prompt + emitted`` as a *new* engine request
+    whose counter restarts at 0; the router sets ``step_offset`` to the
+    emitted count so the survivor redraws the continuation of the SAME
+    stream (the stitched sampled stream is bitwise the uninterrupted
+    one — pinned in ``tests/test_fleet.py``).
     """
 
     temperature: float = 0.0
     top_k: int = 0
     top_p: float = 1.0
     seed: int = 0
+    step_offset: int = 0
 
     def __post_init__(self):
         if self.temperature < 0.0:
@@ -62,6 +73,9 @@ class SamplingParams:
                 f"temperature must be >= 0, got {self.temperature}")
         if not 0.0 < self.top_p <= 1.0:
             raise ValueError(f"top_p must be in (0, 1], got {self.top_p}")
+        if self.step_offset < 0:
+            raise ValueError(
+                f"step_offset must be >= 0, got {self.step_offset}")
 
 
 def _sample_one(logits, temperature, top_k, top_p, seed, step):

@@ -120,6 +120,10 @@ class Request:
     pc_hash: int = 0                    # chain hash after block pc_blocks-1
     preemptions: int = 0                # times evicted back to the queue
     admit_seq: int = -1                 # admission order (victim selection)
+    spec_fails: int = 0                 # consecutive all-rejected proposals
+    #                                     (speculative back-off; ISSUE 13)
+    spec_quiet: int = 0                 # backed-off ticks since the last
+    #                                     probe (re-arm cadence)
 
     # wall-clock marks for the latency metrics (engine-stamped)
     t_submit: float = 0.0
@@ -301,16 +305,22 @@ class Scheduler:
 
     # ------------------------------------------------------------- growth
 
-    def try_grow_to(self, req: Request, n_tokens: int) -> int:
+    def try_grow_to(self, req: Request, n_tokens: int, *,
+                    preempt: bool = True) -> int:
         """Grow ``req.blocks`` toward covering ``n_tokens`` of cache,
         taking blocks on demand: free list first, then prefix-cache
-        eviction, then preemption of strictly *newer* requests.
-        Returns the token count the request's blocks now cover — a
-        newer request with nothing left to preempt simply waits its
-        turn (the engine skips its chunk/decode this tick), while the
-        oldest running request always reaches its target (everything
-        else is evictable or preemptable), which is what makes every
-        admitted request terminate under oversubscription."""
+        eviction, then (``preempt=True``) preemption of strictly
+        *newer* requests.  Returns the token count the request's blocks
+        now cover — a newer request with nothing left to preempt simply
+        waits its turn (the engine skips its chunk/decode this tick),
+        while the oldest running request always reaches its target
+        (everything else is evictable or preemptable), which is what
+        makes every admitted request terminate under oversubscription.
+
+        ``preempt=False`` stops the ladder at eviction — the engine's
+        *speculative* growth (blocks for drafted tokens, ISSUE 13) uses
+        this: drafting is an optimization and must never pay for itself
+        by throwing away a neighbour's computed KV."""
         target = self.cache.blocks_for(n_tokens)
         while len(req.blocks) < target:
             want = target - len(req.blocks)
@@ -319,6 +329,8 @@ class Scheduler:
                     min(want, self.allocator.n_free), owner=req.rid)
                 req.blocks.extend(got)
                 continue
+            if not preempt:
+                break
             victim = self._pick_victim(exclude=req)
             if victim is None:
                 break

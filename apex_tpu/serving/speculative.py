@@ -1,0 +1,161 @@
+"""Self-speculative drafting: n-gram / prompt-lookup proposal, host side.
+
+Speculative decoding (ISSUE 13) splits each decode tick into *propose*
+and *verify*.  This module is the propose half — and deliberately the
+cheapest possible one: **no draft model**.  A request's own token
+stream (prompt + everything it has emitted) is the draft source: match
+the stream's recent suffix against its earlier occurrences and propose
+the tokens that followed last time.  Prompts with shared templates,
+code, quoted context, and the short cycles small greedy models fall
+into are all highly self-predictive — exactly the regime where the
+device is decoding one memory-bound token per tick and k free drafts
+turn into k nearly-free verifications (the ``[max_batch, k+1]`` step in
+:meth:`~apex_tpu.serving.model.DecodeModel.decode_step`).
+
+The proposer is *advisory by construction*: drafts only ever enter the
+verify step, whose accepted tokens are bitwise the tokens the
+non-speculative engine would have produced (greedy argmax, or the
+seed+``output_index``-keyed draws of :mod:`.sampling`).  A wrong draft
+costs one wasted query position, never a wrong token — so the proposer
+needs no correctness contract at all, only a hit rate worth its width.
+
+**Adaptive back-off** keeps the worst-case *tick count* pinned at
+today's one-tick-per-token cadence: a request whose proposals keep
+getting fully rejected (``backoff`` consecutive zero-accept ticks)
+stops drafting — ``n_draft = 0`` is *data*, the step never recompiles —
+re-probes with a single-token proposal every ``probe_every`` quiet
+ticks, and one accepted probe re-arms it.  (The compiled step itself
+stays ``k+1`` wide; the extra query positions ride the same paged
+gather, nearly free on the memory-bound TPU decode and compute-visible
+on CPU — which is why bench ``serving_spec`` gates ``vs_baseline >= 1``
+there.)  The counters ride the
+:class:`~apex_tpu.serving.scheduler.Request`, so preemption and
+recompute-on-readmit keep a request's drafting posture.
+
+The engine's proposer slot is duck-typed (``propose(req, max_k)`` /
+``observe(req, proposed, accepted)``), which is how the forced
+acceptance/rejection tests drive the verify step with oracle and
+adversarial drafts.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Sequence
+
+import numpy as np
+
+__all__ = ["SpeculativeConfig", "NGramProposer", "ngram_propose"]
+
+
+@dataclasses.dataclass(frozen=True)
+class SpeculativeConfig:
+    """Knobs of the self-speculative decode (docs/serving.md).
+
+    ``k`` — max drafted tokens per slot per tick; the decode step
+    compiles once at the fixed ``[max_batch, k + 1]`` verify shape, and
+    every per-slot draft count in ``[0, k]`` is data.  ``max_ngram`` /
+    ``min_ngram`` — suffix lengths tried (longest first) when matching
+    the stream against its own history.  ``backoff`` — consecutive
+    fully-rejected proposals before a request stops drafting (its tick
+    count degrades to the plain one-tick-per-token cadence, never
+    below it).
+    ``probe_every`` — a backed-off request re-probes with a
+    single-token proposal every this-many quiet ticks: a stream that
+    turns self-predictive later (a template tail, a greedy cycle) gets
+    its drafting back — one accepted probe re-arms it — while a
+    hopeless stream wastes one query position per ``probe_every``
+    ticks, not k per tick.
+    """
+
+    k: int = 4
+    max_ngram: int = 3
+    min_ngram: int = 1
+    backoff: int = 4
+    probe_every: int = 16
+
+    def __post_init__(self):
+        if self.k < 1:
+            raise ValueError(
+                f"speculative k must be >= 1 (omit the config to disable "
+                f"speculation), got {self.k}")
+        if self.min_ngram < 1 or self.max_ngram < self.min_ngram:
+            raise ValueError(
+                f"need 1 <= min_ngram <= max_ngram, got "
+                f"min {self.min_ngram} / max {self.max_ngram}")
+        if self.backoff < 1:
+            raise ValueError(f"backoff must be >= 1, got {self.backoff}")
+        if self.probe_every < 1:
+            raise ValueError(
+                f"probe_every must be >= 1, got {self.probe_every}")
+
+
+def ngram_propose(tokens: Sequence[int], k: int, *, max_ngram: int = 3,
+                  min_ngram: int = 1) -> List[int]:
+    """Prompt-lookup drafts: up to ``k`` tokens continuing ``tokens``.
+
+    For n from ``max_ngram`` down to ``min_ngram``: take the stream's
+    last n tokens and find their most recent *earlier* occurrence; on a
+    hit, propose the ``k`` tokens that followed it.  The continuation
+    may overlap the suffix and **self-extend** past the stream's end
+    (a draft near the tail keeps reading from its own proposal), which
+    is what makes a cycling stream — the tiny-model greedy attractor,
+    and any periodic template — fully self-predictive at full width.
+    Vectorized over a sliding window view — O(len) per n, no Python
+    inner loop over the stream.  Returns ``[]`` on no match.
+    """
+    L = len(tokens)
+    if k < 1 or L < min_ngram + 1:
+        return []
+    arr = np.asarray(tokens, np.int64)
+    for n in range(min(max_ngram, L - 1), min_ngram - 1, -1):
+        suffix = arr[L - n:]
+        # windows of arr starting at 0 .. L-1-n: every occurrence
+        # strictly before the suffix's own position
+        windows = np.lib.stride_tricks.sliding_window_view(arr[:-1], n)
+        hits = np.nonzero((windows == suffix).all(axis=1))[0]
+        if hits.size:
+            start = int(hits[-1]) + n         # most recent occurrence
+            out: List[int] = []
+            for j in range(k):
+                idx = start + j
+                out.append(int(arr[idx]) if idx < L else out[idx - L])
+            return out
+    return []
+
+
+class NGramProposer:
+    """Per-request adaptive wrapper over :func:`ngram_propose` — the
+    engine's default proposer when ``ServingConfig.speculative`` is
+    set."""
+
+    def __init__(self, config: SpeculativeConfig):
+        self.config = config
+
+    def propose(self, req, max_k: int) -> List[int]:
+        """Draft up to ``max_k`` tokens for ``req`` (the engine has
+        already clamped ``max_k`` to the context cap, the remaining
+        budget, and the configured ``k``).  A backed-off request
+        proposes nothing — except one probe every ``probe_every`` quiet
+        ticks, which is what makes the documented re-arm reachable (the
+        engine only reports verify outcomes for ticks that drafted)."""
+        if req.spec_fails >= self.config.backoff:
+            req.spec_quiet += 1
+            if req.spec_quiet < self.config.probe_every:
+                return []
+            req.spec_quiet = 0
+            max_k = min(max_k, 1)   # a probe wastes ONE query position
+        return ngram_propose(
+            req.sequence_tokens(), max_k,
+            max_ngram=self.config.max_ngram,
+            min_ngram=self.config.min_ngram)
+
+    def observe(self, req, proposed: int, accepted: int) -> None:
+        """Account one verify outcome: a fully-rejected proposal counts
+        toward the back-off, any acceptance re-arms the request."""
+        if proposed <= 0:
+            return
+        if accepted > 0:
+            req.spec_fails = 0
+        else:
+            req.spec_fails += 1

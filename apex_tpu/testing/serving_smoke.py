@@ -13,13 +13,17 @@ Drives the full ``apex_tpu.serving`` stack on the virtual CPU mesh
    unbatched, which is exactly why the paged runtime exists).
 2. **Zero decode recompiles** — the decode executable compiles once;
    every join/leave is data.  Pinned via the jit cache size.
-3. **int8 cache at occupancy (ISSUE 12)** — the same wave replayed on
-   an **int8 KV cache** engine whose pool is deliberately
-   undersized (roughly half the worst-case demand), so eviction and
-   preemption-with-recompute actually fire mid-run: every request
-   still finishes and every output stream is token-identical to the
-   bf16 leg — quantization and occupancy pressure change the HBM
-   story, never the tokens.
+3. **int8 cache + speculative decoding at occupancy (ISSUE 12/13)** —
+   the same wave plus a template-heavy one replayed on an **int8 KV
+   cache** engine with **n-gram drafting armed**
+   (``speculative=SpeculativeConfig(k=2)``) and the pool deliberately
+   undersized (roughly half the worst-case demand), so eviction,
+   preemption-with-recompute, drafting and the fused ``[max_batch,
+   k+1]`` verify all fire mid-run: every request still finishes and
+   every output stream is token-identical to the bf16 plain-decode
+   leg — quantization, occupancy pressure and speculation change the
+   HBM story and the arrival rate, never the tokens — at 1 decode
+   compile.
 4. **Clean drain on SIGTERM** — a real ``SIGTERM`` mid-stream (through
    ``resilience.PreemptionGuard``) stops admissions, the in-flight
    requests keep decoding and DELIVER their full responses, the queued
@@ -212,28 +216,42 @@ def main() -> int:
         f"(active {sgp['totals']['active_s']:.3f}s / queue "
         f"{sgp['totals']['queue_wait_s']:.3f}s)")
 
-    # ---- phase A2: int8 cache at occupancy pressure (ISSUE 12) -------
-    # Same wave on an int8-quantized cache with the pool undersized to
-    # ~half the worst-case demand: eviction + preemption/recompute fire
-    # mid-run, and the streams must STILL be token-identical to the
-    # bf16 leg above (which phase A proved identical to the reference).
+    # ---- phase A2: int8 + speculative decoding at occupancy ----------
+    # (ISSUE 12 + 13 in one leg.)  The phase-A wave plus a
+    # template-heavy one (repeated motifs, so the n-gram proposer
+    # actually fires) on an int8-quantized cache with k=2 drafting
+    # armed and the pool undersized to ~half the worst-case demand:
+    # eviction, preemption/recompute, drafting and the fused k+1
+    # verify all fire mid-run, and every stream must STILL be
+    # token-identical to the plain bf16 decode.  The wave_s reference
+    # comes from the phase-A engine (proved identical to the
+    # full-forward reference above) — the exact ISSUE 13 contract:
+    # speculative output == non-speculative output, bitwise.
+    from apex_tpu.serving import SpeculativeConfig
+
+    motifs = [[7, 11], [3, 9, 4]]
+    wave_s = [(m * 4, 6) for m in motifs]
+    refs_s = [eng.submit(p, n) for p, n in wave_s]
+    eng.run_until_drained(max_steps=1000)      # plain bf16 reference
+
     reg8 = MetricRegistry()
     eng8 = ServingEngine(
         cfg, ServingConfig(max_batch=3, block_size=4, max_seq=MAX_SEQ,
                            prefill_len=MAX_SEQ, n_blocks=8,
-                           cache_dtype=jnp.int8),
+                           cache_dtype=jnp.int8,
+                           speculative=SpeculativeConfig(k=2)),
         params, mesh=mesh, registry=reg8)
-    reqs8 = [eng8.submit(p, n) for p, n in wave]
+    reqs8 = [eng8.submit(p, n) for p, n in wave + wave_s]
     eng8.run_until_drained(max_steps=2000)
-    for r8, ra in zip(reqs8, reqs):
+    for r8, ra in zip(reqs8, reqs + refs_s):
         if r8.state.value != "finished" or \
                 r8.output_tokens != ra.output_tokens:
-            log(f"FAIL: int8 request {r8.rid} {r8.state.value} "
-                f"{r8.output_tokens} != bf16 {ra.output_tokens}")
+            log(f"FAIL: int8+spec request {r8.rid} {r8.state.value} "
+                f"{r8.output_tokens} != plain bf16 {ra.output_tokens}")
             return 1
     if eng8.decode_compile_count() != 1:
-        log("FAIL: int8 engine recompiled decode under "
-            "eviction/preemption churn")
+        log("FAIL: eviction/preemption/acceptance churn recompiled the "
+            "k+1 verify step")
         return 1
     eng8.scheduler.allocator.check()
     preempts = eng8.scheduler.preemptions
@@ -242,10 +260,15 @@ def main() -> int:
         log("FAIL: the undersized pool exercised neither eviction nor "
             "preemption — the occupancy leg tested nothing")
         return 1
-    log(f"phase A2 OK: int8 cache token-identical to bf16 at 8/15-block "
-        f"oversubscription ({preempts} preemptions, {evicts} evictions, "
-        f"{eng8.scheduler.prefix_cache.hits} prefix hits, 1 decode "
-        "compile)")
+    if eng8.spec_proposed == 0:
+        log("FAIL: the template wave never drafted — the speculative "
+            "leg tested nothing")
+        return 1
+    log(f"phase A2 OK: int8 k=2 speculative streams token-identical to "
+        f"plain bf16 at 8/15-block oversubscription "
+        f"({preempts} preemptions, {evicts} evictions, "
+        f"{eng8.spec_accepted}/{eng8.spec_proposed} drafts accepted, "
+        "1 decode compile)")
 
     # ---- phase B: SIGTERM drain --------------------------------------
     # Same engine (same compiled programs — phase B costs zero extra

@@ -75,12 +75,24 @@ TOLERANCES = {
     # preemption/recompute cadence is host-load sensitive on CPU (the
     # interpret-mode prefill dominates the recompute cost)
     "serving_occupancy": 0.6,
+    # acceptance length couples throughput to the model's greedy
+    # cycling, which shifts with any model/config change; the ratio
+    # vs_baseline is the stable signal, the absolute rate is not
+    "serving_spec": 0.6,
 }
 
 # Hard ceilings on whitelist fields — standing acceptance gates, not
 # noise comparisons ((row, field) -> max allowed value).
 GATES = {
     ("telemetry_overhead", "vs_bare"): 1.05,
+}
+
+# Hard floors, same idea in the other direction ((row, field) -> min
+# allowed value).  serving_spec.vs_baseline is the ISSUE 13 acceptance
+# bar: speculation must never make serving slower than the plain
+# engine, even on CPU where the verify's FLOPs are not free.
+FLOORS = {
+    ("serving_spec", "vs_baseline"): 1.0,
 }
 
 
@@ -178,7 +190,7 @@ def check_bench(rounds: List[dict], tolerance: float,
     hist_rows = [_rows_of(h["compact"]) for h in history]
 
     for name, row in sorted(new_rows.items()):
-        # hard gates first: a ceiling needs no history
+        # hard gates first: a ceiling/floor needs no history
         for (gname, field), ceiling in GATES.items():
             if name == gname and row.get(field) is not None:
                 if float(row[field]) > ceiling:
@@ -188,6 +200,15 @@ def check_bench(rounds: List[dict], tolerance: float,
                 else:
                     notes.append(f"bench {label}: gate {name}.{field}="
                                  f"{row[field]} <= {ceiling} ok")
+        for (gname, field), floor in FLOORS.items():
+            if name == gname and row.get(field) is not None:
+                if float(row[field]) < floor:
+                    failures.append(
+                        f"bench {label}: {name}.{field}="
+                        f"{row[field]} below the {floor} floor")
+                else:
+                    notes.append(f"bench {label}: floor {name}.{field}="
+                                 f"{row[field]} >= {floor} ok")
 
         platform = row.get("platform")
         prior = [h[name] for h in hist_rows if name in h]

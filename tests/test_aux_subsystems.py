@@ -134,9 +134,11 @@ def test_serving_smoke_script():
     """scripts/serving_smoke.sh end to end (ISSUE 9): continuously-
     batched greedy decode token-identical to the per-request
     full-forward reference across staggered request churn, exactly one
-    decode compile, and a clean SIGTERM drain (in-flight delivered,
-    queue cancelled).  Subprocess because the smoke sends itself a real
-    SIGTERM and owns its own platform/mesh pinning."""
+    decode compile, int8 + speculative drafting with the k+1 verify at
+    occupancy pressure (A2 — ISSUE 12/13), and a clean SIGTERM drain
+    (in-flight delivered, queue cancelled).  Subprocess because the
+    smoke sends itself a real SIGTERM and owns its own platform/mesh
+    pinning."""
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
@@ -150,6 +152,7 @@ def test_serving_smoke_script():
         f"stderr tail:\n{proc.stderr.decode(errors='replace')[-3000:]}")
     assert b"PASS" in proc.stderr
     assert b"phase A OK" in proc.stderr and b"phase B OK" in proc.stderr
+    assert b"phase A2 OK" in proc.stderr
 
 
 def test_fleet_smoke_script():

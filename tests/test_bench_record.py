@@ -5,7 +5,7 @@ last JSON line.  Rounds 1-4 all delivered ``parsed: null`` because the
 full record line grew past the tail size.  These tests pin the fix: every
 emission ends with a compact line that (a) is <= 1500 bytes, (b) parses,
 (c) carries the driver contract fields, and (d) survives a simulated
-2000-byte tail even in the worst case (all seventeen BENCH_ORDER rows
+2000-byte tail even in the worst case (all eighteen BENCH_ORDER rows
 verbose — including ``real_data_rn50`` with its ``vs_synthetic``
 composition, ``zero_adam_step`` with ``vs_per_leaf``, ``tp_gpt``
 with its overlap_comm A/B fields (``overlap_tokens_per_sec`` /
@@ -14,9 +14,11 @@ with its overlap_comm A/B fields (``overlap_tokens_per_sec`` /
 with ``vs_bare``, ``serving`` with its per-concurrency
 tokens/sec + p50/p99 TPOT sub-rows and ``vs_unfused``,
 ``serving_occupancy`` with its per-oversubscription curve,
-``vs_reserve`` and the prefix-cache TTFT A/B, and
-``serving_fleet`` with its steady/roll p99-TPOT pair and
-``roll_vs_steady`` — + embedded prior TPU evidence).
+``vs_reserve`` and the prefix-cache TTFT A/B, ``serving_fleet``
+with its steady/roll p99-TPOT pair and ``roll_vs_steady``, and
+``serving_spec`` with its speculative-vs-baseline curve,
+``vs_baseline`` and ``mean_accept_len`` — + embedded prior TPU
+evidence).
 """
 
 import io
@@ -30,7 +32,7 @@ import bench  # noqa: E402
 
 
 def _worst_case_results():
-    """All seventeen BENCH_ORDER rows, each fattened with prose fields,
+    """All eighteen BENCH_ORDER rows, each fattened with prose fields,
     like a CPU-fallback day — the REAL worst case (the pre-fix nine-row
     set under-tested the <=1500-byte guarantee once ``real_data_rn50``,
     ``zero_adam_step``, ``ckpt_save_restore``, ``ckpt_reshard``,
@@ -81,6 +83,16 @@ def _worst_case_results():
                           "p99_tpot_ms_roll": 4.1,
                           "roll_vs_steady": 1.206,
                           "roll_wall_s": 46.7},
+        "serving_spec": {"value": 2154.2, "unit": "tokens/sec",
+                         "vs_baseline": 2.256,
+                         "mean_accept_len": 4.0,
+                         "acceptance_rate": 0.933,
+                         "tokens_per_sec_at": {"1": 357.6, "4": 1218.7,
+                                               "8": 2154.2},
+                         "baseline_tokens_per_sec_at": {
+                             "1": 120.5, "4": 478.4, "8": 954.7},
+                         "vs_baseline_at": {"1": 2.969, "4": 2.547,
+                                            "8": 2.256}},
         "gpt_flash_fp8": {"value": 4112.3, "unit": "tokens/sec/chip"},
         "gpt_long_context": {"value": 2580.7, "unit": "tokens/sec/chip"},
         "input_pipeline": {
@@ -148,6 +160,14 @@ def test_compact_record_under_1500_bytes():
     assert fl["p99_tpot_ms_steady"] == 3.4
     assert fl["p99_tpot_ms_roll"] == 4.1
     assert fl["roll_vs_steady"] == 1.206
+    # ISSUE 13 speculative sub-rows survive the distillation (the
+    # per-concurrency baseline/ratio curves and ``acceptance_rate`` —
+    # reconstructible from the accept length — stay in the full record)
+    sp = compact["rows"]["serving_spec"]
+    assert sp["vs_baseline"] == 2.256
+    assert sp["mean_accept_len"] == 4.0
+    assert sp["tokens_per_sec_at"]["8"] == 2154.2
+    assert record["extras"]["serving_spec"]["acceptance_rate"] == 0.933
     # ISSUE 8 input-pipeline sub-rows survive the distillation
     ip = compact["rows"]["input_pipeline"]
     assert ip["loader_ips_per_backend"]["process"] == 9685.0

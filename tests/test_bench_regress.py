@@ -127,6 +127,27 @@ class TestInjectedRegression:
         assert proc.returncode == 1
         assert "vs_bare" in proc.stdout and "1.05" in proc.stdout
 
+    def test_serving_spec_vs_baseline_floor(self, tmp_path):
+        """The ISSUE 13 acceptance bar (speculation never slower than
+        the plain engine) is a hard floor, no history needed — and a
+        passing ratio is not flagged."""
+        _copy_history(tmp_path)
+        _, newest = _newest_bench(tmp_path)
+        bad = copy.deepcopy(newest)
+        bad["parsed"]["rows"]["serving_spec"] = {
+            "value": 900.0, "unit": "tokens/sec", "platform": "cpu",
+            "vs_baseline": 0.82, "mean_accept_len": 1.1}
+        _write_round(tmp_path, "BENCH_r06.json", bad, n=6)
+        proc = _run("--dir", str(tmp_path))
+        assert proc.returncode == 1
+        assert "vs_baseline" in proc.stdout and "floor" in proc.stdout
+        ok = copy.deepcopy(newest)
+        ok["parsed"]["rows"]["serving_spec"] = {
+            "value": 2100.0, "unit": "tokens/sec", "platform": "cpu",
+            "vs_baseline": 2.26, "mean_accept_len": 4.0}
+        _write_round(tmp_path, "BENCH_r06.json", ok, n=6)
+        assert _run("--dir", str(tmp_path)).returncode == 0
+
     def test_multichip_ok_drop_fails(self, tmp_path):
         _copy_history(tmp_path)
         rec = {"n_devices": 8, "rc": 1, "ok": False, "skipped": False,

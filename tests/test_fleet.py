@@ -40,15 +40,39 @@ def reference(prompt, n, eos_id=None):
     return out
 
 
+def seeded_fn(seq, sampling, abs_step):
+    """Deterministic 'sampled decode': next token from (prefix, seed,
+    absolute draw counter) — the FakeReplica mirror of the engine's
+    ``fold_in(PRNGKey(seed), step_offset + output_index)`` keying.  A
+    replay whose wire ``step_offset`` was not rebased by the emitted
+    prefix re-draws from counter 0 and diverges immediately."""
+    h = 23 + int(sampling.seed) * 7 + int(abs_step) * 13
+    for i, t in enumerate(seq):
+        h = (h * 31 + (i + 1) * int(t)) % 251
+    return h % 97
+
+
+def seeded_reference(prompt, n, sampling):
+    seq, out = list(prompt), []
+    for step in range(n):
+        t = seeded_fn(seq, sampling, step)
+        seq.append(t)
+        out.append(t)
+    return out
+
+
 class FakeReplica:
     """In-memory replica: the client duck-type over a deterministic
     single-token-per-tick engine."""
 
     def __init__(self, name, *, free_blocks=100, max_batch=4,
-                 die_after_tokens=None, fn=fake_fn, meta=None):
+                 die_after_tokens=None, fn=fake_fn, meta=None,
+                 kv_occupancy=0.0, prefix_cache_hits=0):
         self.name = name
         self._fn = fn
         self.free_blocks = free_blocks
+        self.kv_occupancy = kv_occupancy
+        self.prefix_cache_hits = prefix_cache_hits
         self.max_batch = max_batch
         self.die_after_tokens = die_after_tokens
         self.tokens_emitted = 0
@@ -72,15 +96,17 @@ class FakeReplica:
         evs, self._events = self._events, []
         return evs
 
-    def submit(self, frid, prompt, max_new_tokens, eos_id):
+    def submit(self, frid, prompt, max_new_tokens, eos_id,
+               sampling=None):
         if not self._alive:
             raise BrokenPipeError("dead replica")
         self.submissions.append((frid, list(prompt), max_new_tokens,
-                                 eos_id))
+                                 eos_id, sampling))
         if self.draining:
             self._events.append(("rejected", frid, "rejected"))
             return
-        self.waiting.append((frid, list(prompt), max_new_tokens, eos_id))
+        self.waiting.append((frid, list(prompt), max_new_tokens, eos_id,
+                             sampling))
 
     def begin_drain(self, **kw):
         self.draining = True
@@ -104,6 +130,8 @@ class FakeReplica:
             "free_blocks": self.free_blocks,
             "queue_depth": len(self.waiting),
             "draining": self.draining,
+            "kv_occupancy": self.kv_occupancy,
+            "prefix_cache_hits": self.prefix_cache_hits,
         }))
 
     def _maybe_finish_drain(self):
@@ -127,13 +155,21 @@ class FakeReplica:
             self._alive = False
             return
         while self.waiting and len(self.running) < self.max_batch:
-            frid, prompt, max_new, eos = self.waiting.pop(0)
+            frid, prompt, max_new, eos, sampling = self.waiting.pop(0)
             self.running[frid] = {"seq": list(prompt),
-                                  "remaining": max_new, "eos": eos}
+                                  "remaining": max_new, "eos": eos,
+                                  "sampling": sampling, "emitted": 0}
         for frid in list(self.running):
             r = self.running[frid]
-            tok = self._fn(r["seq"])
+            if r["sampling"] is not None:
+                # the engine's seeded-counter keying, mirrored
+                tok = seeded_fn(
+                    r["seq"], r["sampling"],
+                    r["sampling"].step_offset + r["emitted"])
+            else:
+                tok = self._fn(r["seq"])
             r["seq"].append(tok)
+            r["emitted"] += 1
             r["remaining"] -= 1
             self._events.append(("token", frid, tok))
             self.tokens_emitted += 1
@@ -272,7 +308,7 @@ def test_failover_replay_token_identity_kill_at_k(k):
     # carried prompt + the k already-emitted tokens and the remaining
     # budget
     if 0 < k < n_new:
-        frid, wire_prompt, wire_budget, _ = survivor.submissions[0]
+        frid, wire_prompt, wire_budget, _, _ = survivor.submissions[0]
         assert frid == req.rid
         assert wire_prompt == prompt + reference(prompt, k)
         assert wire_budget == n_new - k
@@ -634,6 +670,174 @@ def test_rollout_all_replicas_under_load():
         assert req.state is RequestState.FINISHED
         assert req.output_tokens == reference(req.prompt.tolist(), 2)
     assert router.registry.snapshot()["fleet/rollouts"] == 3.0
+
+
+# ---------------------------- ISSUE 13: sampling over the replica wire
+
+
+def test_sampling_params_ride_the_wire():
+    """A request's SamplingParams cross the transport with every
+    dispatch and drive the replica-side stream."""
+    from apex_tpu.serving import SamplingParams
+
+    rep = FakeReplica("a")
+    router = make_router([rep])
+    sp = SamplingParams(temperature=1.0, top_p=0.9, seed=11)
+    req = router.submit([3, 5], 6, sampling=sp)
+    drive(router, [rep])
+    assert req.state is RequestState.FINISHED
+    assert rep.submissions[0][4] == sp
+    assert req.output_tokens == seeded_reference([3, 5], 6, sp)
+
+
+@pytest.mark.parametrize("k", [1, 3])
+def test_sampled_stream_survives_failover_replay(k):
+    """The ISSUE 13 satellite contract: a SIGKILL mid-sampled-stream is
+    replayed with the draw counter REBASED by the emitted prefix
+    (``step_offset``), so the stitched stream is bitwise the
+    uninterrupted seeded stream — sampling joins the failover replay
+    story instead of breaking it."""
+    from apex_tpu.serving import SamplingParams
+
+    n_new, prompt = 6, [9, 1, 4]
+    sp = SamplingParams(temperature=0.8, seed=5)
+    victim = FakeReplica("victim", free_blocks=1000, die_after_tokens=k)
+    survivor = FakeReplica("survivor", free_blocks=10)
+    router = make_router([victim, survivor])
+    req = router.submit(prompt, n_new, sampling=sp)
+    drive(router, [victim, survivor])
+    assert req.state is RequestState.FINISHED
+    assert req.replays == 1
+    ref = seeded_reference(prompt, n_new, sp)
+    assert req.output_tokens == ref, \
+        "stitched sampled stream diverged from the uninterrupted draw"
+    # the survivor's wire carried prompt+prefix AND the rebased counter
+    frid, wire_prompt, wire_budget, _, wire_sp = survivor.submissions[0]
+    assert frid == req.rid
+    assert wire_prompt == prompt + ref[:k]
+    assert wire_sp.step_offset == k and wire_sp.seed == sp.seed
+
+
+# -------------------------- ISSUE 13: fleet prefix-cache affinity
+
+
+def test_tenant_affinity_tie_break():
+    """With free blocks and queue depth level, a tenant's requests
+    stick to the replica that last served them (whose PrefixCache
+    holds their template blocks); a fresh tenant still takes the
+    name-order default."""
+    a = FakeReplica("a", free_blocks=50)
+    b = FakeReplica("b", free_blocks=80)   # more free: first pick
+    router = make_router([a, b])
+    first = router.submit([1, 2, 3], 2, tenant="t")
+    drive(router, [a, b])
+    assert first.replica == "b"
+    assert router.introspect()["tenant_affinity"]["t"] == "b"
+    b.free_blocks = 50                     # level the primary signal
+    b._emit_state()
+    a._emit_state()
+    router.pump()
+    again = router.submit([1, 2, 3], 2, tenant="t")
+    fresh = router.submit([4, 4], 2, tenant="u")
+    drive(router, [a, b])
+    assert again.replica == "b", "affinity tie-break ignored"
+    assert fresh.replica == "a", \
+        "non-affine tenant should take the name-order default"
+
+
+def test_affinity_never_overrides_free_block_pressure():
+    """free_blocks still dominates: the affine replica under pool
+    pressure loses to a roomier one — affinity is strictly a
+    tie-break."""
+    a = FakeReplica("a", free_blocks=100)
+    b = FakeReplica("b", free_blocks=10)
+    router = make_router([a, b])
+    first = router.submit([7, 7], 2, tenant="t")
+    drive(router, [a, b])
+    assert first.replica == "a"
+    a.free_blocks = 3                      # pool pressure on the warm one
+    a._emit_state()
+    router.pump()
+    nxt = router.submit([7, 7], 2, tenant="t")
+    drive(router, [a, b])
+    assert nxt.replica == "b"
+
+
+def test_affinity_yields_past_the_occupancy_cap():
+    """A warm replica whose heartbeat reports kv_occupancy past the cap
+    is under pool pressure — landing a template there would force
+    evictions, so the tie-break stands down."""
+    a = FakeReplica("a", free_blocks=50)
+    b = FakeReplica("b", free_blocks=80)   # warm one = non-default pick
+    router = make_router([a, b], affinity_occupancy_cap=0.95)
+    first = router.submit([1, 2], 2, tenant="t")
+    drive(router, [a, b])
+    assert first.replica == "b"
+    b.free_blocks = 50                     # level the primary signal
+    b.kv_occupancy = 0.99                  # the cache is the pool now
+    a._emit_state()
+    b._emit_state()
+    router.pump()
+    nxt = router.submit([1, 2], 2, tenant="t")
+    drive(router, [a, b])
+    assert nxt.replica == "a", \
+        "the tie-break must stand down past the occupancy cap"
+
+
+# ------------------------------- ISSUE 13: streaming client API
+
+
+def _ticking(router, replicas):
+    """Consuming a stream pumps the router; in the hermetic harness the
+    fakes only produce when ticked, so tick them on every pump (the
+    real transport's events arrive asynchronously — this is its
+    deterministic stand-in)."""
+    orig = router.pump
+
+    def pump():
+        orig()
+        for rep in replicas:
+            rep.tick()
+
+    router.pump = pump
+    return router
+
+
+def test_stream_yields_tokens_and_closes_on_finish():
+    rep = FakeReplica("a")
+    router = _ticking(make_router([rep]), [rep])
+    req = router.submit([3, 5, 7], 5)
+    seen = list(router.stream(req.rid, poll_s=0))
+    assert seen == reference([3, 5, 7], 5)
+    assert req.state is RequestState.FINISHED
+
+
+def test_stream_continues_through_failover():
+    """The iterator is failover-transparent: tokens emitted before the
+    kill and the replayed remainder arrive on the same stream, stitched
+    bitwise."""
+    victim = FakeReplica("victim", free_blocks=1000, die_after_tokens=3)
+    survivor = FakeReplica("survivor", free_blocks=10)
+    router = _ticking(make_router([victim, survivor]),
+                      [victim, survivor])
+    req = router.submit([9, 1, 4], 6)
+    seen = list(router.stream(req, poll_s=0))
+    assert seen == reference([9, 1, 4], 6)
+    assert req.replays == 1
+
+
+def test_stream_of_shed_request_closes_empty():
+    rep = FakeReplica("a", max_batch=1)
+    router = make_router([rep], max_queue_depth=0)
+    req = router.submit([1], 4)            # shed at the door
+    assert req.state is RequestState.REJECTED
+    assert list(router.stream(req, poll_s=0)) == []
+
+
+def test_stream_of_unknown_rid_raises():
+    router = make_router([FakeReplica("a")])
+    with pytest.raises(KeyError, match="unknown"):
+        next(router.stream(12345))
 
 
 # ------------------------------------------------------ introspection

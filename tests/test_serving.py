@@ -564,18 +564,18 @@ def _teacher_forced_parity(eng, seq, prefix_len):
     arenas, _, _ = prefill_logits(prefix_len, eng.arenas)
     max_err = 0.0
     for t in range(prefix_len, len(seq)):
-        toks = np.zeros((B, 1), np.int32)
+        toks = np.zeros((B, eng.spec_width), np.int32)
         toks[0, 0] = seq[t]
         pos = np.zeros((B,), np.int32)
         pos[0] = t
         act = np.zeros((B,), bool)
         act[0] = True
-        arenas, _, logits = eng._decode(
+        arenas, _, _, logits = eng._decode(
             arenas, eng.params, toks, pos, jnp.asarray(tables), act,
-            *_sampling_zeros(B))
+            np.zeros((B,), np.int32), *_sampling_zeros(B))
         arenas2 = init_kv_arena(cache, eng.mesh, eng.tp_axis)
         _, _, full = prefill_logits(t + 1, arenas2)
-        err = float(jnp.max(jnp.abs(logits[0] - full[0, t])))
+        err = float(jnp.max(jnp.abs(logits[0, 0] - full[0, t])))
         max_err = max(max_err, err)
     return max_err
 
@@ -697,12 +697,16 @@ def _wave(seed=5, n=6):
 
 
 def _run_wave(wave, *, n_blocks=None, admission="occupancy",
-              prefill_len=8, sampling=None, cache_dtype=None):
+              prefill_len=8, sampling=None, cache_dtype=None,
+              speculative=None, proposer=None):
     _, _, eng = _build_engine(
         tp=1, serving=ServingConfig(
             max_batch=4, block_size=4, max_seq=MAX_SEQ,
             prefill_len=prefill_len, n_blocks=n_blocks,
-            admission=admission, cache_dtype=cache_dtype))
+            admission=admission, cache_dtype=cache_dtype,
+            speculative=speculative))
+    if proposer is not None:
+        eng.proposer = proposer
     reqs = [eng.submit(p, n, sampling=sampling) for p, n in wave]
     eng.run_until_drained(max_steps=2000)
     eng.scheduler.allocator.check()
