@@ -43,6 +43,16 @@ package turns those checkpoints into a *serving* runtime —
   failover replay (SIGKILLed replica's in-flight requests re-prefix on
   survivors, greedy-token-identical), and zero-downtime weight rollout
   through the SIGTERM drain + newest-VERIFIED restore.
+- :mod:`.transport` — the router↔replica wire made explicit (ISSUE
+  14): the Transport duck type the router consumes, with the
+  in-process mp-queue shape (``ReplicaProcess``) and a cross-host
+  framed-TCP shape — length-prefixed version+crc32 frames (torn or
+  corrupted frames are detected and classified as replica failure,
+  never deserialized), a :class:`~apex_tpu.serving.transport.
+  SocketTransport` client with jittered-backoff reconnect + lossless
+  session replay + bounded-outbox backpressure + link-RTT pings, and
+  a :func:`~apex_tpu.serving.transport.replica_serve` host daemon
+  wrapping the existing replica worker lifecycle.
 
 See ``docs/serving.md`` for the architecture and cookbook.
 """
@@ -71,6 +81,13 @@ from apex_tpu.serving.engine import ServingConfig, ServingEngine
 from apex_tpu.serving.loader import restore_gpt_for_serving
 from apex_tpu.serving.replica import ReplicaProcess, ReplicaSpec
 from apex_tpu.serving.fleet import FleetRequest, FleetRouter
+from apex_tpu.serving.transport import (
+    SocketTransport,
+    TransportError,
+    TransportServer,
+    replica_serve,
+    start_replica_server,
+)
 
 __all__ = [
     "BlockAllocator",
@@ -88,8 +105,13 @@ __all__ = [
     "Scheduler",
     "ServingConfig",
     "ServingEngine",
+    "SocketTransport",
     "SpeculativeConfig",
+    "TransportError",
+    "TransportServer",
     "init_kv_arena",
+    "replica_serve",
+    "start_replica_server",
     "ngram_propose",
     "paged_attention_decode",
     "paged_attention_decode_unfused",

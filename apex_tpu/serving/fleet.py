@@ -53,17 +53,40 @@ request's tokens as events arrive, closed by the terminal state).
 
 The router is deliberately **jax-free and transport-agnostic**: it
 drives anything with the replica client surface (``alive``/``poll``/
-``submit``/``begin_drain``/``close``), which is how
+``submit``/``begin_drain``/``close`` — the duck type
+:mod:`~apex_tpu.serving.transport` documents), which is how
 ``tests/test_fleet.py`` exercises every policy branch hermetically with
-in-memory fakes.  ``FleetRouter.introspect()`` duck-types the debug
-server's engine slot, so ``DebugServer(engine=router)`` serves live
-fleet state at ``/statusz`` unchanged.
+in-memory fakes, and how ISSUE 14 made the fleet cross-host: the framed
+TCP :class:`~apex_tpu.serving.transport.SocketTransport` slots in where
+``ReplicaProcess`` did and the router does not change.  Two
+network-shaped policies ride on top:
+
+**Graceful link degradation.**  A transport that reports a link RTT
+(``link_rtt_s`` off the client, measured by ping/pong on the router
+host's monotonic clock) past ``link_degraded_rtt_s`` is **demoted** in
+placement — every healthy-link replica with capacity wins first — but
+never hard-failed: its streams keep flowing, and it keeps serving if it
+is all that's left (``fleet/link_degraded`` counts the transitions,
+per-replica RTT rides ``introspect()``).
+
+**Bounded-deadline shed when unreachable.**  When *no* replica is
+dispatchable (all down/draining/rolling — the full-partition shape),
+pending requests wait at most ``dispatch_deadline_s`` and are then shed
+in the typed REJECTED terminal state: a fleet cut off from its replicas
+degrades to observable refusals, never to an unbounded queue of silent
+hangs.
+
+``FleetRouter.introspect()`` duck-types the debug server's engine slot,
+so ``DebugServer(engine=router)`` serves live fleet state at
+``/statusz`` unchanged.
 
 Metric catalog additions (host-local, ``docs/observability.md``):
 ``fleet/requests_submitted`` / ``fleet/requests_finished`` /
 ``serving/requests_rejected`` counters, ``fleet/replays`` /
 ``fleet/failovers`` / ``fleet/reschedules`` / ``fleet/rollouts``
-counters, ``fleet/replicas_live`` / ``fleet/queue_depth`` gauges,
+counters, ``fleet/reconnects`` / ``fleet/frames_corrupt`` /
+``fleet/link_degraded`` transport counters (ISSUE 14),
+``fleet/replicas_live`` / ``fleet/queue_depth`` gauges,
 ``fleet/ttft_ms`` / ``fleet/tpot_ms`` histograms (router-observed).
 """
 
@@ -138,6 +161,12 @@ class _ReplicaView:
         self.probes = 0                     # missed-heartbeat probes so far
         self.next_probe_t: Optional[float] = None
         self.assigned: Dict[int, FleetRequest] = {}
+        # transport link state (ISSUE 14): last-synced client counters
+        # and the degradation verdict placement demotes on
+        self.tx_reconnects = 0
+        self.tx_frames_corrupt = 0
+        self.link_rtt_s: Optional[float] = None
+        self.link_degraded = False
 
     @property
     def name(self) -> str:
@@ -199,6 +228,8 @@ class FleetRouter:
                  probe_retries: int = 3, probe_backoff_s: float = 0.2,
                  max_attempts: int = 8, keep_done: int = 4096,
                  affinity_occupancy_cap: float = 0.95,
+                 link_degraded_rtt_s: float = 1.0,
+                 dispatch_deadline_s: float = 120.0,
                  registry=None, clock: Callable[[], float] = time.monotonic):
         from apex_tpu.observability.metrics import default_registry
 
@@ -208,6 +239,14 @@ class FleetRouter:
         self.heartbeat_timeout_s = heartbeat_timeout_s
         self.probe_retries = probe_retries
         self.probe_backoff_s = probe_backoff_s
+        # link RTT past this demotes the replica in placement (never a
+        # hard failure); transports that report no RTT are exempt
+        self.link_degraded_rtt_s = link_degraded_rtt_s
+        # the all-replicas-unreachable bound: pending requests wait at
+        # most this long with zero dispatchable replicas before the
+        # typed REJECTED shed (a partitioned fleet refuses observably)
+        self.dispatch_deadline_s = dispatch_deadline_s
+        self._no_dispatch_since: Optional[float] = None
         # a request the fleet keeps bouncing (replica-level rejects,
         # drain cancels, failover replays) is parked REJECTED after
         # this many re-routes — a poison request (e.g. one no replica's
@@ -356,14 +395,44 @@ class FleetRouter:
 
     # ------------------------------------------------------------- events
 
+    def _sync_link(self, view: _ReplicaView) -> None:
+        """Mirror the transport's link counters into the registry and
+        refresh the degradation verdict.  Duck-typed: transports
+        without the attributes (mp queues, hermetic fakes) read as
+        healthy links.  Runs even when poll raised — a poll that died
+        ON a corrupt frame already counted it client-side."""
+        client = view.client
+        rec = int(getattr(client, "reconnects", 0) or 0)
+        if rec > view.tx_reconnects:
+            self.registry.counter("fleet/reconnects").inc(
+                rec - view.tx_reconnects)
+            view.tx_reconnects = rec
+        corrupt = int(getattr(client, "frames_corrupt", 0) or 0)
+        if corrupt > view.tx_frames_corrupt:
+            self.registry.counter("fleet/frames_corrupt").inc(
+                corrupt - view.tx_frames_corrupt)
+            view.tx_frames_corrupt = corrupt
+        rtt = getattr(client, "link_rtt_s", None)
+        view.link_rtt_s = rtt
+        degraded = rtt is not None and rtt > self.link_degraded_rtt_s
+        if degraded and not view.link_degraded:
+            self.registry.counter("fleet/link_degraded").inc()
+            logger.warning(
+                "fleet: replica %s link degraded (rtt %.3fs > %.3fs); "
+                "demoting in placement", view.name, rtt,
+                self.link_degraded_rtt_s)
+        view.link_degraded = degraded
+
     def _poll_view(self, view: _ReplicaView) -> None:
         try:
             events = view.client.poll()
         except Exception as e:  # dead pipe mid-read
+            self._sync_link(view)
             logger.warning("fleet: replica %s poll failed: %r",
                            view.name, e)
             self._mark_down(view, f"dead pipe: {e!r}")
             return
+        self._sync_link(view)
         if events:
             view.last_event_t = self._clock()
             view.probes = 0
@@ -580,7 +649,12 @@ class FleetRouter:
             occ = float(state.get("kv_occupancy") or 0.0)
             affine = (v.name == warm
                       and occ < self.affinity_occupancy_cap)
-            return (-free, len(v.assigned), 0 if affine else 1, v.name)
+            # link degradation leads the key (ISSUE 14): a slow link is
+            # DEMOTED — any healthy-link candidate wins regardless of
+            # pool shape — but never excluded, so a fleet whose every
+            # link degraded still serves instead of starving
+            return (1 if v.link_degraded else 0, -free,
+                    len(v.assigned), 0 if affine else 1, v.name)
 
         return min(candidates, key=score)
 
@@ -639,6 +713,36 @@ class FleetRouter:
                 logger.warning("fleet: submit to %s failed: %r",
                                view.name, e)
                 self._mark_down(view, f"dead pipe on submit: {e!r}")
+        self._shed_if_unreachable()
+
+    def _shed_if_unreachable(self) -> None:
+        """Graceful degradation when the whole fleet is out of reach
+        (every replica down/draining/rolling — the full-partition
+        shape): pending requests wait a bounded ``dispatch_deadline_s``
+        from the moment the last replica became undispatchable, then
+        shed in the typed REJECTED terminal state.  Any replica coming
+        back (probe reset, rollout rejoin) resets the window."""
+        pending = sum(len(q) for q in self._pending.values())
+        if pending == 0 or any(v.dispatchable()
+                               for v in self._views.values()):
+            self._no_dispatch_since = None
+            return
+        now = self._clock()
+        if self._no_dispatch_since is None:
+            self._no_dispatch_since = now
+            return
+        if now - self._no_dispatch_since <= self.dispatch_deadline_s:
+            return
+        logger.warning(
+            "fleet: no replica dispatchable for %.1fs; shedding %d "
+            "pending request(s) REJECTED", now - self._no_dispatch_since,
+            pending)
+        for q in self._pending.values():
+            while q:
+                req = q.popleft()
+                if not req.done:
+                    self._reject(req)
+        self._no_dispatch_since = None
 
     # ------------------------------------------------------------ rollout
 
@@ -734,6 +838,13 @@ class FleetRouter:
                 "draining": v.draining, "rolling": v.rolling,
                 "assigned": len(v.assigned),
                 "in_flight": v.in_flight(),
+                # link state (ISSUE 14): RTT on the router host's
+                # monotonic clock — never a cross-host wall compare
+                "link_rtt_ms": (round(v.link_rtt_s * 1e3, 3)
+                                if v.link_rtt_s is not None else None),
+                "link_degraded": v.link_degraded,
+                "reconnects": v.tx_reconnects,
+                "frames_corrupt": v.tx_frames_corrupt,
                 "free_blocks": (v.state or {}).get("free_blocks"),
                 "kv_occupancy": (v.state or {}).get("kv_occupancy"),
                 "prefix_cache_hits": (v.state or {}).get(

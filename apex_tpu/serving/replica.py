@@ -45,7 +45,13 @@ child → parent events
                                  ``max_batch``, ``n_blocks``,
                                  ``debug_port`` (``/healthz`` etc.)
     ``("state", snapshot)``    — rate-limited heartbeat: the engine's
-                                 ``introspect()`` dict + ``hb`` stamp;
+                                 ``introspect()`` dict + ``hb`` stamp
+                                 (**monotonic**, replica-local — one
+                                 clock domain with the worker loop; an
+                                 NTP wall-clock step can never skew a
+                                 heartbeat age, and the router never
+                                 compares it cross-host: liveness runs
+                                 on event *arrival* times);
                                  the router's liveness AND admission
                                  signal (free blocks, queue depth,
                                  draining)
@@ -78,6 +84,20 @@ from typing import Any, Optional, Sequence
 __all__ = ["ReplicaSpec", "ReplicaProcess"]
 
 logger = logging.getLogger(__name__)
+
+
+def _state_snapshot(engine) -> dict:
+    """One state-heartbeat payload: ``introspect()`` + an ``hb`` stamp
+    on the **monotonic** clock.  The worker loop's cadence and the
+    router's probe ladder both run on monotonic time; stamping the
+    snapshot from the wall clock (the pre-ISSUE-14 bug) meant an NTP
+    step could make heartbeat ages jump by the slew — unified here so
+    no clock domain ever mixes wall time into liveness."""
+    import time
+
+    snap = engine.introspect()
+    snap["hb"] = time.monotonic()
+    return snap
 
 
 @dataclasses.dataclass(frozen=True)
@@ -210,9 +230,7 @@ def _replica_worker(spec: ReplicaSpec, name: str, cmd_q, evt_q,
 
         def heartbeat(now: float, force: bool = False) -> float:
             if force or now - last_state >= spec.heartbeat_every_s:
-                snap = engine.introspect()
-                snap["hb"] = time.time()
-                evt_q.put(("state", snap))
+                evt_q.put(("state", _state_snapshot(engine)))
                 return now
             return last_state
 

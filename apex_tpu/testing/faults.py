@@ -22,7 +22,13 @@ drives save→kill→resume and corrupt→fallback→resume end to end:
   checkpoint becomes visible;
 - :func:`simulate_sigterm` — deliver a real SIGTERM to the process (the
   preemption grace signal), driving
-  :class:`apex_tpu.resilience.PreemptionGuard`.
+  :class:`apex_tpu.resilience.PreemptionGuard`;
+- :class:`ChaosProxy` — a TCP proxy between the fleet router and a
+  socket replica (ISSUE 14) injecting the failures a real network
+  throws: partition, half-open (accept-then-silence), slow link, torn
+  frame, crc-corrupt frame, and reconnect churn — each deterministic
+  and healable, so the socket transport's contracts are driven, not
+  asserted.
 
 Everything restores global state on exit; the context managers are
 reentrancy-hostile by design (one fault at a time — compose scenarios
@@ -35,8 +41,10 @@ import contextlib
 import errno
 import os
 import signal
+import socket
 import threading
-from typing import Optional
+import time
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -50,6 +58,7 @@ __all__ = [
     "transient_os_errors",
     "hung_writes",
     "simulate_sigterm",
+    "ChaosProxy",
 ]
 
 
@@ -222,6 +231,291 @@ def hung_writes(*, path_prefix: str = ""):
     finally:
         handle.release()
         ckpt._write_npz = real
+
+
+# ---------------------------------------------------------------------------
+# Network faults (ISSUE 14)
+# ---------------------------------------------------------------------------
+
+
+class _ProxyPair:
+    """One bridged connection (client sock + upstream sock)."""
+
+    def __init__(self, client: socket.socket, upstream: socket.socket):
+        self.client = client
+        self.upstream = upstream
+        self._closed = threading.Event()
+
+    def close(self) -> None:
+        if self._closed.is_set():
+            return
+        self._closed.set()
+        for s in (self.client, self.upstream):
+            try:
+                s.close()
+            except OSError:
+                pass
+
+    @property
+    def closed(self) -> bool:
+        return self._closed.is_set()
+
+
+class ChaosProxy:
+    """TCP chaos between a fleet router and one socket replica.
+
+    Listens on an ephemeral loopback port (``.address``); every
+    accepted connection is bridged to ``upstream`` (a
+    ``TransportServer`` / ``replica_serve`` daemon).  The
+    upstream→client direction is **frame-aware** — it parses the public
+    ``serving.transport`` header (version, length, crc) without ever
+    deserializing a body — so faults land with byte precision:
+
+    - :meth:`partition` — existing connections severed, new connects
+      accepted-then-closed: total silence, the router's heartbeat
+      ladder must produce the down verdict;
+    - :meth:`half_open` — new connections accept but nothing flows
+      (the classic accept-then-silence black hole): the client's hello
+      deadline must churn through it;
+    - :meth:`slow` — every frame/chunk delayed by ``delay_s``: RTT
+      degrades, heartbeats still arrive — placement must *demote*, not
+      fail;
+    - :meth:`tear_next_frame` — the next replica→router frame is cut
+      mid-body and the connection dropped: a torn frame the decoder
+      must detect, never deserialize;
+    - :meth:`corrupt_next_frame` — one bit flipped in the next frame's
+      body: the crc must catch it;
+    - :meth:`drop_connections` — severs at a *frame boundary*
+      (reconnect churn): the session seq-replay must make it lossless;
+    - :meth:`heal` — back to transparent pass-through.
+
+    All controls are thread-safe and take effect at the next frame.
+    """
+
+    def __init__(self, upstream: Tuple[str, int], *,
+                 listen_host: str = "127.0.0.1"):
+        # the ONE header definition — parsing boundaries from a copy
+        # would silently drift if the framing ever changed
+        from apex_tpu.serving.transport import FRAME_HEADER
+
+        self._HEADER = FRAME_HEADER
+        self.upstream = (upstream[0], int(upstream[1]))
+        self._lock = threading.Lock()
+        self._mode = "pass"              # pass | partition | half_open
+        self._delay_s = 0.0
+        self._tear = 0                   # one-shot counters
+        self._corrupt = 0
+        self._cut = False                # boundary-cut flag (churn)
+        self._pairs: list = []
+        self._stalled: list = []         # half-open holds
+        self._closed = False
+        lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        lsock.bind((listen_host, 0))
+        lsock.listen(8)
+        lsock.settimeout(0.2)
+        self._lsock = lsock
+        self.address: Tuple[str, int] = lsock.getsockname()
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="chaos-proxy-accept",
+            daemon=True)
+        self._accept_thread.start()
+
+    # ------------------------------------------------------------- controls
+
+    def partition(self) -> None:
+        with self._lock:
+            self._mode = "partition"
+        self._kill_pairs()
+
+    def half_open(self) -> None:
+        with self._lock:
+            self._mode = "half_open"
+
+    def slow(self, delay_s: float) -> None:
+        with self._lock:
+            self._mode = "pass"
+            self._delay_s = float(delay_s)
+
+    def tear_next_frame(self) -> None:
+        with self._lock:
+            self._tear += 1
+
+    def corrupt_next_frame(self) -> None:
+        with self._lock:
+            self._corrupt += 1
+
+    def drop_connections(self, *, wait_s: float = 5.0) -> None:
+        """Sever every live connection at the next replica→router frame
+        boundary (reconnect churn: a loss the session layer must absorb
+        without a failover)."""
+        with self._lock:
+            if not self._pairs:
+                return
+            self._cut = True
+        deadline = time.monotonic() + wait_s
+        while time.monotonic() < deadline:
+            with self._lock:
+                if all(p.closed for p in self._pairs):
+                    break
+            time.sleep(0.005)
+        with self._lock:
+            self._cut = False
+            self._pairs = [p for p in self._pairs if not p.closed]
+
+    def heal(self) -> None:
+        with self._lock:
+            self._mode = "pass"
+            self._delay_s = 0.0
+        # release half-open holds so the client's next attempt bridges
+        for s in self._drain_stalled():
+            try:
+                s.close()
+            except OSError:
+                pass
+
+    def close(self) -> None:
+        self._closed = True
+        try:
+            self._lsock.close()
+        except OSError:
+            pass
+        self._kill_pairs()
+        for s in self._drain_stalled():
+            try:
+                s.close()
+            except OSError:
+                pass
+
+    def __enter__(self) -> "ChaosProxy":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # ------------------------------------------------------------ plumbing
+
+    def _drain_stalled(self) -> list:
+        with self._lock:
+            stalled, self._stalled = self._stalled, []
+        return stalled
+
+    def _kill_pairs(self) -> None:
+        with self._lock:
+            pairs, self._pairs = self._pairs, []
+        for p in pairs:
+            p.close()
+
+    def _accept_loop(self) -> None:
+        while not self._closed:
+            try:
+                client, _ = self._lsock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            with self._lock:
+                mode = self._mode
+            if mode == "partition":
+                try:
+                    client.close()
+                except OSError:
+                    pass
+                continue
+            if mode == "half_open":
+                with self._lock:
+                    self._stalled.append(client)   # held, never bridged
+                continue
+            try:
+                up = socket.create_connection(self.upstream, timeout=5.0)
+            except OSError:
+                try:
+                    client.close()
+                except OSError:
+                    pass
+                continue
+            pair = _ProxyPair(client, up)
+            with self._lock:
+                self._pairs.append(pair)
+            threading.Thread(target=self._pump_raw, args=(pair,),
+                             daemon=True).start()
+            threading.Thread(target=self._pump_frames, args=(pair,),
+                             daemon=True).start()
+
+    def _fault_gate(self, pair: _ProxyPair) -> bool:
+        """Per-frame/chunk mode check; True = stop pumping this pair."""
+        while True:
+            with self._lock:
+                mode, delay, cut = self._mode, self._delay_s, self._cut
+            if pair.closed or self._closed or mode == "partition" or cut:
+                pair.close()
+                return True
+            if mode == "half_open":
+                time.sleep(0.01)         # stall — silence, not EOF
+                continue
+            if delay > 0:
+                time.sleep(delay)
+            return False
+
+    def _pump_raw(self, pair: _ProxyPair) -> None:
+        """router → replica: raw chunk forwarding."""
+        try:
+            while True:
+                data = pair.client.recv(65536)
+                if not data:
+                    break
+                if self._fault_gate(pair):
+                    return
+                pair.upstream.sendall(data)
+        except OSError:
+            pass
+        finally:
+            pair.close()
+
+    def _recv_exact(self, sock: socket.socket, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            chunk = sock.recv(n - len(buf))
+            if not chunk:
+                raise EOFError
+            buf += chunk
+        return buf
+
+    def _pump_frames(self, pair: _ProxyPair) -> None:
+        """replica → router: frame-aware, so torn/corrupt/cut land at
+        byte-exact positions."""
+        try:
+            while True:
+                header = self._recv_exact(pair.upstream,
+                                          self._HEADER.size)
+                _, length, _ = self._HEADER.unpack(header)
+                body = self._recv_exact(pair.upstream, length)
+                if self._fault_gate(pair):
+                    return
+                frame = header + body
+                with self._lock:
+                    tear = self._tear > 0
+                    if tear:
+                        self._tear -= 1
+                    corrupt = (not tear) and self._corrupt > 0
+                    if corrupt:
+                        self._corrupt -= 1
+                if tear:
+                    # half a frame then FIN: torn mid-body, the decoder
+                    # must refuse to deserialize what did arrive
+                    pair.client.sendall(frame[:max(
+                        self._HEADER.size + 1, len(frame) // 2)])
+                    pair.close()
+                    return
+                if corrupt:
+                    flipped = bytearray(frame)
+                    flipped[-1] ^= 0x10   # body byte: crc must catch it
+                    frame = bytes(flipped)
+                pair.client.sendall(frame)
+        except (OSError, EOFError):
+            pass
+        finally:
+            pair.close()
 
 
 # ---------------------------------------------------------------------------

@@ -26,6 +26,15 @@ real signals:
    answers 200 ``ok``; the SIGKILLed one stops answering at all (the
    liveness half), and the kill is visible in the router's
    ``introspect()``.
+5. **Socket transport under chaos** (ISSUE 14) — three fresh replicas
+   served by ``replica_serve`` daemons over loopback framed TCP, each
+   behind a ``ChaosProxy``.  Mid-decode, one replica's wire is
+   PARTITIONED and another's host process is SIGKILLed; the router
+   (unchanged) detects both through the same ladder, replays on the
+   survivor, and every stream is token-identical to the in-process
+   reference.  The daemons restore the newest VERIFIED checkpoint
+   through the same handshake (the phase-C fallback step), proving the
+   cross-host path end to end.
 
 Run via ``scripts/fleet_smoke.sh``; wired fast-tier in
 ``tests/test_aux_subsystems.py`` (the serving-smoke pattern).
@@ -376,6 +385,113 @@ def main() -> int:
 
         snap = router.introspect()
         log(f"final fleet state: {json.dumps(snap['replicas'])}")
+        router.close()        # free the mp fleet's processes before the
+        router = None         # socket fleet spawns its own engines
+
+        # ---- phase D: socket transport through chaos (ISSUE 14) ---------
+        # Three fresh replicas behind replica_serve daemons on loopback
+        # framed TCP, each wire through a ChaosProxy; one replica
+        # PARTITIONED and another SIGKILLed mid-decode — the router is
+        # byte-for-byte the one that drove phases A-C, which is the
+        # point: the contract is transport-agnostic.
+        from apex_tpu.data._producer import reap_process
+        from apex_tpu.serving.transport import (
+            SocketTransport, start_replica_server)
+        from apex_tpu.testing.faults import ChaosProxy
+
+        t_d = time.monotonic()
+        sock_names = ["s0", "s1", "s2"]
+        procs, proxies = {}, {}
+        sock_router = None
+        try:
+            started = {n: start_replica_server(spec, n,
+                                               addr_timeout_s=300)
+                       for n in sock_names}
+            procs = {n: p for n, (p, _) in started.items()}
+            proxies = {n: ChaosProxy(addr)
+                       for n, (_, addr) in started.items()}
+            clients = [SocketTransport(n, proxies[n].address,
+                                       backoff_initial_s=0.05,
+                                       ping_every_s=0.2)
+                       for n in sock_names]
+            metas_d = {c.name: c.wait_ready(timeout=300)
+                       for c in clients}
+            log(f"3 socket replicas ready in "
+                f"{time.monotonic() - t_d:.1f}s, ckpt steps "
+                f"{[m['ckpt_step'] for m in metas_d.values()]}")
+            if any(m["ckpt_step"] != 2 for m in metas_d.values()):
+                log(f"FAIL: socket fleet not on the fallback step 2: "
+                    f"{metas_d}")
+                return 1
+            reg_d = MetricRegistry(rank=0, world=1)
+            sock_router = FleetRouter(
+                clients, max_queue_depth=12, replica_queue_limit=4,
+                heartbeat_timeout_s=2.0, probe_retries=2,
+                probe_backoff_s=0.25, registry=reg_d)
+            waves_d = [
+                (rng.randint(1, VOCAB - 1,
+                             size=rng.randint(2, 9)).tolist(),
+                 int(rng.randint(10, 15)))
+                for _ in range(4)]
+            reqs_d = [sock_router.submit(p, n) for p, n in waves_d]
+            partitioned = killed = None
+            deadline = time.monotonic() + 90
+            while partitioned is None or killed is None:
+                sock_router.pump()
+                for view in sock_router._views.values():
+                    if view.down:
+                        continue
+                    mid = [r for r in view.assigned.values()
+                           if 1 <= len(r.output_tokens)
+                           < r.max_new_tokens]
+                    if not mid:
+                        continue
+                    if partitioned is None:
+                        partitioned = view.name
+                        proxies[view.name].partition()
+                    elif killed is None and view.name != partitioned:
+                        killed = view.name
+                        procs[view.name].kill()   # SIGKILL the host
+                if sock_router.idle():
+                    log("FAIL: phase D drained before both faults "
+                        "landed mid-decode")
+                    return 1
+                if time.monotonic() > deadline:
+                    log(f"FAIL: no mid-decode fault window in 90s "
+                        f"(partitioned={partitioned}, killed={killed})")
+                    return 1
+                time.sleep(0.001)
+            log(f"partitioned {partitioned}'s wire, SIGKILLed "
+                f"{killed}'s host, both mid-decode")
+            sock_router.run_until_idle(timeout_s=180)
+            if not check_identity(sock_router, reqs_d, waves_d, greedy,
+                                  "D"):
+                return 1
+            snap_d = reg_d.snapshot()
+            down = {n: v.down for n, v in sock_router._views.items()}
+            if not (down[partitioned] and down[killed]
+                    and snap_d.get("fleet/failovers") == 2.0):
+                log(f"FAIL: socket failovers not recorded "
+                    f"(down={down}, "
+                    f"failovers={snap_d.get('fleet/failovers')})")
+                return 1
+            replays_d = sum(r.replays for r in reqs_d)
+            log(f"phase D OK: {len(waves_d)} streams token-identical "
+                f"over framed TCP through a partition + a SIGKILL "
+                f"({replays_d} replayed; socket fleet on step 2) in "
+                f"{time.monotonic() - t_d:.1f}s")
+        finally:
+            if sock_router is not None:
+                sock_router.close()
+            for proxy in proxies.values():
+                proxy.close()
+            for n, p in procs.items():
+                try:
+                    p.terminate()      # SIGTERM: guard drain, exit 0
+                except Exception:
+                    pass
+                reap_process(p, 15.0, what=f"socket replica {n}")
+
         print("PASS", file=sys.stderr, flush=True)
         return 0
     finally:

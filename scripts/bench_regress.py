@@ -79,6 +79,10 @@ TOLERANCES = {
     # cycling, which shifts with any model/config change; the ratio
     # vs_baseline is the stable signal, the absolute rate is not
     "serving_spec": 0.6,
+    # 3 replica processes + the loopback socket leg (wire_vs_inproc)
+    # on a shared CPU host: process scheduling noise dominates both
+    # the absolute rate and the transport ratio
+    "serving_fleet": 0.6,
 }
 
 # Hard ceilings on whitelist fields — standing acceptance gates, not

@@ -9,10 +9,15 @@
 #   - staggered zero-downtime weight rollout under load: SIGTERM drain
 #     -> restore newest VERIFIED checkpoint (corrupt newest falls back)
 #     -> rejoin, with zero failed requests and bounded p99 TPOT;
-#   - /healthz answers ok on live replicas, refuses on the killed one.
+#   - /healthz answers ok on live replicas, refuses on the killed one;
+#   - socket-transport leg (ISSUE 14): three replica_serve daemons over
+#     loopback framed TCP behind ChaosProxy — one wire PARTITIONED and
+#     one host SIGKILLed mid-decode, every stream token-identical to
+#     the in-process reference, the router unchanged.
 # Router policy logic is unit-tested hermetically in
-# tests/test_fleet.py; this script is the end-to-end proof.  Wired
-# fast-tier in tests/test_aux_subsystems.py like the PR 8/9 smokes.
+# tests/test_fleet.py (transport + chaos in tests/test_transport.py);
+# this script is the end-to-end proof.  Wired fast-tier in
+# tests/test_aux_subsystems.py like the PR 8/9 smokes.
 #
 # Usage: scripts/fleet_smoke.sh
 set -euo pipefail

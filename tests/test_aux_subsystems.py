@@ -164,8 +164,12 @@ def test_fleet_smoke_script():
     weight rollout under load restores the newest VERIFIED checkpoint
     (corrupt newest falls back), finishes every request, and keeps p99
     TPOT bounded; /healthz answers on live replicas and refuses on the
-    killed one.  Subprocess because the smoke spawns replica processes
-    and owns its own platform pinning (the serving-smoke pattern)."""
+    killed one.  Phase D (ISSUE 14): the same fleet contract over
+    framed loopback TCP — replica_serve daemons behind ChaosProxy, one
+    wire partitioned and one host SIGKILLed mid-decode, every stream
+    token-identical.  Subprocess because the smoke spawns replica
+    processes and owns its own platform pinning (the serving-smoke
+    pattern)."""
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
@@ -173,12 +177,13 @@ def test_fleet_smoke_script():
     env["PYTHON"] = sys.executable
     proc = subprocess.run(
         ["bash", os.path.join(repo, "scripts", "fleet_smoke.sh")],
-        cwd=repo, env=env, capture_output=True, timeout=560)
+        cwd=repo, env=env, capture_output=True, timeout=700)
     assert proc.returncode == 0, (
         f"fleet_smoke.sh rc={proc.returncode}\n"
         f"stderr tail:\n{proc.stderr.decode(errors='replace')[-3000:]}")
     assert b"PASS" in proc.stderr
-    for phase in (b"phase A OK", b"phase B OK", b"phase C OK"):
+    for phase in (b"phase A OK", b"phase B OK", b"phase C OK",
+                  b"phase D OK"):
         assert phase in proc.stderr
 
 

@@ -82,7 +82,9 @@ def _worst_case_results():
                           "p99_tpot_ms_steady": 3.4,
                           "p99_tpot_ms_roll": 4.1,
                           "roll_vs_steady": 1.206,
-                          "roll_wall_s": 46.7},
+                          "roll_wall_s": 46.7,
+                          "tokens_per_sec_socket": 2688.2,
+                          "wire_vs_inproc": 0.866},
         "serving_spec": {"value": 2154.2, "unit": "tokens/sec",
                          "vs_baseline": 2.256,
                          "mean_accept_len": 4.0,
@@ -158,8 +160,14 @@ def test_compact_record_under_1500_bytes():
     # ``roll_wall_s`` stay in the full record's config/prose only)
     fl = compact["rows"]["serving_fleet"]
     assert fl["p99_tpot_ms_steady"] == 3.4
-    assert fl["p99_tpot_ms_roll"] == 4.1
     assert fl["roll_vs_steady"] == 1.206
+    # the worst case sheds the roll p99 (== steady * roll_vs_steady);
+    # the full record keeps it
+    assert "p99_tpot_ms_roll" not in fl
+    assert record["extras"]["serving_fleet"]["p99_tpot_ms_roll"] == 4.1
+    # ISSUE 14 socket-transport sub-row: the wire ratio is tracked
+    # (``tokens_per_sec_socket`` stays in the full record only)
+    assert fl["wire_vs_inproc"] == 0.866
     # ISSUE 13 speculative sub-rows survive the distillation (the
     # per-concurrency baseline/ratio curves and ``acceptance_rate`` —
     # reconstructible from the accept length — stay in the full record)

@@ -840,6 +840,128 @@ def test_stream_of_unknown_rid_raises():
         next(router.stream(12345))
 
 
+def test_stream_inactivity_deadline_resets_across_failover():
+    """ISSUE 14 satellite: the stream timeout is an INACTIVITY bound
+    and replayed tokens are activity — a healthy mid-stream failover
+    must never trip it, even when the total stream duration is many
+    times the timeout.  Pinned with an injected clock that advances
+    4s per pump against a 10s timeout over a 6-token stream (24s of
+    healthy streaming + a failover gap, all inside the bound only
+    because the deadline resets on every surfaced token)."""
+    clock = [0.0]
+    victim = FakeReplica("victim", free_blocks=1000, die_after_tokens=3)
+    survivor = FakeReplica("survivor", free_blocks=10)
+    router = _ticking(
+        make_router([victim, survivor], clock=lambda: clock[0]),
+        [victim, survivor])
+    orig_pump = router.pump
+
+    def pump():
+        orig_pump()
+        clock[0] += 4.0
+
+    router.pump = pump
+    req = router.submit([9, 1, 4], 6)
+    seen = list(router.stream(req, poll_s=0, timeout_s=10.0))
+    assert seen == reference([9, 1, 4], 6)
+    assert req.replays == 1
+    assert clock[0] > 10.0, "the stream must outlive the raw timeout"
+
+
+def test_stream_times_out_on_genuinely_dead_fleet():
+    """The other half of the inactivity contract: a fleet that stops
+    producing (every replica dead, nothing terminal) still trips the
+    bound instead of hanging the consumer forever."""
+    clock = [0.0]
+    victim = FakeReplica("victim", die_after_tokens=2)
+    router = _ticking(
+        make_router([victim], clock=lambda: clock[0],
+                    dispatch_deadline_s=float("inf")),
+        [victim])
+    orig_pump = router.pump
+
+    def pump():
+        orig_pump()
+        clock[0] += 4.0
+
+    router.pump = pump
+    req = router.submit([9, 1, 4], 6)
+    stream = router.stream(req, poll_s=0, timeout_s=10.0)
+    with pytest.raises(RuntimeError, match="no token"):
+        for _ in stream:
+            pass
+    assert not req.done                   # silence, not a terminal state
+
+
+# --------------------------------- ISSUE 14: clocks + unreachable shed
+
+
+def test_heartbeat_stamp_is_monotonic_under_wall_clock_jump(monkeypatch):
+    """The replica's ``hb`` heartbeat stamp rides the monotonic clock:
+    an NTP wall-clock step (hours, either direction) between two
+    snapshots must not move heartbeat ages at all."""
+    import time as time_mod
+
+    from apex_tpu.serving.replica import _state_snapshot
+
+    class Eng:
+        def introspect(self):
+            return {"queue_depth": 0}
+
+    walls = iter([1e9, 1e9 + 7200.0, 1e9 - 3600.0])
+    monkeypatch.setattr(time_mod, "time", lambda: next(walls, 0.0))
+    s1 = _state_snapshot(Eng())
+    s2 = _state_snapshot(Eng())
+    s3 = _state_snapshot(Eng())
+    assert 0.0 <= s2["hb"] - s1["hb"] < 5.0
+    assert 0.0 <= s3["hb"] - s2["hb"] < 5.0
+
+
+def test_wall_clock_jump_never_triggers_false_failover():
+    """Router-side half of the satellite: liveness runs on event
+    ARRIVAL times (the injected monotonic clock), so heartbeats whose
+    ``hb`` payload jumps by hours — the NTP-step shape — arm no probes
+    and produce no down verdict."""
+    clock = [0.0]
+    rep = FakeReplica("a")
+    router = make_router([rep], heartbeat_timeout_s=1.0,
+                         probe_retries=2, probe_backoff_s=0.2,
+                         clock=lambda: clock[0])
+    router.pump()
+    for i, wild_hb in enumerate([1e9, 1e9 + 7200.0, 1e9 - 3600.0, 0.0]):
+        clock[0] += 0.5                   # inside the timeout per beat
+        rep._events.append(("state", {"free_blocks": 100,
+                                      "queue_depth": 0,
+                                      "hb": wild_hb}))
+        router.pump()
+        view = router._views["a"]
+        assert not view.down and view.probes == 0, (i, wild_hb)
+
+
+def test_unreachable_fleet_sheds_pending_after_bounded_deadline():
+    """Hermetic twin of the ChaosProxy partition test: with every
+    replica down, pending requests wait exactly the bounded deadline
+    on the injected clock, then shed typed REJECTED."""
+    clock = [0.0]
+    rep = FakeReplica("a")
+    router = make_router([rep], clock=lambda: clock[0],
+                         dispatch_deadline_s=3.0)
+    router.pump()
+    rep.kill()
+    router.pump()                         # down verdict (dead process)
+    assert router._views["a"].down
+    req = router.submit([1, 2], 4)
+    router.pump()                         # window opens
+    clock[0] = 2.9
+    router.pump()
+    assert req.state is RequestState.WAITING   # inside the bound: wait
+    clock[0] = 3.2
+    router.pump()
+    assert req.state is RequestState.REJECTED
+    assert router.registry.snapshot()["serving/requests_rejected"] == 1.0
+    assert router.idle()
+
+
 # ------------------------------------------------------ introspection
 
 
