@@ -60,6 +60,14 @@ from apex_tpu.observability.metrics import (
     peak_flops_reason,
 )
 from apex_tpu.observability.timeline import FlightRecorder
+from apex_tpu.observability.trace import (
+    TRACE_HOP_BUCKETS,
+    estimate_offset,
+    format_trace_report,
+    merge_dir,
+    stitch_traces,
+    summarize_traces,
+)
 from apex_tpu.observability.spans import (
     TraceWindow,
     named_span,
@@ -112,4 +120,10 @@ __all__ = [
     "goodput_report",
     "serving_goodput_report",
     "format_report",
+    "TRACE_HOP_BUCKETS",
+    "estimate_offset",
+    "stitch_traces",
+    "summarize_traces",
+    "merge_dir",
+    "format_trace_report",
 ]

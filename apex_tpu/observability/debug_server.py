@@ -148,6 +148,21 @@ class DebugServer:
                 out["serving"] = {"error": repr(e)}
         return out
 
+    def fleet_statusz(self) -> Optional[dict]:
+        """The fleet aggregation plane (ISSUE 15): when the attached
+        engine duck-types ``fleet_statusz()`` (a
+        :class:`~apex_tpu.serving.fleet.FleetRouter`), its merged
+        heartbeats + per-tenant/per-priority SLO view; ``None`` (a 404)
+        otherwise — a plain engine has no fleet to aggregate."""
+        engine = self.engine
+        fn = getattr(engine, "fleet_statusz", None)
+        if fn is None:
+            return None
+        try:
+            return fn()
+        except Exception as e:  # aggregation must never 500 a scrape
+            return {"error": repr(e)}
+
     def healthz(self) -> tuple:
         """``(http_code, payload)`` for ``/healthz``: 200 ``ok`` / 503
         ``draining`` / 503 ``down`` — the readiness half of the health
@@ -193,13 +208,24 @@ class DebugServer:
                                    json.dumps(server.statusz(),
                                               default=str).encode(),
                                    "application/json")
+                    elif self.path.split("?")[0] == "/fleet/statusz":
+                        payload = server.fleet_statusz()
+                        if payload is None:
+                            self._send(404, b"no fleet attached\n",
+                                       "text/plain")
+                        else:
+                            self._send(200,
+                                       json.dumps(payload,
+                                                  default=str).encode(),
+                                       "application/json")
                     elif self.path.split("?")[0] == "/healthz":
                         code, payload = server.healthz()
                         self._send(code, json.dumps(payload).encode(),
                                    "application/json")
                     elif self.path.split("?")[0] == "/":
                         self._send(200, b"apex_tpu debug server: "
-                                   b"/metrics /statusz /healthz\n",
+                                   b"/metrics /statusz /healthz "
+                                   b"/fleet/statusz\n",
                                    "text/plain")
                     else:
                         self._send(404, b"not found\n", "text/plain")

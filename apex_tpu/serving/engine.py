@@ -88,7 +88,12 @@ from apex_tpu.serving.kv_cache import (
 )
 from apex_tpu.serving.model import DecodeModel
 from apex_tpu.serving.sampling import SamplingParams
-from apex_tpu.serving.scheduler import Request, RequestState, Scheduler
+from apex_tpu.serving.scheduler import (
+    Request,
+    RequestState,
+    Scheduler,
+    trace_fields,
+)
 from apex_tpu.serving.speculative import NGramProposer, SpeculativeConfig
 
 __all__ = ["ServingConfig", "ServingEngine"]
@@ -316,22 +321,34 @@ class ServingEngine:
 
     def submit(self, prompt: Sequence[int], max_new_tokens: int,
                eos_id: Optional[int] = None,
-               sampling: Optional[SamplingParams] = None) -> Request:
+               sampling: Optional[SamplingParams] = None,
+               trace: Optional[dict] = None) -> Request:
+        """``trace``: the fleet-minted trace context riding the replica
+        wire (``{"trace_id": ..., "attempt": ...}``, ISSUE 15) — every
+        timeline event of this request then carries the fleet-wide id,
+        so N processes' spills stitch into one span tree.  ``None``
+        (standalone engines, untraced fleets) keeps the events exactly
+        as before."""
         if len(np.shape(prompt)) != 1:
             raise ValueError(
                 f"prompt must be 1-D, got shape {np.shape(prompt)}")
         req = self.scheduler.submit(prompt, max_new_tokens, eos_id,
                                     sampling)
+        if trace is not None:
+            req.trace_id = trace.get("trace_id")
+            req.trace_attempt = int(trace.get("attempt", 0))
         timeline.emit("request_submit", rid=req.rid,
                       prompt_tokens=len(req.prompt),
-                      max_new_tokens=max_new_tokens)
+                      max_new_tokens=max_new_tokens,
+                      **trace_fields(req))
         if req.state is RequestState.REJECTED:
             # submitted into the drain window: refused with a typed
             # terminal state (never queued, never a hang) and counted
             # apart from drain cancellations — a router re-routes a
             # REJECTED request, it does not mourn it
             self.registry.counter("serving/requests_rejected").inc()
-            timeline.emit("request_reject", rid=req.rid)
+            timeline.emit("request_reject", rid=req.rid,
+                          **trace_fields(req))
         return req
 
     # --------------------------------------------------------------- drain
@@ -345,7 +362,8 @@ class ServingEngine:
             self.registry.counter("serving/requests_cancelled").inc(
                 len(cancelled))
         for req in cancelled:
-            timeline.emit("request_cancel", rid=req.rid)
+            timeline.emit("request_cancel", rid=req.rid,
+                          **trace_fields(req))
         self.registry.counter("serving/preemption_drains").inc()
         return cancelled
 
@@ -361,7 +379,8 @@ class ServingEngine:
         for req in admitted:
             timeline.emit("request_admit", rid=req.rid, slot=req.slot,
                           blocks=len(req.blocks),
-                          hit_blocks=req.hit_blocks)
+                          hit_blocks=req.hit_blocks,
+                          **trace_fields(req))
         self._prefill_tick()
         self._decode_once()
         self._steps += 1
@@ -495,7 +514,13 @@ class ServingEngine:
             self.scheduler.note_prefilled(req, chunk)
             if not req.prefilling:
                 # prompt complete: the in-graph sample at its last
-                # prompt position is the request's next output token
+                # prompt position is the request's next output token.
+                # The prefilled marker is the trace walk's prefill →
+                # decode boundary (ISSUE 15) — re-emitted per admission
+                # (a preempted request's recompute prefill ends here too)
+                timeline.emit("request_prefilled", rid=req.rid,
+                              tokens=req.prefill_target,
+                              **trace_fields(req))
                 self._emit(req, int(next_np[req.slot]), now)
 
     # -------------------------------------------------------------- decode
@@ -709,7 +734,8 @@ class ServingEngine:
         self.registry.counter("serving/tokens_generated").inc()
         n = len(req.output_tokens)
         if n % self.timeline_tick_every == 0:
-            timeline.emit("decode_tick", rid=req.rid, tokens=n)
+            timeline.emit("decode_tick", rid=req.rid, tokens=n,
+                          **trace_fields(req))
         if (n >= req.max_new_tokens
                 or (req.eos_id is not None and token == req.eos_id)):
             self._finish(req)
@@ -719,4 +745,5 @@ class ServingEngine:
         self.scheduler.finish(req)
         self.registry.counter("serving/requests_finished").inc()
         timeline.emit("request_finish", rid=req.rid,
-                      tokens=len(req.output_tokens))
+                      tokens=len(req.output_tokens),
+                      **trace_fields(req))

@@ -88,6 +88,24 @@ counters, ``fleet/reconnects`` / ``fleet/frames_corrupt`` /
 ``fleet/link_degraded`` transport counters (ISSUE 14),
 ``fleet/replicas_live`` / ``fleet/queue_depth`` gauges,
 ``fleet/ttft_ms`` / ``fleet/tpot_ms`` histograms (router-observed).
+
+ISSUE 15 — the observability plane over the fleet: with a flight
+recorder armed (:mod:`~apex_tpu.observability.timeline`), ``submit``
+mints a ``trace_id`` per request and every dispatch stamps
+``{trace_id, attempt}`` onto the wire, so the router's hop events
+(``fleet_submit`` / ``fleet_dispatch`` / ``fleet_replay`` /
+``fleet_finish`` / ``fleet_reject``) and every replica's engine events
+stitch into ONE per-request trace across processes
+(:mod:`~apex_tpu.observability.trace`); the socket transport's clock
+samples are spilled as ``link_clock`` events (cross-host mapping) and
+fed to per-replica ``fleet/link_rtt_ms/<name>`` windowed histograms
+(RTT tails next to the point value the demotion reads).  SLO
+accounting rides the same registry: ``fleet/tenant/<t>/*`` and
+``fleet/priority/<p>/*`` windowed ttft/tpot/queue-wait percentiles +
+finished/rejected/replay counts, served merged (with replica
+heartbeats and transport counters) by :meth:`FleetRouter.
+fleet_statusz` → the debug server's ``/fleet/statusz``.  Unarmed,
+all of it is a None check.
 """
 
 from __future__ import annotations
@@ -97,10 +115,12 @@ import dataclasses
 import itertools
 import logging
 import time
+import uuid
 from typing import Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
+from apex_tpu.observability import timeline
 from apex_tpu.serving.sampling import SamplingParams
 from apex_tpu.serving.scheduler import RequestState
 
@@ -130,6 +150,17 @@ class FleetRequest:
     t_submit: float = 0.0
     t_first_token: Optional[float] = None
     t_last_token: Optional[float] = None
+    # distributed tracing (ISSUE 15): the fleet-wide trace id minted at
+    # submit when a flight recorder is armed (None otherwise — tracing
+    # unarmed is a None check end to end), and how many times dispatch
+    # has seated this request (the hop stamp's attempt number: attempt
+    # k > 1 means a failover replay / drain reschedule re-dispatch)
+    trace_id: Optional[str] = None
+    dispatches: int = 0
+    # bounded SLO accounting keys, resolved ONCE at submit (the token
+    # path is the router's hottest loop — it must not re-derive them
+    # per token): (tenant_key, priority_key), "(other)" past the cap
+    slo_keys: tuple = ("default", "0")
 
     @property
     def done(self) -> bool:
@@ -167,6 +198,9 @@ class _ReplicaView:
         self.tx_frames_corrupt = 0
         self.link_rtt_s: Optional[float] = None
         self.link_degraded = False
+        # mp-relay batching mirror (ISSUE 15 satellite)
+        self.tx_relay_batches = 0
+        self.tx_relay_events = 0
 
     @property
     def name(self) -> str:
@@ -230,6 +264,7 @@ class FleetRouter:
                  affinity_occupancy_cap: float = 0.95,
                  link_degraded_rtt_s: float = 1.0,
                  dispatch_deadline_s: float = 120.0,
+                 slo_key_cap: int = 64,
                  registry=None, clock: Callable[[], float] = time.monotonic):
         from apex_tpu.observability.metrics import default_registry
 
@@ -281,9 +316,26 @@ class FleetRouter:
         # prefix-cache affinity: tenant -> the replica that last served
         # it (whose PrefixCache plausibly holds the tenant's template
         # blocks); a placement tie-break, gated on the replica's
-        # heartbeat-reported kv_occupancy staying under the cap
+        # heartbeat-reported kv_occupancy staying under the cap.
+        # LRU-bounded (insertion order + refresh-on-write): tenants are
+        # caller-supplied strings, and an unbounded map would grow
+        # forever under unique-tenant-per-request traffic — losing an
+        # affinity hint costs one cold prefill, never correctness
         self.affinity_occupancy_cap = affinity_occupancy_cap
         self._tenant_affinity: Dict[str, str] = {}
+        self._tenant_affinity_cap = 4096
+        # SLO accounting (ISSUE 15): the tenant / priority-class keys
+        # ever seen, so /fleet/statusz can enumerate its per-key
+        # windowed histograms and counters without walking the
+        # registry.  Tenants are caller-supplied strings, so the key
+        # space is CAPPED: past slo_key_cap distinct keys, new arrivals
+        # account under the "(other)" overflow bucket — a client
+        # stamping a unique tenant per request must not grow router
+        # memory (3 windowed histograms + counters per key) or scrape
+        # size without bound.
+        self.slo_key_cap = slo_key_cap
+        self._slo_tenants: set = set()
+        self._slo_priorities: set = set()
 
     # ----------------------------------------------------------- tenants
 
@@ -343,16 +395,59 @@ class FleetRouter:
             tenant=tenant, priority=int(priority), sampling=sampling,
             t_submit=time.monotonic())
         self.requests[req.rid] = req
+        self._slo_keys(req)
         self.registry.counter("fleet/requests_submitted").inc()
+        if timeline.active() is not None:
+            # trace context minted HERE (the request's first hop is the
+            # router); unarmed routers mint nothing — the free-telemetry
+            # None-check discipline applied to tracing
+            req.trace_id = uuid.uuid4().hex[:16]
+            timeline.emit("fleet_submit", rid=req.rid,
+                          trace_id=req.trace_id, tenant=req.tenant,
+                          priority=req.priority,
+                          prompt_tokens=int(req.prompt.size),
+                          max_new_tokens=req.max_new_tokens)
         if self.total_queue_depth() >= self.max_queue_depth:
             self._reject(req)
             return req
         self._enqueue(req)
         return req
 
+    def _slo_hist(self, name: str):
+        return self.registry.histogram(name, keep_samples=4096)
+
+    def _slo_key(self, keys: set, key) -> str:
+        """Bounded SLO accounting key: a known key passes through, a
+        new one registers while the cap holds, and everything past the
+        cap lands in the "(other)" overflow bucket (metrics stay
+        bounded however many distinct tenants callers invent)."""
+        key = str(key)
+        if key in keys:
+            return key
+        if len(keys) >= self.slo_key_cap:
+            keys.add("(other)")
+            return "(other)"
+        keys.add(key)
+        return key
+
+    def _slo_keys(self, req: FleetRequest) -> tuple:
+        """Resolve (and cache on the request) its bounded accounting
+        keys — called once at submit; every later site reads the
+        cached pair."""
+        req.slo_keys = (
+            self._slo_key(self._slo_tenants, req.tenant),
+            self._slo_key(self._slo_priorities, req.priority))
+        return req.slo_keys
+
     def _reject(self, req: FleetRequest) -> None:
         req.state = RequestState.REJECTED
         self.registry.counter("serving/requests_rejected").inc()
+        tkey, pkey = req.slo_keys
+        self.registry.counter(f"fleet/tenant/{tkey}/rejected").inc()
+        self.registry.counter(f"fleet/priority/{pkey}/rejected").inc()
+        if req.trace_id is not None:
+            timeline.emit("fleet_reject", rid=req.rid,
+                          trace_id=req.trace_id)
         self._note_done(req)
 
     def _note_done(self, req: FleetRequest) -> None:
@@ -412,6 +507,34 @@ class FleetRouter:
             self.registry.counter("fleet/frames_corrupt").inc(
                 corrupt - view.tx_frames_corrupt)
             view.tx_frames_corrupt = corrupt
+        # batched mp-relay mirror (ISSUE 15 satellite): how many events
+        # crossed in batches vs one-per-put — the in-proc leg of the
+        # wire_vs_inproc story, now visible
+        batches = int(getattr(client, "relay_batches", 0) or 0)
+        if batches > view.tx_relay_batches:
+            self.registry.counter("fleet/relay_batch").inc(
+                batches - view.tx_relay_batches)
+            view.tx_relay_batches = batches
+        revents = int(getattr(client, "relay_batched_events", 0) or 0)
+        if revents > view.tx_relay_events:
+            self.registry.counter("fleet/relay_batch_events").inc(
+                revents - view.tx_relay_events)
+            view.tx_relay_events = revents
+        # link RTT: every round trip becomes a sample in the per-replica
+        # windowed histogram (ISSUE 15 satellite — the point gauge kept
+        # no tails, so link_degraded_rtt_s was judged against one
+        # number), and each sample's clock-offset estimate is spilled as
+        # a link_clock event for cross-host trace stitching
+        take = getattr(client, "take_rtt_samples", None)
+        if take is not None:
+            for rtt_s, offset_s, remote_mono in take():
+                self._slo_hist(
+                    f"fleet/link_rtt_ms/{view.name}").observe(
+                        rtt_s * 1e3)
+                timeline.emit("link_clock", replica=view.name,
+                              rtt_s=round(rtt_s, 6),
+                              offset_s=round(offset_s, 6),
+                              remote_mono=round(remote_mono, 6))
         rtt = getattr(client, "link_rtt_s", None)
         view.link_rtt_s = rtt
         degraded = rtt is not None and rtt > self.link_degraded_rtt_s
@@ -454,15 +577,27 @@ class FleetRouter:
             if req is None or req.done:
                 return
             now = time.monotonic()
+            tkey, pkey = req.slo_keys
             if req.t_first_token is None:
                 req.t_first_token = now
+                ttft_ms = (now - req.t_submit) * 1e3
                 self.registry.histogram(
-                    "fleet/ttft_ms", keep_samples=4096).observe(
-                        (now - req.t_submit) * 1e3)
+                    "fleet/ttft_ms", keep_samples=4096).observe(ttft_ms)
+                # per-tenant / per-priority SLO windows (ISSUE 15): the
+                # same router-observed latency, keyed so /fleet/statusz
+                # can answer "whose p99 blew up" instead of "the fleet's"
+                self._slo_hist(
+                    f"fleet/tenant/{tkey}/ttft_ms").observe(ttft_ms)
+                self._slo_hist(
+                    f"fleet/priority/{pkey}/ttft_ms").observe(ttft_ms)
             else:
+                tpot_ms = (now - req.t_last_token) * 1e3
                 self.registry.histogram(
-                    "fleet/tpot_ms", keep_samples=65536).observe(
-                        (now - req.t_last_token) * 1e3)
+                    "fleet/tpot_ms", keep_samples=65536).observe(tpot_ms)
+                self._slo_hist(
+                    f"fleet/tenant/{tkey}/tpot_ms").observe(tpot_ms)
+                self._slo_hist(
+                    f"fleet/priority/{pkey}/tpot_ms").observe(tpot_ms)
             req.t_last_token = now
             req.output_tokens.append(int(token))
         elif kind == "finished":
@@ -480,7 +615,9 @@ class FleetRouter:
             if req is not None and not req.done:
                 req.reschedules += 1
                 self.registry.counter("fleet/reschedules").inc()
-                self._requeue_or_park(req, f"replica {view.name} {kind}")
+                self._requeue_or_park(
+                    req, f"replica {view.name} {kind}",
+                    replica=view.name)
         elif kind == "drained":
             view.drained = True
             view.draining = True
@@ -495,9 +632,17 @@ class FleetRouter:
         if view is not None:
             view.assigned.pop(req.rid, None)
         self.registry.counter("fleet/requests_finished").inc()
+        tkey, pkey = req.slo_keys
+        self.registry.counter(f"fleet/tenant/{tkey}/finished").inc()
+        self.registry.counter(f"fleet/priority/{pkey}/finished").inc()
+        if req.trace_id is not None:
+            timeline.emit("fleet_finish", rid=req.rid,
+                          trace_id=req.trace_id,
+                          tokens=len(req.output_tokens))
         self._note_done(req)
 
-    def _requeue_or_park(self, req: FleetRequest, why: str) -> None:
+    def _requeue_or_park(self, req: FleetRequest, why: str, *,
+                         replica: Optional[str] = None) -> None:
         """Put a bounced request back in the pool — unless it has burnt
         ``max_attempts`` re-routes, in which case it is parked in the
         typed REJECTED terminal state (a poison request every replica
@@ -508,6 +653,13 @@ class FleetRouter:
                 "it REJECTED", req.rid, self.max_attempts, why)
             self._reject(req)
             return
+        if req.trace_id is not None:
+            # the trace walk's failover_replay boundary: from the dead
+            # replica's last flushed event up to the NEXT fleet_dispatch
+            # is replay cost, not decode (observability/trace.py)
+            timeline.emit("fleet_replay", rid=req.rid,
+                          trace_id=req.trace_id, replica=replica,
+                          reason=why)
         self._enqueue(req, front=True)
 
     # ------------------------------------------------- failure detection
@@ -575,7 +727,10 @@ class FleetRouter:
                 continue
             req.replays += 1
             self.registry.counter("fleet/replays").inc()
-            self._requeue_or_park(req, f"replica {view.name} down")
+            self.registry.counter(
+                f"fleet/tenant/{req.slo_keys[0]}/replays").inc()
+            self._requeue_or_park(req, f"replica {view.name} down",
+                                  replica=view.name)
         view.assigned.clear()
 
     def _context_limits(self) -> tuple:
@@ -696,11 +851,38 @@ class FleetRouter:
                     + len(req.output_tokens))
             req.state = RequestState.RUNNING
             req.replica = view.name
+            req.dispatches += 1
             view.assigned[req.rid] = req
+            self._tenant_affinity.pop(req.tenant, None)   # refresh LRU
             self._tenant_affinity[req.tenant] = view.name
+            if len(self._tenant_affinity) > self._tenant_affinity_cap:
+                self._tenant_affinity.pop(
+                    next(iter(self._tenant_affinity)))
+            if req.dispatches == 1 and req.t_first_token is None:
+                # router-side queue wait, observed once per request
+                wait_ms = (time.monotonic() - req.t_submit) * 1e3
+                tkey, pkey = req.slo_keys
+                self._slo_hist(
+                    f"fleet/tenant/{tkey}/queue_wait_ms").observe(
+                        wait_ms)
+                self._slo_hist(
+                    f"fleet/priority/{pkey}/queue_wait_ms").observe(
+                        wait_ms)
+            trace = None
+            if req.trace_id is not None:
+                # the hop stamp: replica + attempt ride the wire so the
+                # replica-side events of a re-dispatched request are
+                # distinguishable from its first incarnation's
+                trace = {"trace_id": req.trace_id,
+                         "attempt": req.dispatches}
+                timeline.emit("fleet_dispatch", rid=req.rid,
+                              trace_id=req.trace_id,
+                              attempt=req.dispatches,
+                              replica=view.name,
+                              prior_tokens=len(req.output_tokens))
             batches.setdefault(view.name, (view, []))[1].append(
                 (req.rid, wire_prompt, req.remaining, req.eos_id,
-                 sampling))
+                 sampling, trace))
         for view, items in batches.values():
             try:
                 if len(items) > 1 and hasattr(view.client, "submit_many"):
@@ -832,6 +1014,7 @@ class FleetRouter:
         ``DebugServer(engine=router)`` serves the fleet at /statusz."""
         replicas = {}
         for name, v in self._views.items():
+            rtt_hist = self._slo_hist(f"fleet/link_rtt_ms/{name}")
             replicas[name] = {
                 "ready": v.ready, "down": v.down,
                 "down_reason": v.down_reason,
@@ -839,12 +1022,19 @@ class FleetRouter:
                 "assigned": len(v.assigned),
                 "in_flight": v.in_flight(),
                 # link state (ISSUE 14): RTT on the router host's
-                # monotonic clock — never a cross-host wall compare
+                # monotonic clock — never a cross-host wall compare.
+                # p50/p99 answer over the windowed histogram (ISSUE 15
+                # satellite): link *jitter* tails next to the latest
+                # point value the degradation verdict reads
                 "link_rtt_ms": (round(v.link_rtt_s * 1e3, 3)
                                 if v.link_rtt_s is not None else None),
+                "link_rtt_p50_ms": rtt_hist.percentile(50),
+                "link_rtt_p99_ms": rtt_hist.percentile(99),
                 "link_degraded": v.link_degraded,
                 "reconnects": v.tx_reconnects,
                 "frames_corrupt": v.tx_frames_corrupt,
+                "relay_batches": v.tx_relay_batches,
+                "relay_batched_events": v.tx_relay_events,
                 "free_blocks": (v.state or {}).get("free_blocks"),
                 "kv_occupancy": (v.state or {}).get("kv_occupancy"),
                 "prefix_cache_hits": (v.state or {}).get(
@@ -863,6 +1053,75 @@ class FleetRouter:
             # /healthz on the router stays ok through a staggered roll
             "draining": bool(self._views) and all(
                 v.draining or v.down for v in self._views.values()),
+        }
+
+    def fleet_statusz(self) -> dict:
+        """The fleet aggregation plane (ISSUE 15): merged replica
+        heartbeats + transport counters + per-tenant / per-priority SLO
+        accounting, served by the debug server at ``/fleet/statusz``
+        (the engine-slot duck type grew one optional method).
+
+        Per tenant and per priority class: windowed p50/p99 TTFT, TPOT
+        and router queue-wait (the existing :class:`~apex_tpu.
+        observability.metrics.Histogram` bounded-ring semantics — the
+        percentiles describe the recent window, the counts are
+        lifetime), plus finished / rejected (shed) / replay (failover)
+        counts.  Everything is a read-only locked snapshot — the
+        free-telemetry discipline applied to the scrape path."""
+        def hist_row(name: str, keep: int = 4096) -> dict:
+            # keep matches the observe sites' windows — keep_samples
+            # binds at first creation, and a scrape racing the first
+            # observation must not shrink a window
+            h = self.registry.histogram(name, keep_samples=keep)
+            return {"count": h.count,
+                    "p50": h.percentile(50), "p99": h.percentile(99)}
+
+        def counter(name: str) -> int:
+            return int(self.registry.counter(name).value)
+
+        def slo_rows(kind: str, keys) -> dict:
+            rows = {}
+            for key in sorted(keys, key=str):
+                rows[str(key)] = {
+                    "ttft_ms": hist_row(f"fleet/{kind}/{key}/ttft_ms"),
+                    "tpot_ms": hist_row(f"fleet/{kind}/{key}/tpot_ms"),
+                    "queue_wait_ms": hist_row(
+                        f"fleet/{kind}/{key}/queue_wait_ms"),
+                    "finished": counter(f"fleet/{kind}/{key}/finished"),
+                    "rejected": counter(f"fleet/{kind}/{key}/rejected"),
+                }
+                if kind == "tenant":
+                    rows[str(key)]["replays"] = counter(
+                        f"fleet/{kind}/{key}/replays")
+            return rows
+
+        base = self.introspect()
+        return {
+            "replicas": base["replicas"],
+            "queue_depth": base["queue_depth"],
+            "pending": base["pending"],
+            "requests": base["requests"],
+            "draining": base["draining"],
+            "slo": {
+                "tenants": slo_rows("tenant", self._slo_tenants),
+                "priorities": slo_rows("priority",
+                                       self._slo_priorities),
+            },
+            "totals": {
+                "submitted": counter("fleet/requests_submitted"),
+                "finished": counter("fleet/requests_finished"),
+                "rejected": counter("serving/requests_rejected"),
+                "failovers": counter("fleet/failovers"),
+                "replays": counter("fleet/replays"),
+                "reschedules": counter("fleet/reschedules"),
+                "reconnects": counter("fleet/reconnects"),
+                "frames_corrupt": counter("fleet/frames_corrupt"),
+                "relay_batch": counter("fleet/relay_batch"),
+                "relay_batch_events": counter(
+                    "fleet/relay_batch_events"),
+            },
+            "fleet_ttft_ms": hist_row("fleet/ttft_ms"),
+            "fleet_tpot_ms": hist_row("fleet/tpot_ms", keep=65536),
         }
 
     # ---------------------------------------------------------- lifecycle

@@ -16,7 +16,8 @@ Wire protocol (multiprocessing queues; every payload is plain
 picklable data):
 
 parent → child commands
-    ``("submit", frid, prompt, max_new_tokens, eos_id, sampling)``
+    ``("submit", frid, prompt, max_new_tokens, eos_id, sampling,
+       trace)``
                         — ``sampling`` is the request's per-request
                           :class:`~apex_tpu.serving.sampling.
                           SamplingParams` (or None for greedy): the
@@ -26,9 +27,17 @@ parent → child commands
                           construction — the router rebases
                           ``step_offset`` by the emitted prefix it
                           re-prefills, so a survivor redraws the SAME
-                          stochastic stream.
+                          stochastic stream.  ``trace`` (ISSUE 15) is
+                          the router-minted trace context
+                          (``{"trace_id", "attempt"}``, or None when
+                          tracing is unarmed): the engine stamps it
+                          onto every timeline event of the request, so
+                          one fleet-wide id spans every process's
+                          spill — including re-dispatches after
+                          failover (``attempt`` increments per
+                          dispatch).
     ``("submit_many", [(frid, prompt, max_new_tokens, eos_id,
-                        sampling), ...])``
+                        sampling, trace), ...])``
                         — batched admission: N requests in ONE queue
                           put/pickle round trip (the router batches a
                           pump's dispatches per replica; at fleet
@@ -56,6 +65,16 @@ child → parent events
                                  signal (free blocks, queue depth,
                                  draining)
     ``("token", frid, token)`` — one generated token, in order
+    ``("batch", [event, ...])``— one relay turn's whole event backlog
+                                 in ONE queue put (ISSUE 15 satellite —
+                                 the socket transport's lesson applied
+                                 to the mp queue: one pickled payload
+                                 per turn instead of one per feeder
+                                 wakeup; :meth:`ReplicaProcess.poll`
+                                 unpacks transparently and counts
+                                 ``relay_batches`` /
+                                 ``relay_batched_events`` for the
+                                 router's ``fleet/relay_batch`` mirror)
     ``("finished", frid)`` / ``("cancelled", frid)`` /
     ``("rejected", frid, why)`` — terminal transitions; ``cancelled``
                                  means drained-out-of-queue (the router
@@ -81,9 +100,21 @@ import logging
 import queue as queue_mod
 from typing import Any, Optional, Sequence
 
-__all__ = ["ReplicaSpec", "ReplicaProcess"]
+__all__ = ["ReplicaSpec", "ReplicaProcess", "wire_submit_item"]
 
 logger = logging.getLogger(__name__)
+
+
+def wire_submit_item(item: Sequence) -> tuple:
+    """Normalize one ``submit_many`` entry to the wire tuple ``(frid,
+    prompt, max_new_tokens, eos_id, sampling, trace)`` — the ONE
+    definition both transports encode with (a 5-tuple from a pre-15
+    caller gets ``trace=None``), so the mp-queue and socket wires can
+    never drift apart on the format."""
+    frid, prompt, max_new, eos, samp = item[:5]
+    trace = item[5] if len(item) > 5 else None
+    return (frid, [int(t) for t in prompt], int(max_new), eos,
+            samp, trace)
 
 
 def _state_snapshot(engine) -> dict:
@@ -124,6 +155,16 @@ class ReplicaSpec:
     #                                  BEFORE the ready handshake, so the
     #                                  router's heartbeat timeout never
     #                                  has to cover an XLA compile
+    # distributed tracing (ISSUE 15): when set, the child arms its own
+    # FlightRecorder spilling to
+    # ``<timeline_dir>/timeline.replica.<name>.<pid>.jsonl`` (process
+    # identity in the filename AND the run_begin meta), so a fleet's N
+    # processes leave N stitchable spills.  None = unarmed (the
+    # zero-cost default — every instrumentation point is a None check).
+    timeline_dir: Optional[str] = None
+    timeline_tick_every: int = 8     # decode_tick sampling (1 = every
+    #                                  token: the trace smoke's precise
+    #                                  hop boundaries)
 
 
 def _build_engine(spec: ReplicaSpec, registry, guard):
@@ -150,7 +191,8 @@ def _build_engine(spec: ReplicaSpec, registry, guard):
         params, _ = init_fn(jax.random.PRNGKey(spec.seed),
                             jnp.zeros((2, 2), jnp.int32))
     engine = ServingEngine(spec.config, spec.serving, params, mesh=mesh,
-                           registry=registry, guard=guard)
+                           registry=registry, guard=guard,
+                           timeline_tick_every=spec.timeline_tick_every)
     return engine, step
 
 
@@ -170,9 +212,18 @@ def _replica_worker(spec: ReplicaSpec, name: str, cmd_q, evt_q,
     # the rollout path is the PR 8 drain, not a new mechanism
     guard = PreemptionGuard()
     server = None
+    recorder = None
     try:
         from apex_tpu.observability.metrics import MetricRegistry
 
+        if spec.timeline_dir is not None:
+            # per-process spill, armed BEFORE the engine builds so the
+            # whole request lifecycle lands in it; the filename and the
+            # run_begin meta both carry the process identity the trace
+            # merger keys on (observability/trace.py)
+            from apex_tpu.observability.trace import arm_process
+
+            recorder = arm_process(spec.timeline_dir, "replica", name)
         registry = MetricRegistry(rank=0, world=1)
         engine, ckpt_step = _build_engine(spec, registry, guard)
         if spec.warmup:
@@ -212,21 +263,33 @@ def _replica_worker(spec: ReplicaSpec, name: str, cmd_q, evt_q,
         last_state = 0.0
 
         def flush() -> None:
+            # one queue put per relay turn (ISSUE 15 satellite): the
+            # socket server batches a whole event backlog into each
+            # send, while mp.Queue's feeder thread pickles one payload
+            # per wakeup — batching here closes that gap for the
+            # in-process transport (the wire_vs_inproc lesson).  A
+            # single event skips the wrapper; order is preserved (one
+            # producer thread, one queue).
+            out = []
             for frid in list(reqs):
                 req = reqs[frid]
                 toks = req.output_tokens
                 for tok in toks[reported[frid]:]:
-                    evt_q.put(("token", frid, int(tok)))
+                    out.append(("token", frid, int(tok)))
                 reported[frid] = len(toks)
                 if req.done:
                     state = req.state.value
                     if state == "finished":
-                        evt_q.put(("finished", frid))
+                        out.append(("finished", frid))
                     elif state == "cancelled":
-                        evt_q.put(("cancelled", frid))
+                        out.append(("cancelled", frid))
                     else:
-                        evt_q.put(("rejected", frid, state))
+                        out.append(("rejected", frid, state))
                     del reqs[frid], reported[frid]
+            if len(out) == 1:
+                evt_q.put(out[0])
+            elif out:
+                evt_q.put(("batch", out))
 
         def heartbeat(now: float, force: bool = False) -> float:
             if force or now - last_state >= spec.heartbeat_every_s:
@@ -234,10 +297,11 @@ def _replica_worker(spec: ReplicaSpec, name: str, cmd_q, evt_q,
                 return now
             return last_state
 
-        def admit_one(frid, prompt, max_new, eos, sampling=None) -> None:
+        def admit_one(frid, prompt, max_new, eos, sampling=None,
+                      trace=None) -> None:
             try:
                 req = engine.submit(prompt, max_new, eos,
-                                    sampling=sampling)
+                                    sampling=sampling, trace=trace)
             except ValueError as e:
                 # unserviceable here (too long for this replica's
                 # pool) — typed refusal, the router decides what to
@@ -292,6 +356,14 @@ def _replica_worker(spec: ReplicaSpec, name: str, cmd_q, evt_q,
         except Exception:
             pass
     finally:
+        if recorder is not None:
+            from apex_tpu.observability import timeline as _tl
+
+            _tl.disarm()
+            try:
+                recorder.flush()      # run_end on the clean-exit paths
+            except Exception:         # a SIGKILL never reaches here —
+                pass                  # its spill ends at the torn tail
         if server is not None:
             server.close()
         guard.uninstall()
@@ -329,6 +401,11 @@ class ReplicaProcess:
 
         self.name = name
         self.meta: Optional[dict] = None
+        # batched-relay accounting (ISSUE 15 satellite): how many
+        # ("batch", ...) payloads poll() unpacked and how many events
+        # rode them — the router mirrors these into fleet/relay_batch*
+        self.relay_batches = 0
+        self.relay_batched_events = 0
         self._ctx = mp.get_context(start_method)
         self._cmd = self._ctx.Queue()
         self._evt = self._ctx.Queue()
@@ -361,21 +438,23 @@ class ReplicaProcess:
     # ------------------------------------------------------------ commands
 
     def submit(self, frid, prompt: Sequence[int], max_new_tokens: int,
-               eos_id: Optional[int] = None, sampling=None) -> None:
+               eos_id: Optional[int] = None, sampling=None,
+               trace=None) -> None:
         """``sampling``: the request's
         :class:`~apex_tpu.serving.sampling.SamplingParams` (picklable,
-        crosses the wire as data) or None for greedy."""
+        crosses the wire as data) or None for greedy.  ``trace``: the
+        router-minted trace context dict, or None when unarmed."""
         self._cmd.put(("submit", frid, [int(t) for t in prompt],
-                       int(max_new_tokens), eos_id, sampling))
+                       int(max_new_tokens), eos_id, sampling, trace))
 
     def submit_many(self, items: Sequence[tuple]) -> None:
         """Batched admission: ``items`` of ``(frid, prompt,
-        max_new_tokens, eos_id, sampling)`` cross the transport as ONE
-        command (one queue put, one pickle) instead of N — the router
-        batches each pump's dispatches per replica through this."""
-        self._cmd.put(("submit_many", [
-            (frid, [int(t) for t in prompt], int(max_new), eos, samp)
-            for frid, prompt, max_new, eos, samp in items]))
+        max_new_tokens, eos_id, sampling[, trace])`` cross the
+        transport as ONE command (one queue put, one pickle) instead of
+        N — the router batches each pump's dispatches per replica
+        through this."""
+        self._cmd.put(("submit_many",
+                       [wire_submit_item(it) for it in items]))
 
     def begin_drain(self, *, sigterm: bool = True) -> None:
         """Start the drain: a real SIGTERM (the production rollout
@@ -413,11 +492,21 @@ class ReplicaProcess:
         events = []
         while True:
             try:
-                events.append(self._evt.get_nowait())
+                ev = self._evt.get_nowait()
             except queue_mod.Empty:
                 break
             except (EOFError, OSError):
                 break
+            if ev and ev[0] == "batch":
+                # the worker's one-put-per-relay-turn payload: unpack
+                # transparently (order preserved) and count it, so the
+                # router can surface fleet/relay_batch without touching
+                # the wire format
+                self.relay_batches += 1
+                self.relay_batched_events += len(ev[1])
+                events.extend(ev[1])
+            else:
+                events.append(ev)
         return events
 
     def wait_ready(self, timeout: float = 300.0) -> dict:

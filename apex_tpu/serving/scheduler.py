@@ -82,7 +82,17 @@ from apex_tpu.serving.kv_cache import (
 )
 from apex_tpu.serving.sampling import SamplingParams
 
-__all__ = ["Request", "RequestState", "Scheduler"]
+__all__ = ["Request", "RequestState", "Scheduler", "trace_fields"]
+
+
+def trace_fields(req) -> dict:
+    """Trace-context kwargs for a request's timeline events (ISSUE 15):
+    ``{trace_id, attempt}`` when the request rides a fleet trace, empty
+    otherwise — an untraced spill carries no null clutter and is byte-
+    compatible with the pre-tracing schema."""
+    if req.trace_id is None:
+        return {}
+    return {"trace_id": req.trace_id, "attempt": req.trace_attempt}
 
 
 class RequestState(enum.Enum):
@@ -124,6 +134,13 @@ class Request:
     #                                     (speculative back-off; ISSUE 13)
     spec_quiet: int = 0                 # backed-off ticks since the last
     #                                     probe (re-arm cadence)
+    # distributed-tracing context (ISSUE 15): the fleet-wide id this
+    # request's timeline events carry, and which dispatch attempt this
+    # engine-local incarnation is — None/0 outside a traced fleet (the
+    # engine's events then stay rid-keyed and process-local, exactly
+    # the pre-tracing shape)
+    trace_id: Optional[str] = None
+    trace_attempt: int = 0
 
     # wall-clock marks for the latency metrics (engine-stamped)
     t_submit: float = 0.0
@@ -372,7 +389,8 @@ class Scheduler:
         self.preemptions += 1
         self.waiting.appendleft(req)
         timeline.emit("request_preempt", rid=req.rid,
-                      tokens=len(req.output_tokens))
+                      tokens=len(req.output_tokens),
+                      **trace_fields(req))
 
     def _index_into_cache(self, req: Request) -> None:
         if self.prefix_cache is None:

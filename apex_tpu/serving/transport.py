@@ -68,10 +68,19 @@ the frames:
   sequence-numbered and buffered until acknowledged; a reconnect
   re-sends the unacked tail and the server dedupes by seq, so a torn
   connection can neither lose nor double-apply a submit.
-- ``("ping", nonce)`` / ``("pong", nonce)`` — link RTT, measured on the
-  client's monotonic clock (cross-host wall clocks are never compared).
-  The router reads ``link_rtt_s`` off the client and *demotes* a
-  degraded link in placement rather than hard-failing the replica.
+- ``("ping", nonce)`` / ``("pong", nonce, server_mono)`` — link RTT,
+  measured on the client's monotonic clock (cross-host wall clocks are
+  never compared).  The router reads ``link_rtt_s`` off the client and
+  *demotes* a degraded link in placement rather than hard-failing the
+  replica.  ``server_mono`` (ISSUE 15) is the replica host's monotonic
+  clock at pong time: together with the client-side send/receive stamps
+  it yields a per-link **clock offset** estimate
+  (``client ≈ server + offset``, uncertainty ±RTT/2 — the NTP
+  construction), refreshed per ping and drained by the router into its
+  timeline spill (``link_clock`` events) so cross-host trace stitching
+  maps every replica's clock onto the router's.  The hello reply
+  carries the same stamp, so a link has an offset sample from its very
+  first exchange.
 - ``("bye",)`` — intentional server exit (drain complete / stop): the
   client stops reconnecting and reports ``alive() == False``, which is
   how a rollout's drained-and-exited check works cross-host.
@@ -241,6 +250,14 @@ class SocketTransport:
         self.reconnects = 0
         self.frames_corrupt = 0
         self.link_rtt_s: Optional[float] = None
+        # latest per-link clock offset (client_mono ≈ server_mono +
+        # offset), ±RTT/2; None until the first stamped pong/hello
+        self.clock_offset_s: Optional[float] = None
+        # undrained (rtt_s, offset_s, server_mono) samples for the
+        # router (take_rtt_samples) — bounded so a standalone client
+        # that nobody drains cannot grow
+        self._rtt_samples: collections.deque = collections.deque(
+            maxlen=512)
 
         self._sock: Optional[socket.socket] = None
         self._pending_sock: Optional[socket.socket] = None
@@ -293,14 +310,15 @@ class SocketTransport:
             self._stage(frame)
 
     def submit(self, frid, prompt: Sequence[int], max_new_tokens: int,
-               eos_id=None, sampling=None) -> None:
+               eos_id=None, sampling=None, trace=None) -> None:
         self._send_cmd(("submit", frid, [int(t) for t in prompt],
-                        int(max_new_tokens), eos_id, sampling))
+                        int(max_new_tokens), eos_id, sampling, trace))
 
     def submit_many(self, items: Sequence[tuple]) -> None:
-        self._send_cmd(("submit_many", [
-            (frid, [int(t) for t in prompt], int(max_new), eos, samp)
-            for frid, prompt, max_new, eos, samp in items]))
+        from apex_tpu.serving.replica import wire_submit_item
+
+        self._send_cmd(("submit_many",
+                        [wire_submit_item(it) for it in items]))
 
     def begin_drain(self, **kw) -> None:
         """Cross-host drain: the wire command (the daemon's worker runs
@@ -440,10 +458,16 @@ class SocketTransport:
         # fresh = this client has never held a session: the server
         # resets its command-dedupe watermark and fast-forwards our
         # event cursor instead of deduping/resetting us against a
-        # PREVIOUS router's session (the restarted-router reattach path)
+        # PREVIOUS router's session (the restarted-router reattach
+        # path).  The trailing 1 advertises the ISSUE 15 clock
+        # exchange: the server stamps its hello reply (and pongs) with
+        # its monotonic clock ONLY for clients that ask — a pre-15
+        # router strict-unpacks the 4-tuple reply, so an unconditional
+        # stamp would break the replicas-first rolling-upgrade order
+        # (a pre-15 server just indexes our extra element away).
         self._wire = bytearray(encode_frame(
             ("hello", self._last_evt_seq, self._cmd_seq,
-             not self._ever_connected)))
+             not self._ever_connected, 1)))
         self._wire_since = now
         self._hello_done = False
         self._hello_sent_t = now
@@ -553,7 +577,13 @@ class SocketTransport:
             while self._outbox and self._outbox[0][0] <= applied:
                 self._outbox.popleft()
         elif kind == "hello":
-            _, applied, reset, resume_seq = msg
+            applied, reset, resume_seq = msg[1], msg[2], msg[3]
+            if len(msg) > 4 and msg[4] is not None:
+                # hello-time exchange: the first offset sample of the
+                # link, before any ping has flown (send stamp = when we
+                # staged our hello)
+                self._note_clock_sample(self._hello_sent_t, now,
+                                        float(msg[4]))
             if reset:
                 # the server's event ring no longer covers our gap: a
                 # lossless resume is impossible, so fail the replica
@@ -575,7 +605,10 @@ class SocketTransport:
         elif kind == "pong":
             sent = self._pings.pop(msg[1], None)
             if sent is not None:
-                self.link_rtt_s = now - sent
+                if len(msg) > 2 and msg[2] is not None:
+                    self._note_clock_sample(sent, now, float(msg[2]))
+                else:                   # an unstamped (pre-15) pong
+                    self.link_rtt_s = now - sent
         elif kind == "bye":
             self._exited = True
             try:
@@ -583,6 +616,29 @@ class SocketTransport:
             except OSError:
                 pass
             self._sock = None
+
+    def _note_clock_sample(self, t_send: float, t_recv: float,
+                           remote_mono: float) -> None:
+        """One round trip's (rtt, offset) estimate — the NTP midpoint
+        construction (:func:`~apex_tpu.observability.trace.
+        estimate_offset`): the remote stamped its clock somewhere inside
+        our [t_send, t_recv] window, so mapping it to the midpoint errs
+        by at most RTT/2.  Kept as a sample queue for the router to
+        drain (take_rtt_samples) into its RTT histogram + timeline."""
+        from apex_tpu.observability.trace import estimate_offset
+
+        offset, _ = estimate_offset(t_send, t_recv, remote_mono)
+        rtt = t_recv - t_send
+        self.link_rtt_s = rtt
+        self.clock_offset_s = offset
+        self._rtt_samples.append((rtt, offset, remote_mono))
+
+    def take_rtt_samples(self) -> list:
+        """Drain the accumulated ``(rtt_s, offset_s, remote_mono)``
+        samples (router-side: histogram + ``link_clock`` spill)."""
+        out = list(self._rtt_samples)
+        self._rtt_samples.clear()
+        return out
 
     def _maybe_ping(self, now: float) -> None:
         if now - self._last_ping_t < self.ping_every_s:
@@ -813,8 +869,16 @@ class TransportServer:
             covered = oldest <= last_seen <= self._evt_seq
             reset = not covered and not fresh
             resume_seq = last_seen if covered else self._evt_seq
-            conn.out.extend(encode_frame(
-                ("hello", self._cmd_applied, reset, resume_seq)))
+            # the monotonic clock stamp (ISSUE 15) goes only to clients
+            # that ADVERTISED it (hello element 5): a pre-15 router
+            # strict-unpacks a 4-tuple reply, and a mixed-version fleet
+            # mid-rolling-upgrade (replicas first) must keep working
+            if len(msg) > 4:
+                reply = ("hello", self._cmd_applied, reset, resume_seq,
+                         time.monotonic())
+            else:
+                reply = ("hello", self._cmd_applied, reset, resume_seq)
+            conn.out.extend(encode_frame(reply))
             if covered:
                 for seq, evt in self._ring:
                     if seq > last_seen:
@@ -840,48 +904,61 @@ class TransportServer:
                 self._cmd_q.put(cmd)
             conn.out.extend(encode_frame(("ack", self._cmd_applied)))
         elif kind == "ping":
-            conn.out.extend(encode_frame(("pong", msg[1])))
+            # the pong's monotonic stamp is the clock-alignment anchor
+            # (ISSUE 15): this host's clock at (approximately) the
+            # client's round-trip midpoint
+            conn.out.extend(encode_frame(
+                ("pong", msg[1], time.monotonic())))
 
     def _pump_events(self) -> None:
         while True:
             try:
-                evt = self._evt_q.get_nowait()
+                raw = self._evt_q.get_nowait()
             except queue_mod.Empty:
                 return
-            if evt[0] == "ready":
-                self._sticky_ready = evt
-            elif evt[0] == "state":
-                self._sticky_state = evt
-            self._evt_seq += 1
-            self._ring.append((self._evt_seq, evt))
-            active = self._active
-            if active is not None and active in self._conns and \
-                    self._conns[active].hello_done:
-                conn = self._conns[active]
-                if conn.stalled:
-                    continue    # ring keeps the event; conn is awaiting
-                #                 its boundary drop in _flush
-                conn.out.extend(
-                    encode_frame(("evt", self._evt_seq, evt)))
-                if len(conn.out) > self._max_buffered:
-                    # live-but-stalled peer: drop rather than grow
-                    # without bound; seq replay recovers on reconnect.
-                    # Only ever sever at a frame boundary — a mid-frame
-                    # cut would read as a TORN frame (a corruption
-                    # verdict) at the client, not a connection loss
-                    if conn.head_rem == 0:
-                        logger.warning(
-                            "transport server %s: dropping stalled "
-                            "connection (%d bytes un-flushed)",
-                            self.address, len(conn.out))
-                        self._drop(active)
-                    else:
-                        logger.warning(
-                            "transport server %s: stalling connection "
-                            "(%d bytes un-flushed, mid-frame); will "
-                            "drop at the frame boundary",
-                            self.address, len(conn.out))
-                        conn.stalled = True
+            # the worker's batched relay (ISSUE 15 satellite) arrives
+            # as one ("batch", [...]) payload; each sub-event gets its
+            # OWN sequence number so the client's dedupe/sticky logic
+            # never sees the wrapper
+            subs = raw[1] if raw and raw[0] == "batch" else (raw,)
+            for evt in subs:
+                self._pump_one(evt)
+
+    def _pump_one(self, evt: tuple) -> None:
+        if evt[0] == "ready":
+            self._sticky_ready = evt
+        elif evt[0] == "state":
+            self._sticky_state = evt
+        self._evt_seq += 1
+        self._ring.append((self._evt_seq, evt))
+        active = self._active
+        if active is not None and active in self._conns and \
+                self._conns[active].hello_done:
+            conn = self._conns[active]
+            if conn.stalled:
+                return      # ring keeps the event; conn is awaiting
+            #                 its boundary drop in _flush
+            conn.out.extend(
+                encode_frame(("evt", self._evt_seq, evt)))
+            if len(conn.out) > self._max_buffered:
+                # live-but-stalled peer: drop rather than grow
+                # without bound; seq replay recovers on reconnect.
+                # Only ever sever at a frame boundary — a mid-frame
+                # cut would read as a TORN frame (a corruption
+                # verdict) at the client, not a connection loss
+                if conn.head_rem == 0:
+                    logger.warning(
+                        "transport server %s: dropping stalled "
+                        "connection (%d bytes un-flushed)",
+                        self.address, len(conn.out))
+                    self._drop(active)
+                else:
+                    logger.warning(
+                        "transport server %s: stalling connection "
+                        "(%d bytes un-flushed, mid-frame); will "
+                        "drop at the frame boundary",
+                        self.address, len(conn.out))
+                    conn.stalled = True
 
     @staticmethod
     def _mark_sent(conn: _ServerConn, n: int) -> None:

@@ -1,0 +1,285 @@
+"""Distributed-tracing smoke (ISSUE 15): the tracing plane, for real.
+
+``tests/test_trace.py`` proves the stitcher over synthesized spills;
+this smoke proves the whole plane over THREE real ``replica_serve``
+daemons on loopback framed TCP, with tracing armed in every process
+and a real SIGKILL mid-decode:
+
+**Phase A — one merged trace through a kill.**  Requests flow through
+the socket fleet; one daemon's host process is SIGKILLed while a
+request is mid-decode.  After the fleet drains, the spill directory
+(router + 3 replica files, the victim's torn at the kill) merges
+strictly into one trace per request — and the killed request's single
+trace spans BOTH replicas (attempts >= 2) with ``failover_replay``
+time attributed and the books exactly closed (overcommit 0,
+unattributed 0).
+
+**Phase B — hop sums vs the router-side stopwatch.**  Every request's
+hop-bucket sum must equal its trace wall-clock exactly AND match an
+independent host stopwatch around submit→terminal within 2% (+a small
+absolute cushion for sub-100ms streams) — the per-request goodput
+books checked against an outside clock, not just against themselves.
+
+**Phase C — the aggregation plane.**  ``/fleet/statusz`` on a
+DebugServer wrapping the router serves per-tenant SLO percentiles and
+merged replica state over HTTP, and ``scripts/trace_report.py``
+(subprocess — the operator's actual entry point) parses the spill dir
+strictly and exits 0.
+
+Run via ``scripts/trace_smoke.sh``; wired fast-tier in
+``tests/test_aux_subsystems.py`` (the fleet-smoke pattern).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+VOCAB, MAX_SEQ = 64, 32
+REPO = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+
+
+def log(msg):
+    print(f"trace_smoke: {msg}", file=sys.stderr, flush=True)
+
+
+def build_cfg():
+    from apex_tpu.transformer.testing import TransformerConfig
+
+    return TransformerConfig(
+        hidden_size=32, num_layers=2, num_attention_heads=4,
+        padded_vocab_size=VOCAB, max_position_embeddings=MAX_SEQ,
+        hidden_dropout=0.0, attention_dropout=0.0, tensor_axis="tp",
+        use_flash_attention=True)
+
+
+def main() -> int:
+    import shutil
+    import tempfile
+
+    from apex_tpu.data._producer import reap_process
+    from apex_tpu.observability import timeline
+    from apex_tpu.observability.debug_server import DebugServer
+    from apex_tpu.observability.metrics import MetricRegistry
+    from apex_tpu.observability.trace import arm_process, merge_dir
+    from apex_tpu.serving import FleetRouter, ReplicaSpec, ServingConfig
+    from apex_tpu.serving.scheduler import RequestState
+    from apex_tpu.serving.transport import (
+        SocketTransport, start_replica_server)
+
+    workdir = tempfile.mkdtemp(prefix="apex_trace_smoke_")
+    trace_dir = os.path.join(workdir, "trace")
+    rng = np.random.RandomState(23)
+    router = None
+    srv = None
+    procs = {}
+    try:
+        recorder = arm_process(trace_dir, "router", "router")
+        spec = ReplicaSpec(
+            config=build_cfg(),
+            serving=ServingConfig(max_batch=3, block_size=4,
+                                  max_seq=MAX_SEQ, prefill_len=MAX_SEQ),
+            tp=1, ckpt_dir=None, debug_server=False,
+            timeline_dir=trace_dir, timeline_tick_every=1)
+        names = ["s0", "s1", "s2"]
+        t0 = time.monotonic()
+        started = {n: start_replica_server(spec, n, addr_timeout_s=300)
+                   for n in names}
+        procs = {n: p for n, (p, _) in started.items()}
+        clients = [SocketTransport(n, addr, backoff_initial_s=0.05,
+                                   ping_every_s=0.05)
+                   for n, (_, addr) in started.items()]
+        for c in clients:
+            c.wait_ready(timeout=300)
+        log(f"3 traced socket replicas ready in "
+            f"{time.monotonic() - t0:.1f}s")
+        registry = MetricRegistry(rank=0, world=1)
+        router = FleetRouter(clients, max_queue_depth=24,
+                             replica_queue_limit=3,
+                             heartbeat_timeout_s=2.0, probe_retries=2,
+                             probe_backoff_s=0.25, registry=registry)
+
+        # ---- traced wave + SIGKILL mid-decode -----------------------
+        waves = [(rng.randint(1, VOCAB - 1,
+                              size=rng.randint(2, 8)).tolist(),
+                  int(rng.randint(10, 15))) for _ in range(5)]
+        stopwatch = {}
+        reqs = []
+        for prompt, n_new in waves:
+            t_sub = time.monotonic()
+            req = router.submit(prompt, n_new, tenant="acme")
+            stopwatch[req.rid] = [t_sub, None]
+            reqs.append(req)
+        if any(r.trace_id is None for r in reqs):
+            log("FAIL: armed router minted no trace_id")
+            return 1
+
+        killed = None
+        deadline = time.monotonic() + 90
+        while True:
+            router.pump()
+            now = time.monotonic()
+            for req in reqs:
+                if req.done and stopwatch[req.rid][1] is None:
+                    stopwatch[req.rid][1] = now
+            if killed is None:
+                for view in router._views.values():
+                    mid = [r for r in view.assigned.values()
+                           if 1 <= len(r.output_tokens)
+                           < r.max_new_tokens]
+                    if mid and not view.down:
+                        killed = view.name
+                        procs[killed].kill()   # SIGKILL the host
+                        log(f"SIGKILLed {killed} mid-decode "
+                            f"({len(mid)} in flight)")
+                        break
+            if all(r.done for r in reqs):
+                break
+            if now > deadline:
+                log(f"FAIL: wave not terminal in 90s (killed={killed})")
+                return 1
+            time.sleep(0.001)
+        if killed is None:
+            log("FAIL: wave drained before a mid-decode kill window")
+            return 1
+        if not all(r.state is RequestState.FINISHED for r in reqs):
+            log(f"FAIL: non-finished states "
+                f"{[r.state for r in reqs]}")
+            return 1
+        survivors = sum(r.replays for r in reqs)
+        if survivors < 1:
+            log("FAIL: the kill produced no failover replay")
+            return 1
+
+        # ---- phase C first (the router must still be live) ----------
+        srv = DebugServer(registry=registry, engine=router).start()
+        with urllib.request.urlopen(srv.url("/fleet/statusz"),
+                                    timeout=10) as resp:
+            plane = json.loads(resp.read())
+        slo = plane["slo"]["tenants"].get("acme")
+        if (resp.status != 200 or slo is None
+                or slo["finished"] != len(reqs)
+                or slo["ttft_ms"]["p99"] is None):
+            log(f"FAIL: /fleet/statusz SLO plane: {plane}")
+            return 1
+        if not plane["totals"]["failovers"] >= 1:
+            log(f"FAIL: failover not on the plane: {plane['totals']}")
+            return 1
+        log(f"phase C OK: /fleet/statusz serves acme SLO "
+            f"(ttft p99 {slo['ttft_ms']['p99']:.1f}ms, "
+            f"{slo['finished']} finished) + "
+            f"{plane['totals']['failovers']} failover")
+
+        # ---- drain the fleet so every spill closes cleanly ----------
+        router.close()
+        router = None
+        for n, p in procs.items():
+            try:
+                p.terminate()          # SIGTERM: guard drain, run_end
+            except Exception:
+                pass
+            reap_process(p, 20.0, what=f"traced replica {n}")
+        procs = {}
+        timeline.disarm()
+        recorder.flush()
+
+        # ---- phase A: strict merge, one trace through the kill ------
+        report = merge_dir(trace_dir, strict=True)
+        traces = report["traces"]
+        by_rid = {rec["rid"]: rec for rec in traces.values()}
+        if sorted(by_rid) != sorted(r.rid for r in reqs):
+            log(f"FAIL: merged rids {sorted(by_rid)} != submitted "
+                f"{sorted(r.rid for r in reqs)}")
+            return 1
+        killed_traces = [rec for rec in traces.values()
+                         if rec["attempts"] >= 2]
+        if not killed_traces:
+            log("FAIL: no merged trace shows a re-dispatch")
+            return 1
+        for rec in traces.values():
+            if rec["state"] != "finished":
+                log(f"FAIL: trace {rec['trace_id']} state "
+                    f"{rec['state']}")
+                return 1
+            if rec["overcommit_s"] != 0 or rec["unattributed_s"] != 0:
+                log(f"FAIL: books not closed: {rec}")
+                return 1
+        for rec in killed_traces:
+            if len(rec["replicas"]) < 2:
+                log(f"FAIL: replayed trace stayed on one replica: "
+                    f"{rec['replicas']}")
+                return 1
+            if rec["hops"]["failover_replay"] <= 0:
+                log(f"FAIL: no failover_replay time attributed: "
+                    f"{rec['hops']}")
+                return 1
+        log(f"phase A OK: {len(traces)} merged traces, "
+            f"{len(killed_traces)} spanning both replicas through the "
+            f"SIGKILL (failover_replay "
+            f"{killed_traces[0]['hops']['failover_replay']:.3f}s), "
+            "books closed exactly")
+
+        # ---- phase B: hop sums vs the router-side stopwatch ---------
+        for req in reqs:
+            rec = by_rid[req.rid]
+            hop_sum = sum(rec["hops"].values())
+            if abs(hop_sum - rec["wall_s"]) > 1e-5:
+                log(f"FAIL: hop sum {hop_sum} != wall {rec['wall_s']}")
+                return 1
+            t_sub, t_done = stopwatch[req.rid]
+            watch = t_done - t_sub
+            # 2% + a small absolute cushion (the stopwatch brackets the
+            # submit call and the post-pump done observation)
+            if abs(hop_sum - watch) > 0.02 * watch + 0.015:
+                log(f"FAIL: rid {req.rid} hop sum {hop_sum:.4f}s vs "
+                    f"stopwatch {watch:.4f}s exceeds 2%")
+                return 1
+        log(f"phase B OK: {len(reqs)} requests' hop sums match the "
+            "router stopwatch within 2%")
+
+        # ---- the operator entry point parses the same dir -----------
+        cli = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "scripts", "trace_report.py"),
+             trace_dir],
+            capture_output=True, timeout=120)
+        if cli.returncode != 0:
+            log(f"FAIL: trace_report.py rc={cli.returncode}: "
+                f"{cli.stderr.decode(errors='replace')[-500:]}")
+            return 1
+        log("trace_report.py output:\n"
+            + cli.stdout.decode(errors="replace"))
+
+        print("PASS", file=sys.stderr, flush=True)
+        return 0
+    finally:
+        timeline.disarm()
+        if srv is not None:
+            srv.close()
+        if router is not None:
+            router.close()
+        from apex_tpu.data._producer import reap_process
+        for n, p in procs.items():
+            try:
+                p.terminate()
+            except Exception:
+                pass
+            reap_process(p, 15.0, what=f"traced replica {n}")
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

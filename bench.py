@@ -1692,10 +1692,15 @@ def bench_serving_fleet(jax, on_tpu):
     ``tokens_per_sec_socket`` and ``wire_vs_inproc`` (socket/in-proc
     ratio) track the wire cost instead of guessing it.  Measured
     surprise, stable across runs: ~15x ABOVE in-proc on the CPU host —
-    mp.Queue relays one pickled event per feeder-thread wakeup (GIL-
-    starved while the child decodes), while the socket server batches
-    a whole event backlog into each 64 KB send; the socket wave runs at
-    the fleet's compute-bound ceiling (~16 ticks x p99 TPOT).  Loopback
+    the socket server batches a whole event backlog into each 64 KB
+    send while mp.Queue pays a feeder-thread wakeup per put (GIL-
+    starved while the child decodes); the socket wave runs at the
+    fleet's compute-bound ceiling (~16 ticks x p99 TPOT).  ISSUE 15
+    re-stamp: the worker now batches its event backlog into one queue
+    put per relay turn (fleet/relay_batch), and the ratio BARELY moved
+    (15.7x, was ~15x) — the verdict is that the feeder-thread wakeup
+    latency dominates, not the per-event pickle count, so the socket
+    transport stays the performance path even single-host.  Loopback
     bounds framing+session cost only; cross-host adds real NIC
     latency on top."""
     import os
@@ -2115,6 +2120,146 @@ def bench_telemetry_overhead(jax, on_tpu):
         mesh_lib.destroy_model_parallel()
 
 
+def bench_serving_trace_overhead(jax, on_tpu):
+    """Distributed tracing on the serving hot path (ISSUE 15): the same
+    continuous-batching wave with the flight recorder DISARMED vs ARMED
+    with per-request trace contexts (request lifecycle events + decode
+    ticks spilled to JSONL, trace ids stamped on every event — exactly
+    what a traced fleet replica pays).  ``vs_bare`` = traced/bare wave
+    wall time at the SHIPPED default tick sampling (every 8th token —
+    what a production replica arms); the standing free-telemetry
+    acceptance gate is <= 1.05 (scripts/bench_regress.py, beside the
+    PR 9 telemetry gate) — tracing must ride inside the existing
+    telemetry budget, not get its own.  ``vs_bare_tick1`` additionally
+    reports the every-token worst case (what the trace smoke arms for
+    exact hop boundaries) — tracked, not gated: on this tiny CPU
+    config a decode tick is ~5 ms, so even a ~20µs spill per token
+    reads as whole percent; on a real chip serving real shapes it
+    vanishes into the step.  Unarmed tracing is a None check and is
+    not measured here because it is the bare leg."""
+    import tempfile
+
+    import numpy as np
+
+    from apex_tpu import parallel
+    from apex_tpu.observability import timeline as tl
+    from apex_tpu.observability.metrics import MetricRegistry
+    from apex_tpu.serving import ServingConfig, ServingEngine
+    from apex_tpu.transformer.testing import TransformerConfig
+    from apex_tpu.transformer.testing.gpt_parallel_train import build_gpt_3d
+
+    mesh = parallel.initialize_model_parallel(
+        tensor_model_parallel_size=1, devices=jax.devices()[:1])
+    tl_dir = tempfile.mkdtemp(prefix="apex_bench_trace_")
+    try:
+        # hidden 256 (vs the serving row's 128): a realistically-heavy
+        # decode tick, so the gate measures the tracing plane against a
+        # step that does real work — on the 128-wide toy the ~20µs
+        # per-event spill reads as whole percent of a ~4ms tick and
+        # host jitter dominates the ratio
+        hidden, layers, heads, vocab = (
+            (512, 4, 8, 2048) if on_tpu else (256, 2, 8, 512))
+        max_batch, prompt_len, gen = 8, 12, 24
+        cfg = TransformerConfig(
+            hidden_size=hidden, num_layers=layers,
+            num_attention_heads=heads, padded_vocab_size=vocab,
+            max_position_embeddings=256, hidden_dropout=0.0,
+            attention_dropout=0.0, tensor_axis="tp",
+            use_flash_attention=True)
+        init_fn, _, _ = build_gpt_3d(cfg, num_chunks=layers,
+                                     num_microbatches=1, mesh=mesh)
+        params, _ = init_fn(jax.random.PRNGKey(0),
+                            jax.numpy.zeros((2, 8), jax.numpy.int32))
+        engine = ServingEngine(
+            cfg, ServingConfig(max_batch=max_batch, block_size=16,
+                               max_seq=prompt_len + gen + 8,
+                               prefill_len=128),
+            params, mesh=mesh, registry=MetricRegistry(rank=0, world=1))
+        rng = np.random.RandomState(0)
+        prompts = [rng.randint(1, vocab - 1, size=prompt_len).tolist()
+                   for _ in range(max_batch)]
+        recorder = tl.FlightRecorder(
+            os.path.join(tl_dir, "timeline.jsonl"))
+
+        def wave(traced: bool, wave_id: int) -> float:
+            t0 = time.perf_counter()
+            for i, p in enumerate(prompts):
+                trace = ({"trace_id": f"w{wave_id}r{i}", "attempt": 1}
+                         if traced else None)
+                engine.submit(p, gen, trace=trace)
+            engine.run_until_drained(max_steps=5000)
+            return time.perf_counter() - t0
+
+        wave(False, 0)                 # compile + warm both programs
+        # interleave timed passes, per-variant minima (the
+        # telemetry_overhead discipline: back-to-back A-then-B on a
+        # shared CPU host skews the ratio either way)
+        # PAIRED rounds, median-of-ratios: on the shared CPU host the
+        # wave-to-wave jitter is whole percent while the true armed
+        # overhead is ~1-2% — minima of independent samples let drift
+        # trip a 5% gate (observed: the same build measured 1.005 and
+        # 1.065 in consecutive runs).  Pairing each traced wave with
+        # an adjacent bare wave cancels the drift; the median ratio is
+        # the gated number.
+        import statistics
+
+        def traced_wave(wid, tick_every):
+            engine.timeline_tick_every = tick_every
+            tl.arm(recorder)
+            try:
+                return wave(True, wid)
+            finally:
+                engine.timeline_tick_every = 8
+                tl.disarm()
+
+        def paired(n, tick_every, base):
+            out = []
+            for r in range(1, n + 1):
+                if r % 2:
+                    b = wave(False, base + 2 * r)
+                    t = traced_wave(base + 2 * r + 1, tick_every)
+                else:
+                    t = traced_wave(base + 2 * r, tick_every)
+                    b = wave(False, base + 2 * r + 1)
+                out.append((t, b))
+            return out
+
+        pairs = paired(10, 8, 0)
+        pairs_tick1 = paired(4, 1, 100)
+        vs_bare = statistics.median(t / b for t, b in pairs)
+        vs_bare_tick1 = statistics.median(t / b for t, b in pairs_tick1)
+        dt_bare = min(b for _, b in pairs)
+        dt_traced = min(t for t, _ in pairs)
+        tokens = max_batch * gen
+        _log(f"serving_trace_overhead: bare {dt_bare * 1e3:.1f}ms "
+             f"traced {dt_traced * 1e3:.1f}ms, paired vs_bare "
+             f"{vs_bare:.3f} (tick_every=1: {vs_bare_tick1:.3f}) over "
+             f"{len(pairs)}+{len(pairs_tick1)} rounds "
+             f"({recorder.events_emitted} timeline events)")
+        return {
+            "value": round(tokens / max(dt_traced, 1e-9), 1),
+            "unit": "tokens/sec",
+            "config": (f"gpt h{hidden} L{layers} c={max_batch} "
+                       f"gen{gen}, default tick sampling"),
+            "bare_tokens_per_sec": round(tokens / max(dt_bare, 1e-9), 1),
+            "vs_bare": round(vs_bare, 3),
+            "vs_bare_tick1": round(vs_bare_tick1, 3),
+            "timeline_events": recorder.events_emitted,
+            "measured": (
+                "continuous-batching wave A/B: flight recorder armed "
+                "with per-request trace contexts (lifecycle events + "
+                "sampled decode ticks, JSONL spill) vs disarmed; "
+                "vs_bare (median of per-round paired ratios — host "
+                "drift cancels) at the shipped tick_every=8 default "
+                "is the <= 1.05 hard gate, vs_bare_tick1 tracks the "
+                "every-token worst case ungated"),
+        }
+    finally:
+        tl.disarm()
+        shutil.rmtree(tl_dir, ignore_errors=True)
+        parallel.destroy_model_parallel()
+
+
 # ---------------------------------------------------------------------------
 
 BENCHES = {
@@ -2134,6 +2279,7 @@ BENCHES = {
     "serving_occupancy": bench_serving_occupancy,
     "serving_fleet": bench_serving_fleet,
     "serving_spec": bench_serving_spec,
+    "serving_trace_overhead": bench_serving_trace_overhead,
     "input_pipeline": bench_input_pipeline,
     "real_data_rn50": bench_real_data_rn50,
     # Diagnostic-only combos (run via ``--one``, not in BENCH_ORDER):
@@ -2157,6 +2303,7 @@ BENCH_ORDER = ["resnet50_o2", "gpt_flash", "bert_large",
                "zero_adam_step", "ckpt_save_restore", "ckpt_reshard",
                "telemetry_overhead", "serving", "serving_occupancy",
                "serving_fleet", "serving_spec",
+               "serving_trace_overhead",
                "gpt_flash_fp8", "gpt_long_context", "input_pipeline",
                "real_data_rn50", "tp_gpt"]
 
@@ -2235,6 +2382,7 @@ _TPU_BENCH_CAP_S = {"fused_adam_step": 420.0, "zero_adam_step": 420.0,
                     "telemetry_overhead": 600.0, "serving": 600.0,
                     "serving_occupancy": 600.0,
                     "serving_fleet": 600.0, "serving_spec": 600.0,
+                    "serving_trace_overhead": 600.0,
                     "tp_gpt": 900.0}
 
 

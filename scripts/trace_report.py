@@ -1,0 +1,86 @@
+#!/usr/bin/env python
+"""Merge a fleet run's timeline spills into per-request traces.
+
+The offline half of the ISSUE 15 tracing plane: point it at the
+directory every fleet process spilled into (the router armed via
+``trace.arm_process(dir, "router", ...)``, each replica via
+``ReplicaSpec(timeline_dir=dir)``) and it stitches one span tree per
+``trace_id`` across all processes — clock-aligned onto the router
+host's monotonic clock through the spilled ``link_clock`` samples —
+and attributes every wall-clock millisecond of every request to
+exactly one hop bucket (router_queue / wire / replica_queue /
+admission_wait / prefill / decode / preempted / failover_replay).
+
+Usage::
+
+    python scripts/trace_report.py <spill-dir>            # human block
+    python scripts/trace_report.py <spill-dir> --json     # full JSON
+    python scripts/trace_report.py <spill-dir> --trace <id>  # one tree
+    python scripts/trace_report.py <spill-dir> --tail-pct 95
+
+Exit status: 0 on a clean merge, 1 when any trace carries overcommit
+(double-counted time — an instrumentation bug, never hidden), 2 on
+usage/IO errors.  ``--no-strict`` tolerates interior JSONL corruption
+(the default is strict: a torn *tail* is always tolerated — that is
+the expected SIGKILL artifact — but a torn interior line fails the
+merge).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="stitch fleet timeline spills into per-request "
+                    "hop-attributed traces")
+    ap.add_argument("dir", help="the fleet run's timeline spill dir")
+    ap.add_argument("--json", action="store_true",
+                    help="print the full report as JSON")
+    ap.add_argument("--trace", default=None,
+                    help="print one trace's span tree by trace_id")
+    ap.add_argument("--tail-pct", type=float, default=99.0,
+                    help="tail percentile for slowest-hop attribution "
+                         "(default 99)")
+    ap.add_argument("--no-strict", action="store_true",
+                    help="tolerate interior JSONL corruption")
+    args = ap.parse_args(argv)
+
+    from apex_tpu.observability.trace import (
+        format_trace_report, merge_dir)
+
+    try:
+        report = merge_dir(args.dir, strict=not args.no_strict,
+                           tail_pct=args.tail_pct)
+    except (OSError, ValueError) as e:
+        print(f"trace_report: {e}", file=sys.stderr)
+        return 2
+
+    if args.trace is not None:
+        rec = report["traces"].get(args.trace)
+        if rec is None:
+            print(f"trace_report: unknown trace_id {args.trace!r}",
+                  file=sys.stderr)
+            return 2
+        print(json.dumps(rec, indent=1))
+    elif args.json:
+        print(json.dumps(report, indent=1))
+    else:
+        print(format_trace_report(report))
+    overcommit = report["summary"]["overcommit_s"]
+    if overcommit > 0:
+        print(f"trace_report: OVERCOMMIT {overcommit:.6f}s (double-"
+              "counted time — instrumentation bug)", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,25 @@
+#!/usr/bin/env bash
+# Distributed-tracing smoke (ISSUE 15): a 3-replica loopback socket
+# fleet with tracing armed in EVERY process —
+#   - one replica SIGKILLed mid-decode: the merged spill directory
+#     yields ONE trace for the killed request, spanning both replicas,
+#     with failover_replay time attributed and the per-request books
+#     exactly closed (overcommit 0, unattributed 0);
+#   - every request's hop-bucket sum matches a router-side stopwatch
+#     within 2%;
+#   - /fleet/statusz serves the per-tenant SLO plane over HTTP, and
+#     scripts/trace_report.py parses the spill dir strictly (exit 0).
+# The stitcher's clock algebra is unit-tested with injected clocks in
+# tests/test_trace.py; this script is the end-to-end proof.  Wired
+# fast-tier in tests/test_aux_subsystems.py like the PR 8/9/11 smokes.
+#
+# Usage: scripts/trace_smoke.sh
+set -euo pipefail
+
+REPO="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+PYTHON="${PYTHON:-python}"
+
+cd "$REPO"
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+  "$PYTHON" apex_tpu/testing/trace_smoke.py
+echo "PASS" >&2

@@ -187,6 +187,33 @@ def test_fleet_smoke_script():
         assert phase in proc.stderr
 
 
+def test_trace_smoke_script():
+    """scripts/trace_smoke.sh end to end (ISSUE 15 CI satellite): a
+    3-replica loopback socket fleet with tracing armed in every
+    process — one replica SIGKILLed mid-decode yields ONE merged trace
+    spanning both replicas with failover_replay attributed and the
+    per-request hop books exactly closed (overcommit 0, unattributed
+    0); every request's hop sum matches the router-side stopwatch
+    within 2%; /fleet/statusz serves the per-tenant SLO plane; and
+    scripts/trace_report.py parses the spill dir strictly.  Subprocess
+    because the smoke spawns replica daemons and owns its platform
+    pinning (the fleet-smoke pattern)."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    env["PYTHON"] = sys.executable
+    proc = subprocess.run(
+        ["bash", os.path.join(repo, "scripts", "trace_smoke.sh")],
+        cwd=repo, env=env, capture_output=True, timeout=600)
+    assert proc.returncode == 0, (
+        f"trace_smoke.sh rc={proc.returncode}\n"
+        f"stderr tail:\n{proc.stderr.decode(errors='replace')[-3000:]}")
+    assert b"PASS" in proc.stderr
+    for phase in (b"phase A OK", b"phase B OK", b"phase C OK"):
+        assert phase in proc.stderr
+
+
 def test_obs_smoke_script(tmp_path):
     """scripts/obs_smoke.sh end to end (ISSUE 10 CI satellite): the
     driver dryrun with the FLIGHT RECORDER armed — the spilled timeline

@@ -97,7 +97,7 @@ class FakeReplica:
         return evs
 
     def submit(self, frid, prompt, max_new_tokens, eos_id,
-               sampling=None):
+               sampling=None, trace=None):
         if not self._alive:
             raise BrokenPipeError("dead replica")
         self.submissions.append((frid, list(prompt), max_new_tokens,

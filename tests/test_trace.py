@@ -1,0 +1,485 @@
+"""apex_tpu.observability.trace — the distributed-tracing plane
+(ISSUE 15), hermetically.
+
+Three layers, no process spawns:
+
+- the **clock algebra**: injected-clock units for the offset estimator
+  (skewed and NTP-stepped replica clocks map back onto the router clock
+  within the RTT bound — the hard error bound of the NTP midpoint
+  construction) and the nearest-sample era selection;
+- the **stitcher**: synthesized spills reproducing the kill-mid-decode
+  failover shape — ONE merged trace whose hops span both replicas with
+  zero unattributed and zero double-counted time (the per-request
+  goodput books);
+- the **live router**: a real FleetRouter over the hermetic FakeReplica
+  mints trace ids only when a recorder is armed, emits the hop events,
+  and serves the /fleet/statusz SLO plane through the DebugServer.
+
+The real-process, real-SIGKILL, real-socket leg is
+``scripts/trace_smoke.sh`` (wired in tests/test_aux_subsystems.py).
+"""
+
+import json
+import queue
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from apex_tpu.observability import timeline
+from apex_tpu.observability.debug_server import DebugServer
+from apex_tpu.observability.metrics import MetricRegistry
+from apex_tpu.observability.timeline import FlightRecorder
+from apex_tpu.observability.trace import (
+    TRACE_HOP_BUCKETS,
+    estimate_offset,
+    map_time,
+    merge_dir,
+    read_fleet_spills,
+    stitch_traces,
+    summarize_traces,
+)
+from apex_tpu.serving.scheduler import RequestState
+
+from test_fleet import FakeReplica, make_router, reference
+
+
+# ------------------------------------------------------- clock algebra
+
+
+@pytest.mark.parametrize("true_offset", [0.0, 1234.5, -9876.25])
+@pytest.mark.parametrize("stamp_frac", [0.0, 0.3, 0.5, 1.0])
+def test_estimate_offset_within_rtt_bound(true_offset, stamp_frac):
+    """However skewed the remote clock and however asymmetric the link
+    (the remote may stamp anywhere inside the round trip), the estimate
+    errs by at most RTT/2 — the bound the merger's clamp accounting
+    relies on."""
+    t_send, rtt = 100.0, 0.008
+    t_recv = t_send + rtt
+    # the remote stamps its (offset-shifted) clock at stamp_frac of the
+    # window: local true instant t_send + stamp_frac*rtt
+    remote_mono = (t_send + stamp_frac * rtt) - true_offset
+    offset, err = estimate_offset(t_send, t_recv, remote_mono)
+    assert err == pytest.approx(rtt / 2)
+    assert abs(offset - true_offset) <= rtt / 2 + 1e-12
+
+
+def test_estimate_offset_rejects_backwards_window():
+    with pytest.raises(ValueError, match="precedes"):
+        estimate_offset(10.0, 9.0, 5.0)
+
+
+def test_map_time_identity_without_samples():
+    # same-host transports (mp queues) share CLOCK_MONOTONIC: no
+    # samples means the identity map, not a crash
+    assert map_time(123.456, []) == 123.456
+
+
+def test_map_time_nearest_sample_selects_clock_era():
+    """An NTP-stepped (or restarted) replica clock leaves offset
+    samples from two eras; each event must map through the sample of
+    ITS OWN era (nearest on the remote's clock), not a stale one."""
+    samples = [(100.0, 50.0), (200.0, 70.0)]   # step of +20 between
+    assert map_time(120.0, samples) == pytest.approx(170.0)   # era 1
+    assert map_time(190.0, samples) == pytest.approx(260.0)   # era 2
+    assert map_time(150.1, samples) == pytest.approx(220.1)   # nearest
+
+
+# ---------------------------------------------------------- stitching
+
+
+def _spill(tmp_path, name, meta, events):
+    from apex_tpu.observability.writers import JsonlWriter
+
+    w = JsonlWriter(str(tmp_path / name), fsync=False)
+    head = {"t": 0.0, "kind": "run_begin", "wall_ts": 0.0}
+    head.update(meta)
+    w.write(head)
+    for ev in events:
+        w.write(ev)
+
+
+def _build_failover_spills(tmp_path, *, r0_t0=1000.0, r0_off=0.0,
+                           r1_t0=1000.0, r1_off=0.0):
+    """Spills for the kill-at-mid-decode failover.  Router mono epoch
+    is 1000.0; each replica's monotonic clock runs ``r*_off`` BEHIND
+    the router's (``router = replica + off`` — a different boot epoch)
+    and its recorder armed when its own clock read ``r*_t0``.  The
+    ROUTER-clock story is identical whatever the skew:
+
+      0.00 submit  0.02 dispatch#1(r0)  0.03 r0 submit  0.05 r0 admit
+      0.06 r0 chunk start .. 0.10 prefilled  0.30 last decode_tick
+      (kill)  0.55 fleet_replay  0.60 dispatch#2(r1)  0.62 r1 submit
+      0.63 r1 admit  0.64 chunk start .. 0.70 prefilled
+      0.90 r1 finish  0.92 fleet_finish
+    """
+    tid = "feedc0de"
+    router_t0 = 1000.0
+
+    def rel_to(replica_t0, off, t_router_rel):
+        # the replica-local relative stamp of the same physical moment:
+        # replica_mono = router_mono - off, minus its recorder epoch
+        return (router_t0 + t_router_rel) - off - replica_t0
+
+    off0, off1 = r0_off, r1_off
+    _spill(tmp_path, "timeline.router.router.1.jsonl",
+           {"role": "router", "name": "router", "pid": 1,
+            "mono_t0": router_t0},
+           [
+               {"t": 0.005, "kind": "link_clock", "replica": "r0",
+                "rtt_s": 0.002, "offset_s": off0,
+                "remote_mono": router_t0 + 0.005 - off0},
+               {"t": 0.005, "kind": "link_clock", "replica": "r1",
+                "rtt_s": 0.002, "offset_s": off1,
+                "remote_mono": router_t0 + 0.005 - off1},
+               {"t": 0.00, "kind": "fleet_submit", "rid": 3,
+                "trace_id": tid, "tenant": "acme", "priority": 0,
+                "prompt_tokens": 4, "max_new_tokens": 8},
+               {"t": 0.02, "kind": "fleet_dispatch", "rid": 3,
+                "trace_id": tid, "attempt": 1, "replica": "r0",
+                "prior_tokens": 0},
+               {"t": 0.55, "kind": "fleet_replay", "rid": 3,
+                "trace_id": tid, "replica": "r0", "reason": "down"},
+               {"t": 0.60, "kind": "fleet_dispatch", "rid": 3,
+                "trace_id": tid, "attempt": 2, "replica": "r1",
+                "prior_tokens": 3},
+               {"t": 0.92, "kind": "fleet_finish", "rid": 3,
+                "trace_id": tid, "tokens": 8},
+           ])
+    _spill(tmp_path, "timeline.replica.r0.2.jsonl",
+           {"role": "replica", "name": "r0", "pid": 2, "mono_t0": r0_t0},
+           [
+               {"t": rel_to(r0_t0, off0, 0.03), "kind": "request_submit",
+                "rid": 0, "trace_id": tid, "attempt": 1},
+               {"t": rel_to(r0_t0, off0, 0.05), "kind": "request_admit",
+                "rid": 0, "trace_id": tid, "attempt": 1},
+               {"t": rel_to(r0_t0, off0, 0.10), "kind": "prefill",
+                "rids": [0], "tokens": 4, "dur_s": 0.04},
+               {"t": rel_to(r0_t0, off0, 0.10), "kind": "request_prefilled",
+                "rid": 0, "trace_id": tid, "attempt": 1},
+               {"t": rel_to(r0_t0, off0, 0.30), "kind": "decode_tick",
+                "rid": 0, "trace_id": tid, "tokens": 3},
+               # SIGKILL here: no finish, torn-tail spill
+           ])
+    _spill(tmp_path, "timeline.replica.r1.3.jsonl",
+           {"role": "replica", "name": "r1", "pid": 3, "mono_t0": r1_t0},
+           [
+               {"t": rel_to(r1_t0, off1, 0.62), "kind": "request_submit",
+                "rid": 0, "trace_id": tid, "attempt": 2},
+               {"t": rel_to(r1_t0, off1, 0.63), "kind": "request_admit",
+                "rid": 0, "trace_id": tid, "attempt": 2},
+               {"t": rel_to(r1_t0, off1, 0.70), "kind": "prefill",
+                "rids": [0], "tokens": 7, "dur_s": 0.06},
+               {"t": rel_to(r1_t0, off1, 0.70), "kind": "request_prefilled",
+                "rid": 0, "trace_id": tid, "attempt": 2},
+               {"t": rel_to(r1_t0, off1, 0.90), "kind": "request_finish",
+                "rid": 0, "trace_id": tid, "tokens": 8},
+           ])
+    return tid
+
+
+def _expected_hops():
+    return {
+        "router_queue": 0.02,             # 0.00 -> 0.02
+        # dispatch->submit legs (0.02->0.03, 0.60->0.62) + the return
+        # leg (0.90 -> 0.92)
+        "wire": 0.01 + 0.02 + 0.02,
+        "replica_queue": 0.02 + 0.01,     # 0.03->0.05, 0.62->0.63
+        "admission_wait": 0.01 + 0.01,    # admit -> own chunk start
+        "prefill": 0.04 + 0.06,           # chunk start -> prefilled
+        "decode": 0.20 + 0.20,            # prefilled -> tick / finish
+        "preempted": 0.0,
+        # r0's last flushed event (0.30) -> re-dispatch (0.60): kill,
+        # detection ladder, requeue — the failover COST
+        "failover_replay": 0.30,
+    }
+
+
+@pytest.mark.parametrize("r0_t0,r0_off,r1_t0,r1_off", [
+    (1000.0, 0.0, 1000.0, 0.0),     # aligned clocks (loopback shape)
+    (5.25, 987654.0, 2e6, -777.5),  # wildly skewed boot epochs, both
+    #                                 directions (the cross-host shape)
+])
+def test_failover_yields_one_fully_attributed_trace(tmp_path, r0_t0,
+                                                    r0_off, r1_t0,
+                                                    r1_off):
+    """The acceptance shape: a request surviving a mid-decode SIGKILL
+    failover produces ONE merged trace whose hops span both replicas,
+    with every wall-clock second in exactly one bucket (overcommit 0,
+    unattributed 0) — and the attribution is invariant to the replicas'
+    clock epochs, because the link_clock samples map them out."""
+    tid = _build_failover_spills(tmp_path, r0_t0=r0_t0, r0_off=r0_off,
+                                 r1_t0=r1_t0, r1_off=r1_off)
+    report = merge_dir(str(tmp_path))
+    assert list(report["traces"]) == [tid]
+    rec = report["traces"][tid]
+    assert rec["state"] == "finished"
+    assert rec["attempts"] == 2
+    assert rec["replicas"] == ["r0", "r1"]
+    assert rec["tenant"] == "acme" and rec["rid"] == 3
+    assert rec["overcommit_s"] == 0.0
+    assert rec["unattributed_s"] == 0.0
+    assert rec["clock_clamped_s"] == 0.0
+    assert rec["wall_s"] == pytest.approx(0.92, abs=1e-6)
+    for bucket, want in _expected_hops().items():
+        assert rec["hops"][bucket] == pytest.approx(want, abs=1e-6), \
+            bucket
+    assert sum(rec["hops"].values()) == pytest.approx(rec["wall_s"],
+                                                      abs=1e-5)
+    summary = report["summary"]
+    assert summary["states"] == {"finished": 1}
+    assert summary["overcommit_s"] == 0.0
+    # the tail row names the dominant hop (decode at 0.40s here, with
+    # failover_replay the visible runner-up in the hops dict)
+    assert summary["tail"][0]["slowest_hop"] == "decode"
+    assert summary["tail"][0]["replicas"] == ["r0", "r1"]
+
+
+def test_stitch_tolerates_offset_error_by_clamping(tmp_path):
+    """A slightly wrong link offset can map a replica event BEFORE the
+    dispatch that caused it; the walk must clamp (and account) rather
+    than reorder or go negative — hop sums still close the books."""
+    tid = _build_failover_spills(tmp_path)
+    # poison r0's offset by +25ms (beyond any hop gap around dispatch)
+    path = tmp_path / "timeline.router.router.1.jsonl"
+    lines = path.read_text().strip().splitlines()
+    out = []
+    for line in lines:
+        ev = json.loads(line)
+        if ev.get("kind") == "link_clock" and ev.get("replica") == "r0":
+            ev["offset_s"] -= 0.025
+        out.append(json.dumps(ev))
+    path.write_text("\n".join(out) + "\n")
+    rec = merge_dir(str(tmp_path))["traces"][tid]
+    assert rec["clock_clamped_s"] > 0.0
+    assert rec["overcommit_s"] == 0.0 and rec["unattributed_s"] == 0.0
+    assert sum(rec["hops"].values()) == pytest.approx(rec["wall_s"],
+                                                      abs=1e-5)
+
+
+def test_read_fleet_spills_requires_router_and_splits_roles(tmp_path):
+    with pytest.raises(ValueError, match="no router spill"):
+        read_fleet_spills(str(tmp_path / "empty"))
+    _build_failover_spills(tmp_path, r0_off=4.5, r1_off=-2.0)
+    router_run, replicas = read_fleet_spills(str(tmp_path))
+    assert router_run[0]["role"] == "router"
+    assert sorted(replicas) == ["r0", "r1"]
+
+
+# ------------------------------------------------- live router tracing
+
+
+def drive(router, reps, *, max_iters=5000):
+    for _ in range(max_iters):
+        router.pump()
+        if router.idle():
+            return
+        for rep in reps:
+            rep.tick()
+    raise AssertionError("fleet not idle")
+
+
+def test_router_mints_traces_only_when_armed():
+    rep = FakeReplica("a")
+    router = make_router([rep])
+    try:
+        req_dark = router.submit([3, 5], 3)
+        rec = timeline.arm(FlightRecorder(None))
+        req_lit = router.submit([3, 5, 7], 3)
+        drive(router, [rep])
+    finally:
+        timeline.disarm()
+        router.close()
+    assert req_dark.trace_id is None
+    assert req_lit.trace_id is not None
+    kinds = [(e["kind"], e.get("trace_id")) for e in rec.events()
+             if e.get("trace_id") == req_lit.trace_id]
+    assert [k for k, _ in kinds] == ["fleet_submit", "fleet_dispatch",
+                                     "fleet_finish"]
+    # the hop stamp rode the wire: the fake saw trace=None for the dark
+    # request and the {trace_id, attempt} dict for the lit one
+    assert req_lit.output_tokens == reference([3, 5, 7], 3)
+
+
+def test_router_only_trace_stitches_and_closes_books():
+    """A fleet whose replicas spill no timeline (hermetic fakes, or
+    replicas simply unarmed) still yields a closed router-side trace:
+    dispatch -> finish all lands in `wire` (the router cannot see
+    inside), and the books still balance exactly."""
+    rep = FakeReplica("a")
+    router = make_router([rep])
+    try:
+        rec = timeline.arm(FlightRecorder(None))
+        req = router.submit([9, 2], 4)
+        drive(router, [rep])
+    finally:
+        timeline.disarm()
+        router.close()
+    traces = stitch_traces(rec.events(), {})
+    assert list(traces) == [req.trace_id]
+    t = traces[req.trace_id]
+    assert t["state"] == "finished"
+    assert t["overcommit_s"] == 0.0 and t["unattributed_s"] == 0.0
+    assert sum(t["hops"].values()) == pytest.approx(t["wall_s"],
+                                                    abs=1e-5)
+    assert t["hops"]["wire"] > 0.0
+    summary = summarize_traces(traces)
+    assert summary["requests"] == 1
+    assert set(summary["hop_totals_s"]) == set(TRACE_HOP_BUCKETS)
+
+
+def test_shed_request_trace_terminates_rejected():
+    rep = FakeReplica("a")
+    router = make_router([rep], max_queue_depth=1)
+    try:
+        rec = timeline.arm(FlightRecorder(None))
+        reqs = [router.submit([5], 2) for _ in range(6)]
+        drive(router, [rep])
+    finally:
+        timeline.disarm()
+        router.close()
+    shed = [r for r in reqs if r.state is RequestState.REJECTED]
+    assert shed
+    traces = stitch_traces(rec.events(), {})
+    for req in shed:
+        assert traces[req.trace_id]["state"] == "rejected"
+
+
+# ----------------------------------------------------- SLO plane
+
+
+def test_fleet_statusz_slo_plane_and_http():
+    rep = FakeReplica("a", max_batch=8)
+    router = make_router([rep], replica_queue_limit=8,
+                         max_queue_depth=6)
+    srv = DebugServer(registry=router.registry, engine=router).start()
+    try:
+        reqs = [router.submit([3, 5, 7], 4, tenant="acme"),
+                router.submit([2, 4], 4, tenant="beta", priority=1)]
+        shed = [router.submit([8], 2, tenant="acme")
+                for _ in range(8)]
+        drive(router, [rep])
+        status = router.fleet_statusz()
+        tenants = status["slo"]["tenants"]
+        assert set(tenants) >= {"acme", "beta"}
+        assert tenants["acme"]["finished"] >= 1
+        assert tenants["acme"]["ttft_ms"]["count"] >= 1
+        assert tenants["acme"]["ttft_ms"]["p99"] is not None
+        assert tenants["beta"]["tpot_ms"]["count"] >= 1
+        assert tenants["acme"]["queue_wait_ms"]["count"] >= 1
+        n_shed = sum(1 for r in shed
+                     if r.state is RequestState.REJECTED)
+        assert n_shed >= 1
+        assert tenants["acme"]["rejected"] == n_shed
+        prios = status["slo"]["priorities"]
+        assert set(prios) >= {"0", "1"}
+        assert prios["1"]["finished"] == 1
+        assert status["totals"]["submitted"] == len(reqs) + len(shed)
+        assert status["totals"]["rejected"] == n_shed
+        # the HTTP plane: /fleet/statusz serves the same payload
+        with urllib.request.urlopen(
+                srv.url("/fleet/statusz"), timeout=10) as resp:
+            assert resp.status == 200
+            payload = json.loads(resp.read())
+        assert payload["slo"]["tenants"]["acme"]["finished"] >= 1
+        assert "replicas" in payload
+    finally:
+        srv.close()
+        router.close()
+    # no fleet attached -> 404, not a fake-empty answer
+    srv2 = DebugServer(registry=MetricRegistry(rank=0, world=1)).start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(srv2.url("/fleet/statusz"),
+                                   timeout=10)
+        assert exc.value.code == 404
+    finally:
+        srv2.close()
+
+
+def test_slo_key_space_is_bounded():
+    """Tenants are caller-supplied strings: past ``slo_key_cap``
+    distinct keys, new arrivals account under "(other)" — a client
+    stamping a unique tenant per request must not grow the registry
+    (3 windowed histograms + counters per key) without bound."""
+    rep = FakeReplica("a", max_batch=8)
+    router = make_router([rep], replica_queue_limit=8,
+                         max_queue_depth=64, slo_key_cap=3)
+    try:
+        for i in range(10):
+            router.submit([3, 5], 2, tenant=f"t{i}")
+        drive(router, [rep])
+        tenants = router.fleet_statusz()["slo"]["tenants"]
+        assert len(tenants) == 4                  # 3 real + overflow
+        assert "(other)" in tenants
+        # overflow traffic is accounted, not dropped
+        assert tenants["(other)"]["finished"] == 7
+        assert sum(t["finished"] for t in tenants.values()) == 10
+    finally:
+        router.close()
+
+
+def test_introspect_has_link_rtt_percentiles():
+    rep = FakeReplica("a")
+    # duck-typed RTT samples: the router drains them into the windowed
+    # per-replica histogram (ISSUE 15 satellite)
+    samples = [(0.001, 0.0, 10.0), (0.002, 0.0, 10.5),
+               (0.100, 0.0, 11.0)]
+    rep.take_rtt_samples = \
+        lambda: [samples.pop(0)] if samples else []
+    router = make_router([rep])
+    try:
+        for _ in range(5):
+            router.pump()
+        intro = router.introspect()["replicas"]["a"]
+        assert intro["link_rtt_p50_ms"] is not None
+        assert intro["link_rtt_p99_ms"] >= intro["link_rtt_p50_ms"]
+    finally:
+        router.close()
+
+
+# ------------------------------------------------- batched event relay
+
+
+def test_transport_server_unpacks_batched_relay():
+    """The worker's one-put-per-turn ("batch", [...]) payload: each
+    sub-event gets its OWN wire sequence number — the client never sees
+    the wrapper."""
+    from apex_tpu.serving.transport import TransportServer
+
+    cmd_q, evt_q = queue.Queue(), queue.Queue()
+    server = TransportServer(cmd_q, evt_q)
+    try:
+        evt_q.put(("batch", [("token", 1, 5), ("token", 1, 6),
+                             ("finished", 1)]))
+        evt_q.put(("state", {"queue_depth": 0}))
+        deadline = time.monotonic() + 10
+        while len(server._ring) < 4 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        ring = list(server._ring)
+        assert [seq for seq, _ in ring] == [1, 2, 3, 4]
+        assert [ev[0] for _, ev in ring] == ["token", "token",
+                                             "finished", "state"]
+    finally:
+        server.close(bye=False)
+
+
+def test_replica_process_poll_unpacks_batches():
+    """ReplicaProcess.poll flattens ("batch", ...) payloads in order
+    and keeps the relay counters the router mirrors into
+    fleet/relay_batch*."""
+    from apex_tpu.serving.replica import ReplicaProcess
+
+    rp = ReplicaProcess.__new__(ReplicaProcess)   # no child spawn
+    rp.relay_batches = 0
+    rp.relay_batched_events = 0
+    rp._evt = queue.Queue()
+    rp._evt.put(("ready", {"pid": 1}))
+    rp._evt.put(("batch", [("token", 0, 1), ("token", 0, 2)]))
+    rp._evt.put(("batch", [("finished", 0)]))
+    events = rp.poll()
+    assert events == [("ready", {"pid": 1}), ("token", 0, 1),
+                      ("token", 0, 2), ("finished", 0)]
+    assert rp.relay_batches == 2
+    assert rp.relay_batched_events == 3

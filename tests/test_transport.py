@@ -269,7 +269,16 @@ def test_reconnect_churn_is_lossless_no_failover():
             router.pump()
             if router.idle():
                 break
-            served.tick()
+            if client._hello_done and served.server._active is not None:
+                # tick the replica only over a live session — BOTH
+                # ends' view: tokens generated into a severed link pile
+                # up in the server ring and the reconnect replays them
+                # as one burst that can blow past the next drop window
+                # entirely (the observed flake; the client alone is not
+                # enough — it learns of the cut ~20ms after the server
+                # does).  Gating makes each window deterministic
+                # without changing what is proven.
+                served.tick()
             if drops < 2 and len(req.output_tokens) >= 2 * (drops + 1):
                 proxy.drop_connections()   # ≥4 tokens still outstanding
                 drops += 1
