@@ -32,6 +32,10 @@ hop bucket          interval
 ``failover_replay`` the dead replica's last flushed event →
                     the re-``fleet_dispatch`` (detection + probe ladder
                     + router requeue — the failover *cost*)
+``kv_migrate``      ``fleet_migrate_start`` → the dispatch onto the
+                    decode replica (ISSUE 16: export + per-block relay
+                    + commit — the disaggregation handoff cost,
+                    attributed, never guessed)
 ==================  =====================================================
 
 Exhaustive and disjoint **by construction**: the attribution is a
@@ -89,7 +93,7 @@ __all__ = [
 
 TRACE_HOP_BUCKETS = (
     "router_queue", "wire", "replica_queue", "admission_wait",
-    "prefill", "decode", "preempted", "failover_replay",
+    "prefill", "decode", "preempted", "failover_replay", "kv_migrate",
 )
 
 # Milestone kinds and their state transitions (the walk below).  Rank
@@ -101,11 +105,12 @@ _KIND_RANK = {
     "request_admit": 3, "prefill_chunk_start": 4,
     "prefill_chunk_end": 5, "decode_tick": 6, "request_prefilled": 6,
     "request_preempt": 7, "request_cancel": 7, "request_reject": 7,
+    "fleet_migrate_start": 7,
     "fleet_replay": 8, "request_finish": 9, "fleet_finish": 10,
     "fleet_reject": 10,
 }
 _ROUTER_KINDS = ("fleet_submit", "fleet_dispatch", "fleet_replay",
-                 "fleet_finish", "fleet_reject")
+                 "fleet_migrate_start", "fleet_finish", "fleet_reject")
 _REPLICA_KINDS = ("request_submit", "request_admit",
                   "request_prefilled", "decode_tick", "request_preempt",
                   "request_cancel", "request_reject", "request_finish")
@@ -336,6 +341,11 @@ _TRANSITION = {
     "request_prefilled": "decode",
     "request_preempt": "preempted",
     "fleet_replay": "failover_replay",
+    # the disaggregation handoff (ISSUE 16): opened by the router's
+    # migrate-start, closed by the dispatch-onto-decode (the ordinary
+    # "wire" transition) — a failed handoff exits through fleet_replay
+    # instead, so either way the books close
+    "fleet_migrate_start": "kv_migrate",
     "request_finish": "return_wire",
 }
 _BUCKET_OF = {state: state for state in TRACE_HOP_BUCKETS}

@@ -81,6 +81,7 @@ from apex_tpu.observability import timeline
 from apex_tpu.parallel import collectives as cc
 from apex_tpu.parallel.mesh import TENSOR_AXIS, get_mesh
 from apex_tpu.serving.kv_cache import (
+    ExportLedger,
     KVCacheConfig,
     arena_partition_spec,
     init_kv_arena,
@@ -262,12 +263,24 @@ class ServingEngine:
         # serving_decode)
         self._decode = jax.jit(decode_body, donate_argnums=(0,))
         self._prefill = jax.jit(prefill_body, donate_argnums=(0,))
+        # KV-block migration (ISSUE 16): one donated scatter lands a
+        # whole imported run in the arenas per migration flush — one
+        # device put per flush, never one per block
+        self._import_scatter = jax.jit(
+            lambda arenas, idx, vals: tuple(
+                a.at[:, idx].set(v) for a, v in zip(arenas, vals)),
+            donate_argnums=(0,))
         self._jnp = jnp
 
         self.scheduler = Scheduler(
             self.cache, serving.max_batch, chunk_tokens=self.prefill_len,
             admission=serving.admission,
             prefix_caching=serving.prefix_caching)
+        # pin-until-ack ledger for exported (migrating) block runs: the
+        # run stays held until the decode side acks, then frees into the
+        # prefix cache as evictable capacity
+        self.exports = ExportLedger(self.scheduler.allocator,
+                                    self.scheduler.prefix_cache)
         self.registry = registry if registry is not None else \
             default_registry()
         self.guard = guard
@@ -366,6 +379,149 @@ class ServingEngine:
                           **trace_fields(req))
         self.registry.counter("serving/preemption_drains").inc()
         return cancelled
+
+    # ------------------------------------------------- KV migration (ISSUE 16)
+
+    def export_request(self, req: Request) -> Tuple[dict, List[tuple]]:
+        """Extract a RUNNING request's KV-block run for migration to a
+        decode replica.
+
+        One batched device gather per arena pulls the run
+        (``blocks_for(cache_len)`` blocks) to the host; each block
+        becomes one payload tuple — ``(k, v)`` or ``(k, v, k_scale,
+        v_scale)`` per-block slabs — sized to ride one wire frame, so
+        the transfer streams and resumes at block boundaries.  The run
+        is then **pinned** in the export ledger (refcount +1 under the
+        export owner) and the request leaves the scheduler silently (no
+        finish/cancel event — the stream continues on the decode side);
+        its own block refs free normally, so the run survives at
+        refcount 1 until :meth:`release_export`.
+
+        Returns ``(meta, payloads)``.  Raises ``ValueError`` when the
+        request is not in an exportable state (still prefilling, no
+        token emitted yet, already exporting) — the caller degrades to
+        letting it keep decoding locally."""
+        if req.state is not RequestState.RUNNING or req.slot is None:
+            raise ValueError(
+                f"request {req.rid} is {req.state}, not exportable")
+        if req.prefilling or not req.output_tokens:
+            raise ValueError(
+                f"request {req.rid} has not completed prefill + first "
+                "token; nothing to migrate yet")
+        seq = req.sequence_tokens()
+        if req.cache_len != len(seq) - 1:
+            raise ValueError(
+                f"request {req.rid} cache_len {req.cache_len} out of "
+                f"phase with its {len(seq)}-token stream")
+        n_blocks = self.cache.blocks_for(req.cache_len)
+        run = list(req.blocks[:n_blocks])
+        idx = self._jnp.asarray(np.asarray(run, np.int32))
+        # one gather + one device->host transfer per arena (batched tx)
+        slabs = [np.asarray(a[:, idx]) for a in self.arenas]
+        payloads = [tuple(slab[:, j] for slab in slabs)
+                    for j in range(n_blocks)]
+        n_bytes = int(sum(s.nbytes for s in slabs))
+        self.exports.pin(req.rid, run, seq[:req.cache_len],
+                         req.cache_len)
+        # the request leaves this engine silently: the slot's table row
+        # zeroes and its own refs free (the export pin keeps the run)
+        self._tables[req.slot][:] = 0
+        self.scheduler.finish(req)
+        self.registry.counter("serving/kv_export_blocks").inc(n_blocks)
+        timeline.emit("request_export", rid=req.rid,
+                      tokens=len(req.output_tokens), blocks=n_blocks,
+                      **trace_fields(req))
+        meta = {
+            "cache_len": req.cache_len,
+            "n_blocks": n_blocks,
+            "n_out": len(req.output_tokens),
+            "block_size": self.cache.block_size,
+            "n_layers": self.cache.n_layers,
+            "kv_heads": self.cache.kv_heads,
+            "head_dim": self.cache.head_dim,
+            "dtype": str(np.dtype(self.cache.dtype)),
+            "bytes": n_bytes,
+        }
+        return meta, payloads
+
+    def release_export(self, rid, *, ok: bool) -> None:
+        """Drop the pin on an exported run (the decode side's ack, or
+        the router's abort).  Either way the run's full blocks index
+        into the local prefix cache — the KV is valid content, and a
+        failed migration's re-prefill routed back here then hits it —
+        and the pin frees.  Idempotent: a duplicate/stale ack is a
+        no-op."""
+        self.exports.release(rid, to_cache=True)
+        if not ok:
+            self.registry.counter("serving/kv_export_aborts").inc()
+
+    def _check_import_payloads(self, payloads: List[tuple]) -> None:
+        """Reject a malformed migration payload BEFORE any device put —
+        a torn or mismatched transfer must degrade to re-prefill, never
+        land partial garbage in the arena."""
+        want_shapes = [a.shape[:1] + a.shape[2:] for a in self.arenas]
+        want_dtypes = [a.dtype for a in self.arenas]
+        for j, p in enumerate(payloads):
+            if len(p) != len(self.arenas):
+                raise ValueError(
+                    f"imported block {j} carries {len(p)} slabs, arena "
+                    f"set has {len(self.arenas)}")
+            for s, shape, dtype in zip(p, want_shapes, want_dtypes):
+                if tuple(np.shape(s)) != tuple(shape) \
+                        or np.dtype(getattr(s, "dtype", None)) != dtype:
+                    raise ValueError(
+                        f"imported block {j} slab shape/dtype "
+                        f"{np.shape(s)}/{getattr(s, 'dtype', None)} != "
+                        f"arena {tuple(shape)}/{dtype}")
+
+    def import_request(self, prompt: Sequence[int], max_new_tokens: int,
+                       eos_id: Optional[int] = None,
+                       sampling: Optional[SamplingParams] = None,
+                       trace: Optional[dict] = None, *,
+                       cache_len: int,
+                       payloads: List[tuple]) -> Request:
+        """Admit a migrated request with its KV run injected into the
+        local arenas (the decode side of a KV-block migration).
+
+        ``prompt`` is the request's full wire sequence so far (original
+        prompt + every token already streamed — exactly the failover-
+        replay wire), ``cache_len`` the tokens the imported run covers
+        (always ``len(prompt) - 1``: the last wire token recomputes
+        here, which is what makes the continued stream bitwise the
+        replay stream), ``payloads`` the per-block slabs from
+        :meth:`export_request`.  The injection is ONE donated scatter
+        per migration flush across all arenas.  Raises on missing
+        capacity or a malformed payload — the caller reports a typed
+        failure and the router degrades to re-prefill."""
+        self._check_import_payloads(payloads)
+        req = self.scheduler.admit_imported(
+            prompt, max_new_tokens, eos_id, sampling,
+            cache_len=cache_len, n_blocks=len(payloads))
+        if trace is not None:
+            req.trace_id = trace.get("trace_id")
+            req.trace_attempt = int(trace.get("attempt", 0))
+        timeline.emit("request_submit", rid=req.rid,
+                      prompt_tokens=len(req.prompt),
+                      max_new_tokens=max_new_tokens, imported=True,
+                      **trace_fields(req))
+        if req.state is RequestState.REJECTED:
+            self.registry.counter("serving/requests_rejected").inc()
+            timeline.emit("request_reject", rid=req.rid,
+                          **trace_fields(req))
+            return req
+        idx = self._jnp.asarray(
+            np.asarray(req.blocks[:len(payloads)], np.int32))
+        vals = tuple(
+            np.stack([p[i] for p in payloads], axis=1)
+            for i in range(len(self.arenas)))
+        self.arenas = self._import_scatter(self.arenas, idx, vals)
+        self.scheduler.note_imported(req)
+        self.registry.counter("serving/kv_import_blocks").inc(
+            len(payloads))
+        timeline.emit("request_admit", rid=req.rid, slot=req.slot,
+                      blocks=len(req.blocks), hit_blocks=0,
+                      imported=True, **trace_fields(req))
+        return req
 
     # ---------------------------------------------------------------- step
 
@@ -702,6 +858,7 @@ class ServingEngine:
             "prefix_cache_hits": (pc.hits if pc is not None else None),
             "evictions": (pc.evictions if pc is not None else None),
             "preemptions": sched.preemptions,
+            "kv_exports_pinned": len(self.exports),
             "spec_width": self.spec_width,
             "spec_proposed": self.spec_proposed,
             "spec_accepted": self.spec_accepted,

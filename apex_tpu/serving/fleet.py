@@ -106,6 +106,30 @@ finished/rejected/replay counts, served merged (with replica
 heartbeats and transport counters) by :meth:`FleetRouter.
 fleet_statusz` → the debug server's ``/fleet/statusz``.  Unarmed,
 all of it is a None check.
+
+ISSUE 16 — disaggregated prefill/decode fleets.  A replica's
+``ReplicaSpec.role`` rides its ready handshake; placement grows a role
+axis beside prefix/adapter affinity: initial dispatch prefers
+prefill-eligible replicas (``prefill``/``both``), and once a request
+on a ``role="prefill"`` replica has its first token the router
+migrates its paged KV to a decode-eligible replica — a streamed
+per-block relay over the SAME session-layer frames both transports
+already speak (``kv_meta`` → N×``kv_block`` → ``kv_export_done`` up
+from the source; ``import_kv`` → N×``kv_block`` → ``import_commit``
+down to the destination), so a reconnect resumes mid-migration at a
+block boundary instead of restarting.  The handoff state machine is
+failure-first: the source keeps the run PINNED until the router's
+``kv_ack``, and EVERY fault — source death, destination death, torn
+frame, import refusal, stream-completed-during-transfer — degrades to
+the existing re-prefill/replay path (the request re-enters the pool
+with its emitted prefix; bitwise identity holds by the same argument
+as failover).  ``role="both"`` fleets never migrate: byte-for-byte
+the PR 15 behavior.  Counters: ``fleet/kv_migrate_started`` /
+``_completed`` / ``_failed`` / ``_blocks`` / ``_bytes`` +
+``fleet/kv_migrate_ms`` windowed histogram; per-role SLO splits and
+migration backlog ride :meth:`FleetRouter.fleet_statusz`; the
+``fleet_migrate_start`` hop event opens the trace plane's
+``kv_migrate`` bucket (closed by the dispatch-onto-decode).
 """
 
 from __future__ import annotations
@@ -157,6 +181,11 @@ class FleetRequest:
     # k > 1 means a failover replay / drain reschedule re-dispatch)
     trace_id: Optional[str] = None
     dispatches: int = 0
+    # set at migration commit (ISSUE 16): the next inter-token gap
+    # spans the handoff (already accounted in fleet/kv_migrate_ms), so
+    # the per-ROLE pool-health TPOT skips it once — tenant-facing TPOT
+    # keeps the gap (the stall is real user-visible latency)
+    migrated_gap: bool = False
     # bounded SLO accounting keys, resolved ONCE at submit (the token
     # path is the router's hottest loop — it must not re-derive them
     # per token): (tenant_key, priority_key), "(other)" past the cap
@@ -205,6 +234,13 @@ class _ReplicaView:
     @property
     def name(self) -> str:
         return self.client.name
+
+    @property
+    def role(self) -> str:
+        """Fleet role from the ready handshake (ISSUE 16); a transport
+        that does not say (pre-16 daemons, hermetic fakes) reads as
+        ``"both"`` — the never-migrates default."""
+        return (self.meta or {}).get("role") or "both"
 
     def dispatchable(self) -> bool:
         return (self.ready and not self.down and not self.draining
@@ -265,6 +301,8 @@ class FleetRouter:
                  link_degraded_rtt_s: float = 1.0,
                  dispatch_deadline_s: float = 120.0,
                  slo_key_cap: int = 64,
+                 migrate_min_remaining: int = 2,
+                 migrate_max_inflight: int = 16,
                  registry=None, clock: Callable[[], float] = time.monotonic):
         from apex_tpu.observability.metrics import default_registry
 
@@ -336,6 +374,18 @@ class FleetRouter:
         self.slo_key_cap = slo_key_cap
         self._slo_tenants: set = set()
         self._slo_priorities: set = set()
+        # KV migration (ISSUE 16): rid -> handoff record.  A request on
+        # a role="prefill" replica becomes a migration candidate once
+        # it has a first token AND at least migrate_min_remaining
+        # budget left (a stream about to finish is cheaper to let
+        # finish in place than to ship).  migrate_max_inflight bounds
+        # concurrent handoffs so a prefill flood cannot turn the
+        # router into an unbounded block relay.
+        # record: {"src", "dst", "phase": "export"|"transfer"|"commit"
+        #          |"aborted", "meta", "n_sent", "t_start"}
+        self.migrate_min_remaining = int(migrate_min_remaining)
+        self.migrate_max_inflight = int(migrate_max_inflight)
+        self._migrations: Dict[int, dict] = {}
 
     # ----------------------------------------------------------- tenants
 
@@ -482,6 +532,7 @@ class FleetRouter:
             if not view.down:
                 self._detect_failure(view)
         self._dispatch()
+        self._pump_migrations()
         live = sum(1 for v in self._views.values()
                    if not v.down and v.client.alive())
         self.registry.gauge("fleet/replicas_live").set(live)
@@ -590,6 +641,11 @@ class FleetRouter:
                     f"fleet/tenant/{tkey}/ttft_ms").observe(ttft_ms)
                 self._slo_hist(
                     f"fleet/priority/{pkey}/ttft_ms").observe(ttft_ms)
+                # per-role SLO split (ISSUE 16): the same latency keyed
+                # by the EMITTING replica's role, so /fleet/statusz can
+                # answer "is the decode pool's p99 clean" directly
+                self._slo_hist(
+                    f"fleet/role/{view.role}/ttft_ms").observe(ttft_ms)
             else:
                 tpot_ms = (now - req.t_last_token) * 1e3
                 self.registry.histogram(
@@ -598,6 +654,14 @@ class FleetRouter:
                     f"fleet/tenant/{tkey}/tpot_ms").observe(tpot_ms)
                 self._slo_hist(
                     f"fleet/priority/{pkey}/tpot_ms").observe(tpot_ms)
+                if req.migrated_gap:
+                    # the gap spanning the handoff is kv_migrate cost,
+                    # not the decode pool's steady-state TPOT
+                    req.migrated_gap = False
+                else:
+                    self._slo_hist(
+                        f"fleet/role/{view.role}/tpot_ms").observe(
+                        tpot_ms)
             req.t_last_token = now
             req.output_tokens.append(int(token))
         elif kind == "finished":
@@ -621,6 +685,9 @@ class FleetRouter:
         elif kind == "drained":
             view.drained = True
             view.draining = True
+        elif kind in ("kv_meta", "kv_block", "kv_export_done",
+                      "kv_export_failed", "kv_imported"):
+            self._handle_migration_event(view, ev)
         elif kind == "error":
             logger.warning("fleet: replica %s relayed error: %r",
                            view.name, ev[1])
@@ -711,6 +778,7 @@ class FleetRouter:
                            "in-flight request(s)", view.name, reason,
                            len(view.assigned))
             self.registry.counter("fleet/failovers").inc()
+        self._abort_migrations_for(view)
         self._replay(view)
 
     def _replay(self, view: _ReplicaView) -> None:
@@ -787,6 +855,16 @@ class FleetRouter:
                       and v.in_flight() < self.replica_queue_limit]
         if not candidates:
             return None
+        # Role axis (ISSUE 16): initial dispatch is the admission +
+        # chunked-prefill phase, so prefill-eligible replicas
+        # ("prefill"/"both") win it; decode specialists take requests
+        # through KV migration instead.  Graceful degradation over
+        # starvation: when every candidate is a decode specialist, use
+        # them anyway — a "decode" replica is a full engine and
+        # prefills correctly, just not at its best placement.
+        prefill_ok = [v for v in candidates if v.role != "decode"]
+        if prefill_ok:
+            candidates = prefill_ok
         # Prefix-cache affinity (ISSUE 13 satellite): the replica that
         # last served this tenant plausibly still holds the tenant's
         # template blocks in its PrefixCache, so landing there turns
@@ -926,6 +1004,293 @@ class FleetRouter:
                     self._reject(req)
         self._no_dispatch_since = None
 
+    # ------------------------------------------------- KV migration (16)
+
+    def _view_if_up(self, name: Optional[str]) -> Optional[_ReplicaView]:
+        view = self._views.get(name) if name is not None else None
+        if view is None or view.down or not view.client.alive():
+            return None
+        return view
+
+    def _pick_migration_dst(self, src: _ReplicaView
+                            ) -> Optional[_ReplicaView]:
+        """A decode-eligible landing replica: decode specialists first
+        (the whole point of the split), ``both`` as fallback, never the
+        source, never past the per-replica ceiling."""
+        candidates = [v for v in self._views.values()
+                      if v is not src and v.dispatchable()
+                      and v.role != "prefill"
+                      and v.in_flight() < self.replica_queue_limit]
+        if not candidates:
+            return None
+
+        def score(v: _ReplicaView):
+            state = v.state or {}
+            return (1 if v.link_degraded else 0,
+                    0 if v.role == "decode" else 1,
+                    -int(state.get("free_blocks", 0)),
+                    len(v.assigned), v.name)
+
+        return min(candidates, key=score)
+
+    def _pump_migrations(self) -> None:
+        """The handoff trigger: any first-tokened request sitting on a
+        ``role="prefill"`` replica with enough budget left ships its KV
+        to a decode replica.  One ``export_kv`` command starts it; the
+        rest of the state machine runs on the source's event stream
+        (:meth:`_handle_migration_event`)."""
+        if len(self._migrations) >= self.migrate_max_inflight:
+            return
+        for view in list(self._views.values()):
+            if view.role != "prefill" or not view.dispatchable():
+                continue
+            for rid, req in list(view.assigned.items()):
+                if len(self._migrations) >= self.migrate_max_inflight:
+                    return
+                if (rid in self._migrations or req.done
+                        or req.t_first_token is None
+                        or not req.output_tokens
+                        or req.remaining < self.migrate_min_remaining
+                        or self._stream_complete(req)):
+                    continue
+                dst = self._pick_migration_dst(view)
+                if dst is None:
+                    return      # nowhere to land; keep decoding here
+                try:
+                    view.client.export_kv(rid)
+                except Exception as e:
+                    logger.warning(
+                        "fleet: export_kv to %s failed: %r",
+                        view.name, e)
+                    self._mark_down(
+                        view, f"dead pipe on export_kv: {e!r}")
+                    return
+                self._migrations[rid] = {
+                    "src": view.name, "dst": dst.name,
+                    "phase": "export", "meta": None, "n_sent": 0,
+                    "t_start": time.monotonic()}
+                self.registry.counter("fleet/kv_migrate_started").inc()
+                if req.trace_id is not None:
+                    # opens the trace plane's kv_migrate hop; the
+                    # dispatch-onto-decode at commit closes it
+                    timeline.emit("fleet_migrate_start", rid=rid,
+                                  trace_id=req.trace_id,
+                                  attempt=req.dispatches,
+                                  src=view.name, dst=dst.name,
+                                  prior_tokens=len(req.output_tokens))
+
+    def _resolve_migration(self, rid: int, rec: dict, why: str, *,
+                           requeue: bool = True) -> None:
+        """Common failure epilogue: un-pin the source (``kv_ack`` False
+        — the exported run still indexes into its prefix cache, so the
+        re-prefill that follows is usually a block-share, not a
+        recompute), drop any pending destination import, and put the
+        request back in the pool.  The degraded path IS the proven
+        replay path — token identity needs no new argument."""
+        self._migrations.pop(rid, None)
+        self.registry.counter("fleet/kv_migrate_failed").inc()
+        src = self._view_if_up(rec["src"])
+        dst = self._view_if_up(rec["dst"])
+        if dst is not None:
+            try:
+                dst.client.kv_abort(rid)
+            except Exception:       # dying pipe: poll() will verdict it
+                pass
+        if src is not None:
+            try:
+                src.client.kv_ack(rid, False)
+            except Exception:
+                pass
+        req = self.requests.get(rid)
+        if req is None or req.done:
+            return
+        if not requeue:
+            return                  # still decoding on the source
+        for name in (rec["src"], rec["dst"]):
+            v = self._views.get(name)
+            if v is not None:       # down views too: a later _replay
+                v.assigned.pop(rid, None)   # must not double-enqueue
+        if self._stream_complete(req):
+            self._finish(req, None)
+            return
+        logger.warning("fleet: KV migration of request %d failed (%s); "
+                       "degrading to re-prefill", rid, why)
+        self._requeue_or_park(req, f"kv migration failed: {why}",
+                              replica=rec["src"])
+
+    def _abort_migrations_for(self, view: _ReplicaView) -> None:
+        """A replica going down mid-handoff (either side).  Source
+        down: the request is still in its ``assigned`` map, so the
+        ordinary :meth:`_replay` that follows covers it — only the
+        destination's pending import needs dropping.  Destination
+        down: the source's export may still be streaming, so the
+        record flips to "aborted" and the source's own
+        ``kv_export_done`` resolves it (its events are swallowed in
+        between); a handoff already past export resolves immediately."""
+        for rid, rec in list(self._migrations.items()):
+            if rec["src"] == view.name:
+                if rec["phase"] == "commit":
+                    # the commit already raced toward the decode
+                    # replica — it may be admitted there any moment, so
+                    # it must NOT also replay (double execution).  Move
+                    # it optimistically; the kv_imported verdict (or
+                    # the destination's own death) resolves the handoff
+                    req = self.requests.get(rid)
+                    dst = self._view_if_up(rec["dst"])
+                    view.assigned.pop(rid, None)
+                    if req is not None and not req.done \
+                            and dst is not None:
+                        req.replica = dst.name
+                        dst.assigned[rid] = req
+                    else:
+                        self._resolve_migration(
+                            rid, rec, "source died at commit")
+                    continue
+                self._migrations.pop(rid, None)
+                self.registry.counter("fleet/kv_migrate_failed").inc()
+                dst = self._view_if_up(rec["dst"])
+                if dst is not None:
+                    try:
+                        dst.client.kv_abort(rid)
+                    except Exception:
+                        pass
+            elif rec["dst"] == view.name:
+                if rec["phase"] in ("export", "transfer"):
+                    rec["phase"] = "aborted"
+                else:
+                    self._resolve_migration(
+                        rid, rec, f"decode replica {view.name} died")
+
+    def _handle_migration_event(self, view: _ReplicaView,
+                                ev: tuple) -> None:
+        kind, rid = ev[0], ev[1]
+        rec = self._migrations.get(rid)
+        if rec is None:
+            return      # stale event of an already-resolved handoff
+        req = self.requests.get(rid)
+        if kind == "kv_export_failed" and view.name == rec["src"]:
+            # nothing left the source engine — the request just keeps
+            # decoding there; only the destination's pending import
+            # (if the meta ever went out) needs dropping
+            self._resolve_migration(rid, rec, str(ev[2]), requeue=False)
+        elif kind == "kv_meta" and view.name == rec["src"]:
+            if rec["phase"] == "aborted":
+                return
+            rec["meta"] = ev[2]
+            rec["phase"] = "transfer"
+            if req is None or req.done or \
+                    int(ev[2].get("n_out", -1)) != len(req.output_tokens):
+                # token stream and export are out of phase — never
+                # commit a cache that disagrees with the stream
+                self._resolve_migration(
+                    rid, rec, "token/export phase mismatch")
+                return
+            dst = self._view_if_up(rec["dst"])
+            if dst is None:
+                self._resolve_migration(rid, rec, "destination gone")
+                return
+            try:
+                dst.client.import_kv(rid, ev[2])
+            except Exception as e:
+                self._resolve_migration(rid, rec, f"import_kv: {e!r}")
+        elif kind == "kv_block" and view.name == rec["src"]:
+            if rec["phase"] != "transfer":
+                return      # aborted mid-stream: swallow the tail
+            payload = ev[3]
+            rec["n_sent"] += 1
+            self.registry.counter("fleet/kv_migrate_blocks").inc()
+            self.registry.counter("fleet/kv_migrate_bytes").inc(
+                int(sum(getattr(s, "nbytes", 0) for s in payload)))
+            dst = self._view_if_up(rec["dst"])
+            if dst is None:
+                self._resolve_migration(rid, rec, "destination gone")
+                return
+            try:
+                dst.client.kv_block(rid, int(ev[2]), payload)
+            except Exception as e:
+                self._resolve_migration(rid, rec, f"kv_block: {e!r}")
+        elif kind == "kv_export_done" and view.name == rec["src"]:
+            if rec["phase"] == "aborted":
+                # the destination died while the source streamed; the
+                # export is complete source-side, so resolve NOW (the
+                # late un-pin path of the refcount story)
+                self._resolve_migration(
+                    rid, rec, "destination died mid-transfer")
+                return
+            n = int(ev[2])
+            meta = rec["meta"] or {}
+            if rec["phase"] != "transfer" or rec["n_sent"] != n or \
+                    int(meta.get("n_blocks", -1)) != n:
+                self._resolve_migration(rid, rec, "block count mismatch")
+                return
+            if req is None or req.done or self._stream_complete(req):
+                # the stream finished while its KV was in flight —
+                # deliver it, don't bounce it through an import that
+                # would refuse a zero budget
+                self._resolve_migration(rid, rec, "stream complete")
+                return
+            dst = self._view_if_up(rec["dst"])
+            if dst is None:
+                self._resolve_migration(rid, rec, "destination gone")
+                return
+            # the commit is a dispatch onto the decode replica: same
+            # wire item as failover replay (full stream as the prompt,
+            # remaining budget, step_offset rebased by the emitted
+            # prefix) — the imported KV just makes the re-prefill a
+            # one-token recompute instead of a full one
+            sampling = req.sampling
+            if sampling is not None and req.output_tokens:
+                sampling = dataclasses.replace(
+                    sampling, step_offset=sampling.step_offset
+                    + len(req.output_tokens))
+            wire_prompt = list(map(int, req.prompt)) + req.output_tokens
+            req.dispatches += 1
+            trace = None
+            if req.trace_id is not None:
+                trace = {"trace_id": req.trace_id,
+                         "attempt": req.dispatches}
+                timeline.emit("fleet_dispatch", rid=rid,
+                              trace_id=req.trace_id,
+                              attempt=req.dispatches,
+                              replica=dst.name, migrated=True,
+                              prior_tokens=len(req.output_tokens))
+            item = (rid, wire_prompt, req.remaining, req.eos_id,
+                    sampling, trace)
+            try:
+                dst.client.import_commit(rid, item, n)
+            except Exception as e:
+                self._resolve_migration(rid, rec, f"import_commit: {e!r}")
+                return
+            rec["phase"] = "commit"
+        elif kind == "kv_imported" and view.name == rec["dst"]:
+            ok, why = bool(ev[2]), ev[3]
+            if not ok or req is None or req.done:
+                self._resolve_migration(
+                    rid, rec, f"import refused: {why}")
+                return
+            # handoff complete: the request now lives on the decode
+            # replica; the source un-pins into its prefix cache
+            self._migrations.pop(rid, None)
+            raw_src = self._views.get(rec["src"])
+            if raw_src is not None:
+                # pop from the raw view even when it is down — a source
+                # that died AFTER flushing its export completes the
+                # handoff, and a stale assigned entry here would make
+                # the death-time _replay double-execute the request
+                raw_src.assigned.pop(rid, None)
+            src = self._view_if_up(rec["src"])
+            if src is not None:
+                try:
+                    src.client.kv_ack(rid, True)
+                except Exception:
+                    pass
+            req.replica = view.name
+            req.migrated_gap = True
+            view.assigned[rid] = req
+            self.registry.counter("fleet/kv_migrate_completed").inc()
+            self._slo_hist("fleet/kv_migrate_ms").observe(
+                (time.monotonic() - rec["t_start"]) * 1e3)
+
     # ------------------------------------------------------------ rollout
 
     def rollout(self, factory: Callable[[str], object], *,
@@ -1017,6 +1382,7 @@ class FleetRouter:
             rtt_hist = self._slo_hist(f"fleet/link_rtt_ms/{name}")
             replicas[name] = {
                 "ready": v.ready, "down": v.down,
+                "role": v.role,
                 "down_reason": v.down_reason,
                 "draining": v.draining, "rolling": v.rolling,
                 "assigned": len(v.assigned),
@@ -1039,6 +1405,12 @@ class FleetRouter:
                 "kv_occupancy": (v.state or {}).get("kv_occupancy"),
                 "prefix_cache_hits": (v.state or {}).get(
                     "prefix_cache_hits"),
+                # migration backlog, replica side (ISSUE 16): imports
+                # pending commit + exports pinned awaiting ack
+                "kv_pending_imports": (v.state or {}).get(
+                    "kv_pending_imports"),
+                "kv_exports_pinned": (v.state or {}).get(
+                    "kv_exports_pinned"),
                 "ckpt_step": (v.meta or {}).get("ckpt_step"),
             }
         states = collections.Counter(
@@ -1096,12 +1468,40 @@ class FleetRouter:
             return rows
 
         base = self.introspect()
+        # per-role SLO split + migration backlog (ISSUE 16): a
+        # saturated migration link shows up HERE (backlog climbing,
+        # decode-role tpot widening) before it becomes tail latency
+        roles: Dict[str, dict] = {}
+        for name, v in self._views.items():
+            row = roles.setdefault(v.role, {
+                "replicas": [], "assigned": 0, "backlog": 0,
+                "ttft_ms": hist_row(f"fleet/role/{v.role}/ttft_ms"),
+                "tpot_ms": hist_row(f"fleet/role/{v.role}/tpot_ms"),
+            })
+            row["replicas"].append(name)
+            if not v.down:
+                row["assigned"] += len(v.assigned)
+                row["backlog"] += v.backlog()
         return {
             "replicas": base["replicas"],
             "queue_depth": base["queue_depth"],
             "pending": base["pending"],
             "requests": base["requests"],
             "draining": base["draining"],
+            "roles": roles,
+            "migrations": {
+                "inflight": len(self._migrations),
+                "backlog": len(self._migrations) + sum(
+                    int((v.state or {}).get("kv_pending_imports") or 0)
+                    + int((v.state or {}).get("kv_exports_pinned") or 0)
+                    for v in self._views.values() if not v.down),
+                "started": counter("fleet/kv_migrate_started"),
+                "completed": counter("fleet/kv_migrate_completed"),
+                "failed": counter("fleet/kv_migrate_failed"),
+                "blocks": counter("fleet/kv_migrate_blocks"),
+                "bytes": counter("fleet/kv_migrate_bytes"),
+                "migrate_ms": hist_row("fleet/kv_migrate_ms"),
+            },
             "slo": {
                 "tenants": slo_rows("tenant", self._slo_tenants),
                 "priorities": slo_rows("priority",

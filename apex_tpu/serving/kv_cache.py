@@ -65,6 +65,9 @@ __all__ = [
     "OutOfBlocksError",
     "PrefixCache",
     "CACHE_OWNER",
+    "EXPORT_OWNER",
+    "KVExport",
+    "ExportLedger",
     "init_kv_arena",
     "arena_partition_spec",
     "scale_partition_spec",
@@ -74,6 +77,12 @@ __all__ = [
 # request id, so foreign-free checks see the cache as just another
 # owner — freeing a cached block with a request's id raises)
 CACHE_OWNER = "<prefix-cache>"
+
+# prefix of the composite owner a mid-migration export pin holds blocks
+# under: ``(EXPORT_OWNER, rid)`` — distinct from both the request id and
+# CACHE_OWNER, so the source request can finish (its own refs free) while
+# the exported run stays pinned until the decode side acks receipt
+EXPORT_OWNER = "<kv-export>"
 
 
 class OutOfBlocksError(RuntimeError):
@@ -294,6 +303,120 @@ class BlockAllocator:
         empties = [b for b, h in self._holders.items() if not h]
         if empties:
             raise AssertionError(f"held blocks with no holders: {empties}")
+
+
+@dataclasses.dataclass
+class KVExport:
+    """One migrating block run, pinned on the source until acked.
+
+    ``blocks`` is the prefix-order physical run covering ``cache_len``
+    tokens of ``tokens`` (the request's wire sequence at export time —
+    kept so an acked run can be indexed into the prefix cache under its
+    chain hash).  The pin holds every block under the composite owner
+    ``(EXPORT_OWNER, rid)``; the exporting request's own refs free
+    normally when it leaves the scheduler."""
+
+    rid: Any
+    blocks: List[int]
+    tokens: List[int]
+    cache_len: int
+
+    @property
+    def owner(self) -> Tuple[str, Any]:
+        return (EXPORT_OWNER, self.rid)
+
+
+class ExportLedger:
+    """Pin-until-ack bookkeeping for KV-block migration (ISSUE 16).
+
+    The refcount story of a migration, on the source replica:
+
+    1. :meth:`pin` — every block of the run gains the export owner
+       (refcount +1).  The exporting request then leaves the scheduler
+       and its own refs free normally; the run survives at refcount 1.
+    2. The blocks stream over the wire.  Nothing here can recycle them:
+       the pin is a first-class holder, so ``BlockAllocator.check()``
+       stays free-XOR-held at every step.
+    3. :meth:`release` on the decode side's ack — the run's *full*
+       blocks are indexed into the prefix cache (the cache increfs
+       before the pin decrefs, so no block ever transits through free),
+       turning the shipped prefill into evictable local capacity; the
+       partial tail block and, on a failed migration, every block just
+       free back to the pool.
+
+    A source that dies mid-migration leaks nothing *by construction*:
+    the ledger and pool die with the process, and the decode side either
+    committed (it owns its own imported copies) or degrades to
+    re-prefill through the router's replay path.  ``release`` is
+    idempotent — a duplicate or stale ack (router retry after a
+    reconnect) is a no-op, never a double free."""
+
+    def __init__(self, allocator: BlockAllocator,
+                 prefix_cache: Optional["PrefixCache"] = None):
+        self.allocator = allocator
+        self.prefix_cache = prefix_cache
+        self._pins: Dict[Any, KVExport] = {}
+
+    def __len__(self) -> int:
+        return len(self._pins)
+
+    def pin(self, rid: Any, blocks: Sequence[int],
+            tokens: Sequence[int], cache_len: int) -> KVExport:
+        """Pin ``blocks`` (the run covering ``cache_len`` tokens) under
+        the export owner.  One outstanding export per request id."""
+        if rid in self._pins:
+            raise ValueError(f"request {rid!r} already has an export "
+                             "in flight")
+        exp = KVExport(rid=rid, blocks=list(blocks),
+                       tokens=[int(t) for t in tokens],
+                       cache_len=int(cache_len))
+        pinned = []
+        try:
+            for b in exp.blocks:
+                self.allocator.share(b, exp.owner)
+                pinned.append(b)
+        except ValueError:
+            # roll the partial pin back before re-raising: the ledger
+            # never holds a half-pinned run
+            for b in pinned:
+                self.allocator.free([b], owner=exp.owner)
+            raise
+        self._pins[rid] = exp
+        return exp
+
+    def release(self, rid: Any, *, to_cache: bool = True) -> int:
+        """Drop the pin on ``rid``'s run.  ``to_cache=True`` (the ack
+        path) first indexes the run's full blocks into the prefix
+        cache, so the shipped prefill stays hittable locally; the
+        failed-migration path (``to_cache=False``) and the partial tail
+        block free straight back to the pool.  Returns the number of
+        blocks that went into the cache; unknown/duplicate ids are a
+        no-op (0)."""
+        exp = self._pins.pop(rid, None)
+        if exp is None:
+            return 0
+        cached = 0
+        if to_cache and self.prefix_cache is not None:
+            before = self.prefix_cache.n_blocks
+            self.prefix_cache.insert(exp.tokens, exp.blocks, exp.cache_len)
+            cached = self.prefix_cache.n_blocks - before
+        self.allocator.free(exp.blocks, owner=exp.owner)
+        return cached
+
+    def release_all(self, *, to_cache: bool = False) -> None:
+        """Drop every outstanding pin (drain/shutdown path)."""
+        for rid in list(self._pins):
+            self.release(rid, to_cache=to_cache)
+
+    def check(self) -> None:
+        """Every pinned block must be live and held by its export
+        owner (the ledger's half of the free-XOR-held invariant)."""
+        for exp in self._pins.values():
+            for b in exp.blocks:
+                holders = self.allocator._holders.get(b)
+                if not holders or exp.owner not in holders:
+                    raise AssertionError(
+                        f"export pin of {exp.rid!r} lost block {b}")
 
 
 class PrefixCache:

@@ -48,6 +48,52 @@ parent → child commands
                           through the engine's ``PreemptionGuard``
     ``("stop",)``       — immediate cooperative exit
 
+KV-block migration (ISSUE 16 — disaggregated prefill/decode).  The
+router relays a request's paged KV from a prefill replica to a decode
+replica; each side speaks a handful of extra commands/events, and every
+``kv_block`` payload rides its OWN frame on the socket transport so the
+session layer's per-frame seq makes the stream resumable at any block
+boundary:
+
+parent → child (source / prefill side)
+    ``("export_kv", frid)``      — extract the request's block run and
+                                   stream it up as events; the request
+                                   leaves this engine (its stream
+                                   continues on the decode side)
+    ``("kv_ack", frid, ok)``     — the migration's outcome: either way
+                                   the pinned run releases (full blocks
+                                   index into the local prefix cache —
+                                   valid KV regardless, and the failed
+                                   case's re-prefill then hits it)
+
+parent → child (destination / decode side)
+    ``("import_kv", frid, meta)``           — open a pending import
+    ``("kv_block", frid, idx, payload)``    — one block's slabs
+    ``("import_commit", frid, item, n)``    — all blocks sent: admit
+                                              ``item`` (a
+                                              ``wire_submit_item``
+                                              whose prompt is the full
+                                              stream so far) with the
+                                              imported KV; ONE batched
+                                              device scatter lands the
+                                              payload
+    ``("kv_abort", frid)``                  — drop a pending import
+
+child → parent (source side)
+    ``("kv_meta", frid, meta)``          — export opened; ``meta`` has
+                                           ``cache_len``/``n_blocks``/
+                                           ``n_out``/``bytes``/shape
+    ``("kv_block", frid, idx, payload)`` — one block, in order
+    ``("kv_export_done", frid, n)``      — run fully streamed
+    ``("kv_export_failed", frid, why)``  — not exportable (router lets
+                                           the request keep decoding
+                                           here)
+
+child → parent (destination side)
+    ``("kv_imported", frid, ok, why)``   — commit verdict; ``ok`` means
+                                           the request is RUNNING here
+                                           as if prefilled locally
+
 child → parent events
     ``("ready", meta)``        — engine built; ``meta`` has ``pid``,
                                  ``ckpt_step`` (None for seed init),
@@ -148,6 +194,13 @@ class ReplicaSpec:
     tp: int = 1
     ckpt_dir: Optional[str] = None
     seed: int = 0
+    # fleet role (ISSUE 16): "prefill" replicas take admission +
+    # chunked prefill and hand their KV off; "decode" replicas receive
+    # migrated KV and run the paged-decode step undisturbed; "both"
+    # (the default) is the pre-disaggregation behavior, byte-for-byte.
+    # The role is ROUTER policy — the engine underneath is identical;
+    # a "prefill" replica that never migrates still decodes correctly.
+    role: str = "both"               # "prefill" | "decode" | "both"
     heartbeat_every_s: float = 0.05  # state-event rate limit
     idle_sleep_s: float = 0.005      # loop sleep when no work is queued
     debug_server: bool = True        # /metrics /statusz /healthz
@@ -165,6 +218,12 @@ class ReplicaSpec:
     timeline_tick_every: int = 8     # decode_tick sampling (1 = every
     #                                  token: the trace smoke's precise
     #                                  hop boundaries)
+
+    def __post_init__(self):
+        if self.role not in ("prefill", "decode", "both"):
+            raise ValueError(
+                f"role must be 'prefill' | 'decode' | 'both', "
+                f"got {self.role!r}")
 
 
 def _build_engine(spec: ReplicaSpec, registry, guard):
@@ -227,12 +286,16 @@ def _replica_worker(spec: ReplicaSpec, name: str, cmd_q, evt_q,
         registry = MetricRegistry(rank=0, world=1)
         engine, ckpt_step = _build_engine(spec, registry, guard)
         if spec.warmup:
-            # one throwaway token: the jitted prefill + decode programs
+            # throwaway tokens: the jitted prefill + decode programs
             # compile HERE, inside the wait_ready window, so once this
             # replica reports ready its step time is steady state and
             # the router's missed-heartbeat detector sees no compile
-            # stall it could mistake for a wedge
-            engine.submit([1], 1)
+            # stall it could mistake for a wedge.  max_new=3, not 1:
+            # the first token comes out of PREFILL — a 1-token warmup
+            # never runs the decode program, deferring its compile to
+            # the first live request (exactly the stall this exists to
+            # prevent)
+            engine.submit([1], 3)
             for _ in range(64):
                 if engine.scheduler.idle:
                     break
@@ -256,10 +319,13 @@ def _replica_worker(spec: ReplicaSpec, name: str, cmd_q, evt_q,
             "max_seq": engine.cache.max_seq,
             "prefill_len": None,
             "debug_port": debug_port,
+            "role": spec.role,
         }))
 
         reqs = {}          # frid -> engine Request
         reported = {}      # frid -> tokens already relayed
+        exported = {}      # frid -> engine rid, pinned until kv_ack
+        imports = {}       # frid -> {"meta", "blocks": {idx: payload}}
         last_state = 0.0
 
         def flush() -> None:
@@ -293,7 +359,13 @@ def _replica_worker(spec: ReplicaSpec, name: str, cmd_q, evt_q,
 
         def heartbeat(now: float, force: bool = False) -> float:
             if force or now - last_state >= spec.heartbeat_every_s:
-                evt_q.put(("state", _state_snapshot(engine)))
+                snap = _state_snapshot(engine)
+                # migration backlog (ISSUE 16): pending imports not yet
+                # committed + exports pinned awaiting ack — the
+                # /fleet/statusz backlog signal
+                snap["kv_pending_imports"] = len(imports)
+                snap["kv_exports_pinned"] = len(exported)
+                evt_q.put(("state", snap))
                 return now
             return last_state
 
@@ -314,6 +386,66 @@ def _replica_worker(spec: ReplicaSpec, name: str, cmd_q, evt_q,
                     reqs[frid] = req
                     reported[frid] = 0
 
+        def export_one(frid) -> None:
+            """Source side of a migration: relay any still-unreported
+            tokens FIRST (so every token of the stream precedes its
+            kv_meta on the wire), then stream the block run up as
+            one-frame-per-block events.  The pinned run is released by
+            the router's later ``kv_ack``."""
+            req = reqs.get(frid)
+            if req is None or req.done:
+                evt_q.put(("kv_export_failed", frid, "not running here"))
+                return
+            for tok in req.output_tokens[reported[frid]:]:
+                evt_q.put(("token", frid, int(tok)))
+            reported[frid] = len(req.output_tokens)
+            try:
+                meta, payloads = engine.export_request(req)
+            except ValueError as e:
+                evt_q.put(("kv_export_failed", frid, repr(e)))
+                return
+            del reqs[frid], reported[frid]
+            exported[frid] = req.rid
+            evt_q.put(("kv_meta", frid, meta))
+            for idx, payload in enumerate(payloads):
+                evt_q.put(("kv_block", frid, idx, payload))
+            evt_q.put(("kv_export_done", frid, len(payloads)))
+
+        def import_commit(frid, item, n_blocks) -> None:
+            """Destination side: every block landed — admit the request
+            with the imported KV through ONE batched scatter.  Any
+            failure is a typed verdict; the router degrades to
+            re-prefill and this engine's arena is untouched."""
+            pending = imports.pop(frid, None)
+            if pending is None:
+                evt_q.put(("kv_imported", frid, False, "no pending import"))
+                return
+            blocks = pending["blocks"]
+            missing = [i for i in range(n_blocks) if i not in blocks]
+            if missing:
+                evt_q.put(("kv_imported", frid, False,
+                           f"missing blocks {missing[:4]}"))
+                return
+            _, prompt, max_new, eos, sampling, trace = \
+                wire_submit_item(item)
+            try:
+                import numpy as _np
+
+                req = engine.import_request(
+                    _np.asarray(prompt, _np.int32), max_new, eos,
+                    sampling, trace,
+                    cache_len=int(pending["meta"]["cache_len"]),
+                    payloads=[blocks[i] for i in range(n_blocks)])
+            except ValueError as e:
+                evt_q.put(("kv_imported", frid, False, repr(e)))
+                return
+            if req.done:
+                evt_q.put(("kv_imported", frid, False, req.state.value))
+                return
+            reqs[frid] = req
+            reported[frid] = 0
+            evt_q.put(("kv_imported", frid, True, None))
+
         while not orphaned():
             try:
                 while True:
@@ -323,6 +455,22 @@ def _replica_worker(spec: ReplicaSpec, name: str, cmd_q, evt_q,
                     elif cmd[0] == "submit_many":
                         for item in cmd[1]:
                             admit_one(*item)
+                    elif cmd[0] == "export_kv":
+                        export_one(cmd[1])
+                    elif cmd[0] == "kv_ack":
+                        rid = exported.pop(cmd[1], None)
+                        if rid is not None:
+                            engine.release_export(rid, ok=bool(cmd[2]))
+                    elif cmd[0] == "import_kv":
+                        imports[cmd[1]] = {"meta": cmd[2], "blocks": {}}
+                    elif cmd[0] == "kv_block":
+                        pend = imports.get(cmd[1])
+                        if pend is not None:
+                            pend["blocks"][int(cmd[2])] = cmd[3]
+                    elif cmd[0] == "import_commit":
+                        import_commit(cmd[1], cmd[2], cmd[3])
+                    elif cmd[0] == "kv_abort":
+                        imports.pop(cmd[1], None)
                     elif cmd[0] == "drain":
                         guard.trigger()
                     elif cmd[0] == "stop":
@@ -455,6 +603,31 @@ class ReplicaProcess:
         through this."""
         self._cmd.put(("submit_many",
                        [wire_submit_item(it) for it in items]))
+
+    # ------------------------------------------------- KV migration cmds
+    # (ISSUE 16) Thin wire wrappers; the router drives the handoff state
+    # machine.  On the socket transport each of these is its own frame —
+    # which is what makes a torn migration resumable at block
+    # granularity via the session layer's per-frame seq.
+
+    def export_kv(self, frid) -> None:
+        self._cmd.put(("export_kv", frid))
+
+    def kv_ack(self, frid, ok: bool) -> None:
+        self._cmd.put(("kv_ack", frid, bool(ok)))
+
+    def import_kv(self, frid, meta: dict) -> None:
+        self._cmd.put(("import_kv", frid, meta))
+
+    def kv_block(self, frid, idx: int, payload) -> None:
+        self._cmd.put(("kv_block", frid, int(idx), payload))
+
+    def import_commit(self, frid, item, n_blocks: int) -> None:
+        self._cmd.put(("import_commit", frid, wire_submit_item(item),
+                       int(n_blocks)))
+
+    def kv_abort(self, frid) -> None:
+        self._cmd.put(("kv_abort", frid))
 
     def begin_drain(self, *, sigterm: bool = True) -> None:
         """Start the drain: a real SIGTERM (the production rollout

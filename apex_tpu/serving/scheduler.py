@@ -320,6 +320,94 @@ class Scheduler:
             admitted.append(req)
         return admitted
 
+    def admit_imported(self, prompt: Sequence[int], max_new_tokens: int,
+                       eos_id: Optional[int] = None,
+                       sampling: Optional[SamplingParams] = None, *,
+                       cache_len: int, n_blocks: int) -> Request:
+        """Admit a request whose KV for ``prompt[:cache_len]`` is about
+        to be *imported* (KV-block migration, ISSUE 16) instead of
+        computed here.
+
+        Allocates blocks covering the whole prefill target (the
+        imported run plus the remaining-tail blocks, so the chunked
+        prefill of the uncovered tokens never scatters out of range),
+        binds a slot immediately — the migrated payload is already
+        committed to this host, parking it behind the FIFO would strand
+        device memory — and returns the RUNNING request with
+        ``cache_len`` pre-seeded.  The engine scatters the payload into
+        ``req.blocks[:n_blocks]`` and the ordinary chunked-prefill path
+        covers ``prompt[cache_len:]`` (for a migration that is exactly
+        the last wire token — the same recompute-one-token shape as a
+        prefix-cache hit), which is what makes the continued stream
+        bitwise the failover-replay stream.  Raises ``ValueError`` /
+        :class:`~apex_tpu.serving.kv_cache.OutOfBlocksError` when slot
+        or pool capacity is missing (the caller degrades to
+        re-prefill); a drain window returns a REJECTED request, exactly
+        like :meth:`submit`."""
+        from apex_tpu.serving.kv_cache import OutOfBlocksError
+
+        prompt = np.asarray(prompt, np.int32)
+        if prompt.ndim != 1 or prompt.size == 0:
+            raise ValueError("prompt must be a non-empty 1-D token list")
+        if prompt.size >= self.cache.max_seq:
+            raise ValueError(
+                f"imported prompt of {prompt.size} tokens does not fit "
+                f"max_seq={self.cache.max_seq} with room to generate")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if not 0 < cache_len < prompt.size:
+            raise ValueError(
+                f"imported cache_len {cache_len} must cover part of the "
+                f"{prompt.size}-token prompt (>= 1 token recomputed)")
+        if n_blocks != self.cache.blocks_for(cache_len):
+            raise ValueError(
+                f"imported run of {n_blocks} blocks does not cover "
+                f"cache_len {cache_len} (block_size "
+                f"{self.cache.block_size})")
+        req = Request(rid=next(self._ids), prompt=prompt,
+                      max_new_tokens=max_new_tokens, eos_id=eos_id,
+                      sampling=sampling or SamplingParams(),
+                      t_submit=time.monotonic())
+        if self._worst_case_blocks(req) > self.allocator.n_blocks:
+            raise ValueError(
+                "imported request exceeds the whole pool worst-case")
+        if self.draining:
+            req.state = RequestState.REJECTED
+            return req
+        free = self.free_slots()
+        if not free:
+            raise ValueError("no free decode slot for the imported "
+                             "request")
+        if self.admission == "reserve":
+            need = self._worst_case_blocks(req)
+        else:
+            need = self.cache.blocks_for(prompt.size)
+        if not self._ensure_free(need):
+            raise OutOfBlocksError(
+                f"imported request needs {need} blocks, only "
+                f"{self.allocator.n_free} free after eviction")
+        req.blocks = self.allocator.alloc(need, owner=req.rid)
+        req.hit_blocks = 0
+        req.pc_blocks = 0
+        req.pc_hash = 0
+        req.cache_len = int(cache_len)
+        req.prefill_target = prompt.size
+        req.slot = free[0]
+        req.state = RequestState.RUNNING
+        req.admit_seq = next(self._admit_seq)
+        self.slots[req.slot] = req
+        # NB the imported run is NOT indexed into the prefix cache here:
+        # its content has not landed in the arena yet.  The engine calls
+        # :meth:`note_imported` after the batched scatter.
+        return req
+
+    def note_imported(self, req: Request) -> None:
+        """Index an imported request's landed run into the prefix cache
+        (called by the engine after the batched scatter — indexing
+        before the device put lands would let a same-tick hit share
+        garbage blocks)."""
+        self._index_into_cache(req)
+
     # ------------------------------------------------------------- growth
 
     def try_grow_to(self, req: Request, n_tokens: int, *,

@@ -325,6 +325,33 @@ class SocketTransport:
         the same PreemptionGuard drain a local SIGTERM would start)."""
         self._send_cmd(("drain",))
 
+    # ------------------------------------------------- KV migration cmds
+    # (ISSUE 16) Each call is ONE frame on the wire — a kv_block
+    # payload rides its own frame, so the outbox/ack machinery already
+    # gives the migration per-block resumability: a reconnect resends
+    # exactly the unacked tail, never restarts the stream.
+
+    def export_kv(self, frid) -> None:
+        self._send_cmd(("export_kv", frid))
+
+    def kv_ack(self, frid, ok: bool) -> None:
+        self._send_cmd(("kv_ack", frid, bool(ok)))
+
+    def import_kv(self, frid, meta: dict) -> None:
+        self._send_cmd(("import_kv", frid, meta))
+
+    def kv_block(self, frid, idx: int, payload) -> None:
+        self._send_cmd(("kv_block", frid, int(idx), payload))
+
+    def import_commit(self, frid, item, n_blocks: int) -> None:
+        from apex_tpu.serving.replica import wire_submit_item
+
+        self._send_cmd(("import_commit", frid, wire_submit_item(item),
+                        int(n_blocks)))
+
+    def kv_abort(self, frid) -> None:
+        self._send_cmd(("kv_abort", frid))
+
     # -------------------------------------------------------------- events
 
     def poll(self) -> list:

@@ -434,6 +434,16 @@ class ChaosProxy:
                 except OSError:
                     pass
                 continue
+            # both relay legs disable Nagle, same as the real transport
+            # endpoints: a store-and-forward proxy that batches small
+            # frames behind delayed ACKs would change the very timing
+            # the fault tests are probing
+            for s in (client, up):
+                try:
+                    s.setsockopt(socket.IPPROTO_TCP,
+                                 socket.TCP_NODELAY, 1)
+                except OSError:
+                    pass
             pair = _ProxyPair(client, up)
             with self._lock:
                 self._pairs.append(pair)

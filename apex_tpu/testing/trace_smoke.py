@@ -263,6 +263,136 @@ def main() -> int:
         log("trace_report.py output:\n"
             + cli.stdout.decode(errors="replace"))
 
+        # ---- phase D: the disaggregated 2-prefill/2-decode fleet ----
+        # (ISSUE 16) — the kv_migrate hop on REAL daemons: prefill
+        # replicas admit, KV runs stream to the decode side, and the
+        # merged traces must carry kv_migrate time while the books
+        # still close against the host stopwatch.
+        import dataclasses
+
+        trace_dir2 = os.path.join(workdir, "trace_disagg")
+        recorder2 = arm_process(trace_dir2, "router", "router")
+        roles = {"p0": "prefill", "p1": "prefill",
+                 "d0": "decode", "d1": "decode"}
+        t0 = time.monotonic()
+        started2 = {
+            n: start_replica_server(
+                dataclasses.replace(spec, role=role,
+                                    timeline_dir=trace_dir2),
+                n, addr_timeout_s=300)
+            for n, role in roles.items()}
+        procs = {n: p for n, (p, _) in started2.items()}
+        clients2 = [SocketTransport(n, addr, backoff_initial_s=0.05,
+                                    ping_every_s=0.05)
+                    for n, (_, addr) in started2.items()]
+        for c in clients2:
+            c.wait_ready(timeout=300)
+        log(f"2-prefill/2-decode fleet ready in "
+            f"{time.monotonic() - t0:.1f}s")
+        registry2 = MetricRegistry(rank=0, world=1)
+        router = FleetRouter(clients2, max_queue_depth=24,
+                             replica_queue_limit=3,
+                             heartbeat_timeout_s=2.0, probe_retries=2,
+                             probe_backoff_s=0.25, registry=registry2)
+        waves2 = [(rng.randint(1, VOCAB - 1,
+                               size=rng.randint(2, 8)).tolist(),
+                   int(rng.randint(12, 16))) for _ in range(4)]
+        stopwatch2 = {}
+        reqs2 = []
+        for prompt, n_new in waves2:
+            t_sub = time.monotonic()
+            req = router.submit(prompt, n_new, tenant="acme")
+            stopwatch2[req.rid] = [t_sub, None]
+            reqs2.append(req)
+        deadline = time.monotonic() + 120
+        while True:
+            router.pump()
+            now = time.monotonic()
+            for req in reqs2:
+                if req.done and stopwatch2[req.rid][1] is None:
+                    stopwatch2[req.rid][1] = now
+            if all(r.done for r in reqs2):
+                break
+            if now > deadline:
+                log("FAIL: disagg wave not terminal in 120s")
+                return 1
+            time.sleep(0.001)
+        if not all(r.state is RequestState.FINISHED for r in reqs2):
+            log(f"FAIL: disagg states {[r.state for r in reqs2]}")
+            return 1
+        # let the trailing kv_acks land before tearing the fleet down
+        t_settle = time.monotonic() + 5
+        while router._migrations and time.monotonic() < t_settle:
+            router.pump()
+            time.sleep(0.001)
+        snap2 = registry2.snapshot()
+        if snap2.get("fleet/kv_migrate_completed", 0.0) < 1:
+            log(f"FAIL: no completed migration (started "
+                f"{snap2.get('fleet/kv_migrate_started', 0.0)})")
+            return 1
+        if snap2.get("fleet/failovers", 0.0) != 0:
+            log("FAIL: disagg wave tripped a failover")
+            return 1
+        router.close()
+        router = None
+        for n, p in procs.items():
+            try:
+                p.terminate()
+            except Exception:
+                pass
+            reap_process(p, 20.0, what=f"disagg replica {n}")
+        procs = {}
+        timeline.disarm()
+        recorder2.flush()
+        report2 = merge_dir(trace_dir2, strict=True)
+        by_rid2 = {rec["rid"]: rec
+                   for rec in report2["traces"].values()}
+        migrated_traces = 0
+        for req in reqs2:
+            rec = by_rid2[req.rid]
+            if rec["state"] != "finished":
+                log(f"FAIL: disagg trace {rec['trace_id']} state "
+                    f"{rec['state']}")
+                return 1
+            if rec["overcommit_s"] != 0 or rec["unattributed_s"] != 0:
+                log(f"FAIL: disagg books not closed: {rec}")
+                return 1
+            hop_sum = sum(rec["hops"].values())
+            if abs(hop_sum - rec["wall_s"]) > 1e-5:
+                log(f"FAIL: disagg hop sum {hop_sum} != wall "
+                    f"{rec['wall_s']}")
+                return 1
+            t_sub, t_done = stopwatch2[req.rid]
+            watch = t_done - t_sub
+            if abs(hop_sum - watch) > 0.02 * watch + 0.015:
+                log(f"FAIL: disagg rid {req.rid} hop sum "
+                    f"{hop_sum:.4f}s vs stopwatch {watch:.4f}s "
+                    "exceeds 2%")
+                return 1
+            if rec["hops"]["kv_migrate"] > 0:
+                migrated_traces += 1
+                if len(rec["replicas"]) < 2:
+                    log(f"FAIL: migrated trace stayed on one "
+                        f"replica: {rec['replicas']}")
+                    return 1
+        if migrated_traces < 1:
+            log("FAIL: no merged trace carries kv_migrate time")
+            return 1
+        cli2 = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "scripts", "trace_report.py"),
+             trace_dir2],
+            capture_output=True, timeout=120)
+        if cli2.returncode != 0:
+            log(f"FAIL: trace_report.py (disagg) rc="
+                f"{cli2.returncode}: "
+                f"{cli2.stderr.decode(errors='replace')[-500:]}")
+            return 1
+        log(f"phase D OK: {len(reqs2)} requests through the "
+            f"2-prefill/2-decode fleet, {migrated_traces} traces "
+            "carrying kv_migrate time, hop sums within 2% of the "
+            "stopwatch, books closed")
+
         print("PASS", file=sys.stderr, flush=True)
         return 0
     finally:

@@ -83,6 +83,9 @@ TOLERANCES = {
     # on a shared CPU host: process scheduling noise dominates both
     # the absolute rate and the transport ratio
     "serving_fleet": 0.6,
+    # absolute decode p99 on a shared CPU host is scheduling-noise
+    # bound; the gated signal is the vs_colocated floor below
+    "serving_disagg": 0.6,
     # absolute wave rate on a shared CPU host is noisy; the gated
     # signal is the vs_bare ceiling above, not the rate
     "serving_trace_overhead": 0.6,
@@ -103,6 +106,10 @@ GATES = {
 # engine, even on CPU where the verify's FLOPs are not free.
 FLOORS = {
     ("serving_spec", "vs_baseline"): 1.0,
+    # ISSUE 16: disaggregating prefill from decode must protect the
+    # decode tail — co-located p99 / disaggregated p99 under the same
+    # prefill flood at equal pool size
+    ("serving_disagg", "vs_colocated"): 1.0,
 }
 
 

@@ -9,7 +9,19 @@ directory every fleet process spilled into (the router armed via
 host's monotonic clock through the spilled ``link_clock`` samples —
 and attributes every wall-clock millisecond of every request to
 exactly one hop bucket (router_queue / wire / replica_queue /
-admission_wait / prefill / decode / preempted / failover_replay).
+admission_wait / prefill / decode / preempted / failover_replay /
+kv_migrate).
+
+Hop glossary: router_queue = waiting in the router pool; wire =
+dispatch → replica submit plus the replica-finish → router-finish
+return leg; replica_queue = the engine's waiting deque;
+admission_wait = admitted but the packed prefill hasn't picked the
+slot up; prefill = chunked-prefill activity; decode = steady-state
+token generation; preempted = evicted-awaiting-readmit; failover_replay
+= death detection + probe ladder + requeue after a replica died;
+kv_migrate = the disaggregation handoff (ISSUE 16): KV export on the
+prefill replica + the per-block relay + the import commit, from
+``fleet_migrate_start`` to the dispatch onto the decode replica.
 
 Usage::
 

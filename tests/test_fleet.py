@@ -65,9 +65,12 @@ class FakeReplica:
     """In-memory replica: the client duck-type over a deterministic
     single-token-per-tick engine."""
 
+    BLOCK = 4          # fake KV block size (tokens per exported block)
+
     def __init__(self, name, *, free_blocks=100, max_batch=4,
                  die_after_tokens=None, fn=fake_fn, meta=None,
-                 kv_occupancy=0.0, prefix_cache_hits=0):
+                 kv_occupancy=0.0, prefix_cache_hits=0,
+                 fail_export=False, refuse_import=False):
         self.name = name
         self._fn = fn
         self.free_blocks = free_blocks
@@ -85,6 +88,15 @@ class FakeReplica:
         self.running = {}           # frid -> {"seq", "remaining", "eos"}
         self.submissions = []       # (frid, prompt, max_new, eos) log
         self.closed = False
+        # --- ISSUE 16 migration surface ---
+        self.fail_export = fail_export
+        self.refuse_import = refuse_import
+        self.exports = {}           # frid -> exported running-state (pinned)
+        self.export_acks = []       # (frid, ok) log
+        self.pending_imports = {}   # frid -> {"meta", "blocks": {idx: ...}}
+        self.imports_committed = 0
+        self.defer_import_verdict = False   # hold kv_imported until flush
+        self._deferred_verdicts = []
         self._emit_state()
 
     # --- client surface -------------------------------------------------
@@ -107,6 +119,86 @@ class FakeReplica:
             return
         self.waiting.append((frid, list(prompt), max_new_tokens, eos_id,
                              sampling))
+
+    # --- ISSUE 16 migration surface (prefill/decode disaggregation) ---
+
+    def export_kv(self, frid):
+        """Export a running request's fake KV: the block run is the token
+        prefix chunked ``BLOCK`` tokens per frame, each payload a tuple
+        of one uint8 ndarray (picklable across the real wire, and the
+        router's bytes-on-wire counter sees real ``nbytes``).  The
+        request leaves ``running`` silently — exactly the engine's
+        silent-removal contract — and stays pinned in ``exports`` until
+        the ``kv_ack``."""
+        import numpy as np
+
+        if not self._alive:
+            raise BrokenPipeError("dead replica")
+        r = self.running.get(frid)
+        if self.fail_export or r is None:
+            self._events.append(("kv_export_failed", frid,
+                                 "fake export refused"))
+            return
+        del self.running[frid]
+        self.exports[frid] = r
+        cache_len = len(r["seq"]) - 1        # all but the last wire token
+        n_blocks = max(1, -(-cache_len // self.BLOCK))
+        meta = {"n_out": r["emitted"], "cache_len": cache_len,
+                "n_blocks": n_blocks, "block_size": self.BLOCK,
+                "bytes": cache_len * 2}
+        self._events.append(("kv_meta", frid, meta))
+        for idx in range(n_blocks):
+            chunk = bytes(t % 256 for t in
+                          r["seq"][idx * self.BLOCK:(idx + 1) * self.BLOCK])
+            self._events.append(("kv_block", frid, idx,
+                                 (np.frombuffer(chunk, dtype=np.uint8),)))
+        self._events.append(("kv_export_done", frid, n_blocks))
+
+    def kv_ack(self, frid, ok):
+        if not self._alive:
+            raise BrokenPipeError("dead replica")
+        self.exports.pop(frid, None)
+        self.export_acks.append((frid, bool(ok)))
+
+    def import_kv(self, frid, meta):
+        if not self._alive:
+            raise BrokenPipeError("dead replica")
+        self.pending_imports[frid] = {"meta": meta, "blocks": {}}
+
+    def kv_block(self, frid, idx, payload):
+        if not self._alive:
+            raise BrokenPipeError("dead replica")
+        p = self.pending_imports.get(frid)
+        if p is not None:
+            p["blocks"][idx] = payload
+
+    def import_commit(self, frid, item, n_blocks):
+        if not self._alive:
+            raise BrokenPipeError("dead replica")
+        p = self.pending_imports.pop(frid, None)
+        if p is None or len(p["blocks"]) != n_blocks or self.draining \
+                or self.refuse_import:
+            verdict = ("kv_imported", frid, False, "fake import refused")
+        else:
+            rid, prompt, max_new, eos, sampling, trace = item
+            self.running[frid] = {"seq": list(prompt),
+                                  "remaining": max_new, "eos": eos,
+                                  "sampling": sampling, "emitted": 0}
+            self.imports_committed += 1
+            verdict = ("kv_imported", frid, True, None)
+        if self.defer_import_verdict:
+            self._deferred_verdicts.append(verdict)
+        else:
+            self._events.append(verdict)
+
+    def flush_import_verdicts(self):
+        self._events.extend(self._deferred_verdicts)
+        self._deferred_verdicts = []
+
+    def kv_abort(self, frid):
+        if not self._alive:
+            raise BrokenPipeError("dead replica")
+        self.pending_imports.pop(frid, None)
 
     def begin_drain(self, **kw):
         self.draining = True
@@ -132,6 +224,8 @@ class FakeReplica:
             "draining": self.draining,
             "kv_occupancy": self.kv_occupancy,
             "prefix_cache_hits": self.prefix_cache_hits,
+            "kv_pending_imports": len(self.pending_imports),
+            "kv_exports_pinned": len(self.exports),
         }))
 
     def _maybe_finish_drain(self):
@@ -989,3 +1083,295 @@ def test_introspect_duck_types_debug_server_engine():
     snap = router.introspect()
     assert snap["requests"].get("running", 0) == 1
     assert snap["draining"] is False
+
+
+# ---------------- ISSUE 16: disaggregated prefill/decode migration
+
+
+def _disagg_pair(**pkw):
+    """A 1-prefill / 1-decode fleet, the smallest disaggregated shape."""
+    p = FakeReplica("p", meta={"role": "prefill"}, **pkw)
+    d = FakeReplica("d", meta={"role": "decode"})
+    router = make_router([p, d])
+    router.pump()                  # drain ready events → roles known
+    return p, d, router
+
+
+def test_disagg_happy_path_token_identity_and_counters():
+    """The tentpole contract: a prefill-role replica takes admission,
+    the KV run streams to the decode replica block-by-block, and the
+    stitched stream is bitwise the single-replica stream.  The source
+    pin releases on the ack; every migration counter moves."""
+    p, d, router = _disagg_pair()
+    req = router.submit([9, 1, 4], 8)
+    drive(router, [p, d])
+    assert req.state is RequestState.FINISHED
+    assert req.output_tokens == reference([9, 1, 4], 8)
+    # admission landed on the prefill replica, the stream finished on
+    # the decode replica
+    assert p.submissions and p.submissions[0][0] == req.rid
+    assert req.replica == "d"
+    assert d.imports_committed == 1
+    assert not d.submissions          # handoff, not a replay dispatch
+    # refcount story, fake edition: pinned until ack, then released
+    assert p.exports == {} and p.export_acks == [(req.rid, True)]
+    snap = router.registry.snapshot()
+    assert snap.get("fleet/kv_migrate_started") == 1.0
+    assert snap.get("fleet/kv_migrate_completed") == 1.0
+    assert snap.get("fleet/kv_migrate_failed", 0.0) == 0.0
+    assert snap.get("fleet/kv_migrate_blocks", 0.0) >= 1.0
+    assert snap.get("fleet/kv_migrate_bytes", 0.0) >= 1.0
+    assert snap.get("fleet/failovers", 0.0) == 0.0
+    assert router._migrations == {}
+
+
+def test_disagg_seeded_stream_identity():
+    """Seeded sampling across the handoff: the wire item's rebased
+    ``step_offset`` keeps the decode replica's draw counter aligned, so
+    the migrated stream is bitwise the uninterrupted seeded stream."""
+    from apex_tpu.serving import SamplingParams
+
+    sp = SamplingParams(temperature=0.8, seed=5)
+    p, d, router = _disagg_pair()
+    req = router.submit([3, 5], 6, sampling=sp)
+    drive(router, [p, d])
+    assert req.state is RequestState.FINISHED
+    assert req.replica == "d"
+    assert req.output_tokens == seeded_reference([3, 5], 6, sp)
+    assert router.registry.snapshot().get(
+        "fleet/kv_migrate_completed") == 1.0
+
+
+def test_migrated_gap_excluded_from_role_tpot_only():
+    """The inter-token gap spanning the handoff is kv_migrate cost (it
+    has its own histogram), so the per-ROLE pool-health TPOT skips it
+    exactly once — while the fleet-wide and tenant-facing TPOT keep it,
+    because the stall is real user-visible latency."""
+    p, d, router = _disagg_pair()
+    req = router.submit([9, 1, 4], 8)
+    drive(router, [p, d])
+    assert req.state is RequestState.FINISHED
+    assert req.replica == "d"
+    assert router.registry.snapshot().get(
+        "fleet/kv_migrate_completed") == 1.0
+    # 8 tokens -> 7 inter-token gaps, all of them in the fleet-wide
+    # histogram (the handoff gap is not hidden from users)...
+    assert router.registry.histogram("fleet/tpot_ms").count == 7
+    # ...but exactly ONE gap — the handoff — is missing from the
+    # role-split histograms, and the flag is consumed (set-once)
+    role_gaps = (
+        router.registry.histogram("fleet/role/prefill/tpot_ms").count
+        + router.registry.histogram("fleet/role/decode/tpot_ms").count)
+    assert role_gaps == 6
+    assert req.migrated_gap is False
+
+
+def test_role_both_fleet_never_migrates():
+    """``role="both"`` everywhere (the default) is byte-for-byte the
+    PR 15 fleet: no export ever fires."""
+    a = FakeReplica("a")
+    b = FakeReplica("b")
+    router = make_router([a, b])
+    reqs = [router.submit([i, 2], 5) for i in (1, 3, 7)]
+    drive(router, [a, b])
+    for r in reqs:
+        assert r.state is RequestState.FINISHED
+        assert r.output_tokens == reference(list(r.prompt), 5)
+    snap = router.registry.snapshot()
+    assert snap.get("fleet/kv_migrate_started", 0.0) == 0.0
+    assert a.exports == {} and b.exports == {}
+    assert a.imports_committed == 0 and b.imports_committed == 0
+
+
+def test_migration_respects_min_remaining_budget():
+    """A nearly-done stream is not worth shipping: with fewer than
+    ``migrate_min_remaining`` tokens left the request finishes where it
+    prefilled, even on a prefill-role replica."""
+    p, d, router = _disagg_pair()
+    req = router.submit([5, 6], 2)     # after token 1: remaining == 1
+    drive(router, [p, d])
+    assert req.state is RequestState.FINISHED
+    assert req.output_tokens == reference([5, 6], 2)
+    assert req.replica == "p"
+    assert router.registry.snapshot().get(
+        "fleet/kv_migrate_started", 0.0) == 0.0
+
+
+def test_prefill_role_preferred_for_admission():
+    """Placement grows a role axis: fresh prompts land on prefill-
+    capable replicas; a decode specialist only takes admissions when
+    nothing else is up."""
+    p, d, router = _disagg_pair()
+    req = router.submit([5, 6], 2)
+    router.pump()
+    assert req.replica == "p" and not d.submissions
+    # sole-survivor fallback: decode-role still serves when it is all
+    # that is left (demotion is a preference, not an exclusion)
+    p.kill()
+    req2 = router.submit([7, 7], 2)
+    drive(router, [p, d])
+    assert req2.state is RequestState.FINISHED
+    assert req2.output_tokens == reference([7, 7], 2)
+    assert req2.replica == "d"
+
+
+def test_export_failed_keeps_decoding_on_source():
+    """``kv_export_failed`` means nothing left the source engine: the
+    request keeps decoding in place — no requeue, no token loss."""
+    p, d, router = _disagg_pair(fail_export=True)
+    req = router.submit([9, 1, 4], 8)
+    drive(router, [p, d])
+    assert req.state is RequestState.FINISHED
+    assert req.output_tokens == reference([9, 1, 4], 8)
+    assert req.replica == "p"
+    assert d.imports_committed == 0
+    snap = router.registry.snapshot()
+    assert snap.get("fleet/kv_migrate_started", 0.0) >= 1.0
+    assert snap.get("fleet/kv_migrate_failed", 0.0) >= 1.0
+    assert snap.get("fleet/kv_migrate_completed", 0.0) == 0.0
+    assert snap.get("fleet/failovers", 0.0) == 0.0
+
+
+def test_import_refused_degrades_to_replay_identity():
+    """Every refused commit walks the proven replay path: re-prefill on
+    the source, bitwise stream, and the source pin released (not ok)
+    each round — the handoff can fail forever without corrupting the
+    stream or leaking a block."""
+    p, d, router = _disagg_pair()
+    d.refuse_import = True
+    req = router.submit([9, 1, 4], 6)
+    drive(router, [p, d])
+    assert req.state is RequestState.FINISHED
+    assert req.output_tokens == reference([9, 1, 4], 6)
+    assert req.replica == "p"
+    assert d.imports_committed == 0
+    assert p.exports == {}            # every pin released...
+    assert p.export_acks and all(not ok for _, ok in p.export_acks)
+    snap = router.registry.snapshot()
+    assert snap.get("fleet/kv_migrate_failed", 0.0) >= 1.0
+    assert snap.get("fleet/kv_migrate_completed", 0.0) == 0.0
+
+
+def test_decode_replica_dies_mid_transfer_replays_on_source():
+    """Destination death while blocks are in flight: the record aborts,
+    the source un-pins, and the request re-prefills through the
+    ordinary replay machinery — bitwise identical."""
+    p, d, router = _disagg_pair()
+    req = router.submit([9, 1, 4], 8)
+    router.pump()                      # dispatch → p
+    p.tick()                           # first token
+    router.pump()                      # token seen; export_kv issued
+    assert router._migrations[req.rid]["phase"] == "export"
+    d.kill()                           # dies before the stream relays
+    drive(router, [p, d])
+    assert req.state is RequestState.FINISHED
+    assert req.output_tokens == reference([9, 1, 4], 8)
+    assert req.replica == "p"
+    # replay wire carried prompt + the emitted prefix (the PR 10 shape)
+    assert len(p.submissions) == 2
+    assert p.submissions[1][1] == [9, 1, 4] + req.output_tokens[:1]
+    assert p.exports == {} and p.export_acks == [(req.rid, False)]
+    snap = router.registry.snapshot()
+    assert snap.get("fleet/kv_migrate_failed", 0.0) >= 1.0
+    assert snap.get("fleet/kv_migrate_completed", 0.0) == 0.0
+
+
+def test_prefill_replica_dies_mid_export_replays_on_decode():
+    """Source death before the export frames flush: the ordinary
+    failover replay re-prefills the stream on the surviving decode
+    replica."""
+    p, d, router = _disagg_pair()
+    req = router.submit([9, 1, 4], 8)
+    router.pump()
+    p.tick()
+    router.pump()                      # export_kv issued, phase=export
+    assert router._migrations[req.rid]["phase"] == "export"
+    p.kill()
+    p._events.clear()                  # SIGKILL: unflushed frames lost
+    drive(router, [p, d])
+    assert req.state is RequestState.FINISHED
+    assert req.output_tokens == reference([9, 1, 4], 8)
+    assert req.replica == "d"
+    assert req.replays == 1
+    assert d.submissions[0][1] == [9, 1, 4] + req.output_tokens[:1]
+    snap = router.registry.snapshot()
+    assert snap.get("fleet/kv_migrate_failed", 0.0) >= 1.0
+    assert snap.get("fleet/kv_migrate_completed", 0.0) == 0.0
+
+
+def test_prefill_dies_after_export_flushed_completes_no_replay():
+    """Source death AFTER the export frames flushed: what reached the
+    wire is real, so the handoff completes on the decode replica — and
+    the death-time replay must NOT double-execute the request."""
+    p, d, router = _disagg_pair()
+    req = router.submit([9, 1, 4], 8)
+    router.pump()
+    p.tick()
+    router.pump()                      # export_kv issued, phase=export
+    p.kill()                           # frames already in the queue
+    drive(router, [p, d])
+    assert req.state is RequestState.FINISHED
+    assert req.output_tokens == reference([9, 1, 4], 8)
+    assert req.replica == "d"
+    assert req.replays == 0
+    assert not d.submissions           # handoff, never a replay dispatch
+    assert d.imports_committed == 1
+    snap = router.registry.snapshot()
+    assert snap.get("fleet/kv_migrate_completed") == 1.0
+    assert snap.get("fleet/kv_migrate_failed", 0.0) == 0.0
+
+
+def test_prefill_dies_at_commit_no_double_execution():
+    """The tightest race: the commit is already on the decode replica
+    when the source dies.  The request moves optimistically — it must
+    NOT also replay (double execution) — and the ``kv_imported``
+    verdict completes the handoff."""
+    p, d, router = _disagg_pair()
+    d.defer_import_verdict = True      # hold kv_imported in flight
+    req = router.submit([9, 1, 4], 8)
+    router.pump()
+    p.tick()
+    router.pump()                      # export_kv issued
+    router.pump()                      # meta/blocks/done → import_commit
+    assert router._migrations[req.rid]["phase"] == "commit"
+    p.kill()                           # dies with the verdict in flight
+    p._events.clear()
+    router.pump()                      # p down → optimistic move to d
+    d.defer_import_verdict = False
+    d.flush_import_verdicts()
+    drive(router, [p, d])
+    assert req.state is RequestState.FINISHED
+    assert req.output_tokens == reference([9, 1, 4], 8)
+    assert req.replica == "d"
+    assert d.imports_committed == 1
+    assert not d.submissions           # never replayed onto d
+    snap = router.registry.snapshot()
+    assert snap.get("fleet/kv_migrate_completed") == 1.0
+    assert snap.get("fleet/kv_migrate_failed", 0.0) == 0.0
+
+
+def test_statusz_splits_roles_and_reports_migration_backlog():
+    """/fleet/statusz grows the ISSUE 16 panes: per-role SLO split and
+    the migration block (counters + backlog depth)."""
+    p, d, router = _disagg_pair()
+    req = router.submit([9, 1, 4], 8)
+    drive(router, [p, d])
+    assert req.state is RequestState.FINISHED
+    body = router.fleet_statusz()
+    roles = body["roles"]
+    assert roles["prefill"]["replicas"] == ["p"]
+    assert roles["decode"]["replicas"] == ["d"]
+    # TTFT was observed on the prefill side, TPOT on the decode side
+    assert roles["prefill"]["ttft_ms"]["count"] >= 1
+    assert roles["decode"]["tpot_ms"]["count"] >= 1
+    mig = body["migrations"]
+    assert mig["started"] == 1 and mig["completed"] == 1
+    assert mig["failed"] == 0
+    assert mig["blocks"] >= 1 and mig["bytes"] >= 1
+    assert mig["inflight"] == 0 and mig["backlog"] == 0
+    assert mig["migrate_ms"]["count"] == 1
+    intro = router.introspect()["replicas"]
+    assert intro["p"]["role"] == "prefill"
+    assert intro["d"]["role"] == "decode"
+    assert intro["p"]["kv_exports_pinned"] == 0
+    assert intro["d"]["kv_pending_imports"] == 0

@@ -1106,3 +1106,177 @@ def test_engine_statusz_through_debug_server():
     assert "apex_serving_tokens_generated" in metrics
     assert "apex_serving_active_slots" in metrics
     eng.run_until_drained()
+
+
+# ------------- ISSUE 16: KV export/import (the disaggregation handoff)
+
+
+def _migrated_stream(sampling=None, spec=False, after=3, n_new=10):
+    """Prefill+decode ``after`` tokens on one engine, export/import the
+    paged KV into a second engine, finish there; returns the stitched
+    stream plus both engines for invariant checks."""
+    import dataclasses
+
+    kw = dict(max_batch=3, block_size=4, max_seq=MAX_SEQ,
+              prefill_len=MAX_SEQ)
+    if spec:
+        from apex_tpu.serving.speculative import SpeculativeConfig
+        kw["speculative"] = SpeculativeConfig(k=3)
+    _, _, src = _build_engine(1, serving=ServingConfig(
+        max_batch=3, block_size=4, max_seq=MAX_SEQ, prefill_len=MAX_SEQ))
+    _, _, dst = _build_engine(1, serving=ServingConfig(**kw))
+    prompt = np.arange(1, 9, dtype=np.int32)
+    req = src.submit(prompt, max_new_tokens=n_new, sampling=sampling)
+    while len(req.output_tokens) < after and not req.done:
+        src.step()
+    assert not req.done
+    pre = list(req.output_tokens)
+    meta, payloads = src.export_request(req)
+    # the export invariants the router's phase cross-check rests on
+    assert meta["n_out"] == len(pre)
+    assert meta["cache_len"] == len(prompt) + len(pre) - 1
+    assert meta["n_blocks"] == len(payloads) >= 1
+    wire = np.concatenate([prompt, np.asarray(pre, np.int32)])
+    s2 = sampling
+    if s2 is not None:
+        s2 = dataclasses.replace(
+            s2, step_offset=s2.step_offset + len(pre))
+    req2 = dst.import_request(wire, n_new - len(pre), sampling=s2,
+                              cache_len=int(meta["cache_len"]),
+                              payloads=payloads)
+    src.release_export(req.rid, ok=True)
+    for _ in range(120):
+        dst.step()
+        if req2.done:
+            break
+    assert req2.done
+    return pre + list(req2.output_tokens), src, dst
+
+
+def _single_stream(sampling=None, spec=False, n_new=10):
+    kw = dict(max_batch=3, block_size=4, max_seq=MAX_SEQ,
+              prefill_len=MAX_SEQ)
+    if spec:
+        from apex_tpu.serving.speculative import SpeculativeConfig
+        kw["speculative"] = SpeculativeConfig(k=3)
+    _, _, eng = _build_engine(1, serving=ServingConfig(**kw))
+    req = eng.submit(np.arange(1, 9, dtype=np.int32),
+                     max_new_tokens=n_new, sampling=sampling)
+    for _ in range(120):
+        eng.step()
+        if req.done:
+            break
+    assert req.done
+    return list(req.output_tokens)
+
+
+def test_export_import_greedy_bitwise_identity():
+    """The tentpole contract at the engine layer: a stream exported
+    after 3 tokens and imported into a fresh engine is bitwise the
+    single-engine stream — the imported KV plus a one-token re-prefill
+    reproduce the exact decode state."""
+    single = _single_stream()
+    migrated, src, dst = _migrated_stream()
+    assert migrated == single
+    # refcount story: the pin released into the prefix cache, every
+    # block in both pools is free XOR held
+    assert len(src.exports) == 0
+    src.scheduler.allocator.check()
+    dst.scheduler.allocator.check()
+
+
+def test_export_import_seeded_bitwise_identity():
+    """Seeded sampling across the handoff: the rebased ``step_offset``
+    keys the destination's draws at the absolute stream position, so
+    sampled streams are bitwise identical too."""
+    sp = SamplingParams(temperature=0.8, top_k=8, seed=7)
+    single = _single_stream(sampling=sp)
+    migrated, src, dst = _migrated_stream(sampling=sp)
+    assert migrated == single
+
+
+def test_export_import_speculative_decode_identity():
+    """The decode side of a disaggregated fleet runs k-speculative: an
+    imported request verified k+1 at a time still matches the plain
+    single-engine stream bitwise (speculation is exact)."""
+    single = _single_stream()                      # plain greedy engine
+    migrated, src, dst = _migrated_stream(spec=True)
+    assert migrated == single
+
+
+def test_export_refused_while_prefilling_or_unstarted():
+    """Export demands a quiescent decode-state request: no slot, a
+    pending prefill, or zero emitted tokens must refuse (ValueError)
+    rather than ship a cache that disagrees with the stream."""
+    _, _, eng = _build_engine(1, serving=ServingConfig(
+        max_batch=2, block_size=4, max_seq=MAX_SEQ, prefill_len=MAX_SEQ))
+    req = eng.submit([3, 5, 7], 4)
+    with pytest.raises(ValueError):
+        eng.export_request(req)        # nothing prefilled yet
+    eng.run_until_drained()
+    with pytest.raises(ValueError):
+        eng.export_request(req)        # finished: no slot anymore
+
+
+def test_import_shape_mismatch_refused_before_scatter():
+    """A payload whose shape disagrees with the arenas must refuse
+    BEFORE any device put — a torn/mismatched transfer can never
+    corrupt the destination cache."""
+    single = _single_stream(n_new=6)   # warm reference engine unused
+    _, _, src = _build_engine(1, serving=ServingConfig(
+        max_batch=3, block_size=4, max_seq=MAX_SEQ, prefill_len=MAX_SEQ))
+    _, _, dst = _build_engine(1, serving=ServingConfig(
+        max_batch=3, block_size=4, max_seq=MAX_SEQ, prefill_len=MAX_SEQ))
+    prompt = np.arange(1, 9, dtype=np.int32)
+    req = src.submit(prompt, max_new_tokens=6)
+    while len(req.output_tokens) < 2:
+        src.step()
+    meta, payloads = src.export_request(req)
+    torn = [tuple(p[:-1]) for p in payloads]       # one slab short
+    wire = np.concatenate(
+        [prompt, np.asarray(req.output_tokens, np.int32)])
+    with pytest.raises(ValueError):
+        dst.import_request(wire, 4, cache_len=int(meta["cache_len"]),
+                           payloads=torn)
+    src.release_export(req.rid, ok=False)
+    dst.scheduler.allocator.check()
+    src.scheduler.allocator.check()
+
+
+def test_export_churn_200_steps_leaks_no_blocks():
+    """The refcount-hardening satellite: 200 migrate/fail/retry churn
+    steps — export, then either abandon (the dies-before-ack shape,
+    released not-ok) or land it — and the allocator invariant stays
+    free-XOR-held on both pools; stale double-acks are no-ops."""
+    _, _, src = _build_engine(1, serving=ServingConfig(
+        max_batch=3, block_size=4, max_seq=MAX_SEQ, prefill_len=MAX_SEQ))
+    _, _, dst = _build_engine(1, serving=ServingConfig(
+        max_batch=3, block_size=4, max_seq=MAX_SEQ, prefill_len=MAX_SEQ))
+    prompt = np.arange(1, 9, dtype=np.int32)
+    for step in range(200):
+        req = src.submit(prompt, max_new_tokens=4)
+        while len(req.output_tokens) < 2 and not req.done:
+            src.step()
+        meta, payloads = src.export_request(req)
+        if step % 3 == 0:
+            # failed handoff: un-pin not-ok (re-prefill would follow)
+            src.release_export(req.rid, ok=False)
+            src.release_export(req.rid, ok=False)   # stale ack: no-op
+        else:
+            wire = np.concatenate(
+                [prompt, np.asarray(req.output_tokens, np.int32)])
+            req2 = dst.import_request(
+                wire, 4 - len(req.output_tokens),
+                cache_len=int(meta["cache_len"]), payloads=payloads)
+            src.release_export(req.rid, ok=True)
+            src.release_export(req.rid, ok=True)    # stale ack: no-op
+            while not req2.done:
+                dst.step()
+        if step % 20 == 0:
+            src.scheduler.allocator.check()
+            dst.scheduler.allocator.check()
+    assert len(src.exports) == 0
+    src.exports.check()
+    src.scheduler.allocator.check()
+    dst.scheduler.allocator.check()
+    assert src.introspect()["kv_exports_pinned"] == 0

@@ -439,6 +439,155 @@ def test_introspect_has_link_rtt_percentiles():
         router.close()
 
 
+# ------------------------------- ISSUE 16: the kv_migrate hop bucket
+
+
+def _build_migration_spills(tmp_path):
+    """Spills for the disaggregation handoff: prefill on r0, the KV
+    run streamed to r1, decode finishing there.  Router-clock story:
+
+      0.00 submit  0.02 dispatch#1(r0)  0.03 r0 submit  0.05 r0 admit
+      0.06 r0 chunk start .. 0.10 prefilled  0.30 last decode_tick
+      0.32 fleet_migrate_start  0.40 dispatch#2(r1, migrated)
+      0.42 r1 submit  0.43 r1 admit  0.44 chunk start .. 0.45
+      prefilled (the one-token re-prefill)  0.60 r1 finish
+      0.62 fleet_finish
+    """
+    tid = "00c0ffee"
+    router_t0 = 1000.0
+    _spill(tmp_path, "timeline.router.router.1.jsonl",
+           {"role": "router", "name": "router", "pid": 1,
+            "mono_t0": router_t0},
+           [
+               {"t": 0.00, "kind": "fleet_submit", "rid": 7,
+                "trace_id": tid, "tenant": "acme", "priority": 0,
+                "prompt_tokens": 3, "max_new_tokens": 8},
+               {"t": 0.02, "kind": "fleet_dispatch", "rid": 7,
+                "trace_id": tid, "attempt": 1, "replica": "r0",
+                "prior_tokens": 0},
+               {"t": 0.32, "kind": "fleet_migrate_start", "rid": 7,
+                "trace_id": tid, "attempt": 1, "src": "r0",
+                "dst": "r1", "prior_tokens": 3},
+               {"t": 0.40, "kind": "fleet_dispatch", "rid": 7,
+                "trace_id": tid, "attempt": 2, "replica": "r1",
+                "migrated": True, "prior_tokens": 3},
+               {"t": 0.62, "kind": "fleet_finish", "rid": 7,
+                "trace_id": tid, "tokens": 8},
+           ])
+    _spill(tmp_path, "timeline.replica.r0.2.jsonl",
+           {"role": "replica", "name": "r0", "pid": 2,
+            "mono_t0": router_t0},
+           [
+               {"t": 0.03, "kind": "request_submit", "rid": 0,
+                "trace_id": tid, "attempt": 1},
+               {"t": 0.05, "kind": "request_admit", "rid": 0,
+                "trace_id": tid, "attempt": 1},
+               {"t": 0.10, "kind": "prefill", "rids": [0],
+                "tokens": 3, "dur_s": 0.04},
+               {"t": 0.10, "kind": "request_prefilled", "rid": 0,
+                "trace_id": tid, "attempt": 1},
+               {"t": 0.30, "kind": "decode_tick", "rid": 0,
+                "trace_id": tid, "tokens": 3},
+               # the export itself is replica bookkeeping, not a walk
+               # milestone — it must not disturb the hop books
+               {"t": 0.33, "kind": "request_export", "rid": 0,
+                "trace_id": tid, "blocks": 1},
+           ])
+    _spill(tmp_path, "timeline.replica.r1.3.jsonl",
+           {"role": "replica", "name": "r1", "pid": 3,
+            "mono_t0": router_t0},
+           [
+               {"t": 0.42, "kind": "request_submit", "rid": 0,
+                "trace_id": tid, "attempt": 2},
+               {"t": 0.43, "kind": "request_admit", "rid": 0,
+                "trace_id": tid, "attempt": 2},
+               {"t": 0.45, "kind": "prefill", "rids": [0],
+                "tokens": 1, "dur_s": 0.01},
+               {"t": 0.45, "kind": "request_prefilled", "rid": 0,
+                "trace_id": tid, "attempt": 2},
+               {"t": 0.60, "kind": "request_finish", "rid": 0,
+                "trace_id": tid, "tokens": 8},
+           ])
+    return tid
+
+
+def test_migration_trace_attributes_kv_migrate_hop(tmp_path):
+    """The disaggregation handoff yields ONE merged trace spanning both
+    roles: migrate-start → dispatch-onto-decode lands in the
+    ``kv_migrate`` bucket, decode time on BOTH sides stays decode, and
+    the books still close exactly (every second in exactly one
+    bucket)."""
+    tid = _build_migration_spills(tmp_path)
+    report = merge_dir(str(tmp_path))
+    rec = report["traces"][tid]
+    assert rec["state"] == "finished"
+    assert rec["attempts"] == 2
+    assert rec["replicas"] == ["r0", "r1"]
+    assert rec["overcommit_s"] == 0.0
+    assert rec["unattributed_s"] == 0.0
+    assert rec["wall_s"] == pytest.approx(0.62, abs=1e-6)
+    want = {
+        "router_queue": 0.02,          # 0.00 -> 0.02
+        # dispatch->submit legs (0.02->0.03, 0.40->0.42) + the return
+        # leg (0.60 -> 0.62)
+        "wire": 0.01 + 0.02 + 0.02,
+        "replica_queue": 0.02 + 0.01,  # 0.03->0.05, 0.42->0.43
+        "admission_wait": 0.01 + 0.01,
+        "prefill": 0.04 + 0.01,        # full prefill + 1-token re-do
+        "decode": 0.22 + 0.15,         # prefilled -> migrate_start,
+        #                                prefilled -> finish
+        "preempted": 0.0,
+        "failover_replay": 0.0,        # a handoff is not a failure
+        "kv_migrate": 0.08,            # migrate_start -> dispatch#2
+    }
+    for bucket, val in want.items():
+        assert rec["hops"][bucket] == pytest.approx(val, abs=1e-6), \
+            bucket
+    assert sum(rec["hops"].values()) == pytest.approx(rec["wall_s"],
+                                                      abs=1e-5)
+    summary = report["summary"]
+    assert summary["states"] == {"finished": 1}
+    assert "kv_migrate" in summary["hop_totals_s"]
+
+
+def test_live_disagg_router_emits_and_closes_kv_migrate():
+    """A live disaggregated fleet (prefill + decode FakeReplicas) emits
+    the migrate-start hop event between the two dispatches, and the
+    router-only stitch closes the books with kv_migrate > 0."""
+    p = FakeReplica("p", meta={"role": "prefill"})
+    d = FakeReplica("d", meta={"role": "decode"})
+    router = make_router([p, d])
+    try:
+        router.pump()                  # roles known before arming
+        rec = timeline.arm(FlightRecorder(None))
+        req = router.submit([9, 1, 4], 8)
+        drive(router, [p, d])
+    finally:
+        timeline.disarm()
+        router.close()
+    assert req.state is RequestState.FINISHED
+    assert req.replica == "d"
+    assert req.output_tokens == reference([9, 1, 4], 8)
+    evs = [e for e in rec.events()
+           if e.get("trace_id") == req.trace_id]
+    assert [e["kind"] for e in evs] == [
+        "fleet_submit", "fleet_dispatch", "fleet_migrate_start",
+        "fleet_dispatch", "fleet_finish"]
+    mig = evs[2]
+    assert mig["src"] == "p" and mig["dst"] == "d"
+    assert evs[3]["migrated"] is True
+    assert evs[3]["replica"] == "d"
+    assert evs[3]["attempt"] == 2
+    traces = stitch_traces(rec.events(), {})
+    t = traces[req.trace_id]
+    assert t["state"] == "finished"
+    assert t["hops"]["kv_migrate"] > 0.0
+    assert t["overcommit_s"] == 0.0 and t["unattributed_s"] == 0.0
+    assert sum(t["hops"].values()) == pytest.approx(t["wall_s"],
+                                                    abs=1e-5)
+    assert t["replicas"] == ["p", "d"]
+
+
 # ------------------------------------------------- batched event relay
 
 

@@ -120,6 +120,18 @@ class ServedFake:
                         self.fake.submit(*item)
                 elif cmd[0] == "drain":
                     self.fake.begin_drain()
+                elif cmd[0] == "export_kv":
+                    self.fake.export_kv(cmd[1])
+                elif cmd[0] == "kv_ack":
+                    self.fake.kv_ack(cmd[1], cmd[2])
+                elif cmd[0] == "import_kv":
+                    self.fake.import_kv(cmd[1], cmd[2])
+                elif cmd[0] == "kv_block":
+                    self.fake.kv_block(cmd[1], cmd[2], cmd[3])
+                elif cmd[0] == "import_commit":
+                    self.fake.import_commit(cmd[1], cmd[2], cmd[3])
+                elif cmd[0] == "kv_abort":
+                    self.fake.kv_abort(cmd[1])
                 elif cmd[0] == "stop":
                     self._relay()
                     self._shutdown(bye=True)
@@ -168,16 +180,21 @@ def wait_states(router, *, tries=2000):
 
 
 def sock_drive(router, served, *, clock=None, step=0.05, max_iters=4000,
-               sleep_s=0.001):
+               sleep_s=0.001, tick_every=1):
     """Pump router + tick served fakes until idle; optionally advance
     an injected router clock per iteration (the failure-detection
-    ladder's deterministic driver)."""
-    for _ in range(max_iters):
+    ladder's deterministic driver).  ``tick_every`` throttles replica
+    ticks to one per N iterations: on a slowed link every tick's state
+    heartbeat costs a full proxy delay on the wire, so un-throttled
+    ticking floods the session ring ahead of the token events and
+    starves them behind hours of queued heartbeats."""
+    for i in range(max_iters):
         router.pump()
         if router.idle():
             return
-        for s in served:
-            s.tick()
+        if i % tick_every == 0:
+            for s in served:
+                s.tick()
         if clock is not None:
             clock[0] += step
         time.sleep(sleep_s)
@@ -269,15 +286,18 @@ def test_reconnect_churn_is_lossless_no_failover():
             router.pump()
             if router.idle():
                 break
-            if client._hello_done and served.server._active is not None:
-                # tick the replica only over a live session — BOTH
-                # ends' view: tokens generated into a severed link pile
-                # up in the server ring and the reconnect replays them
-                # as one burst that can blow past the next drop window
-                # entirely (the observed flake; the client alone is not
-                # enough — it learns of the cut ~20ms after the server
-                # does).  Gating makes each window deterministic
-                # without changing what is proven.
+            if client._hello_done and served.server._active is not None \
+                    and len(req.output_tokens) == served.fake.tokens_emitted:
+                # tick the replica only over a live session AND in
+                # lockstep with delivery — BOTH ends' view: tokens
+                # generated into a severed link pile up in the server
+                # ring and the reconnect replays them as one burst that
+                # can blow past the next drop window entirely (the
+                # observed flake; the client alone is not enough — it
+                # learns of the cut ~20ms after the server does).  The
+                # lockstep clause keeps at most one token in flight, so
+                # a single replay burst cannot finish the stream and
+                # each drop window must earn its own reconnect.
                 served.tick()
             if drops < 2 and len(req.output_tokens) >= 2 * (drops + 1):
                 proxy.drop_connections()   # ≥4 tokens still outstanding
@@ -539,7 +559,11 @@ def test_sole_slow_replica_still_serves():
             time.sleep(0.01)
         assert router._views["a"].link_degraded
         req = router.submit([7, 7], 2)
-        sock_drive(router, [served], max_iters=8000)
+        # throttle ticks well below the 10-frames/s drain the 0.1s link
+        # sustains, or per-tick state heartbeats flood the ring ahead
+        # of the token events (see sock_drive)
+        sock_drive(router, [served], max_iters=8000, sleep_s=0.02,
+                   tick_every=10)
         assert req.state is RequestState.FINISHED
         assert req.output_tokens == reference([7, 7], 2)
     finally:
@@ -867,3 +891,240 @@ def test_stalled_connection_drop_severs_at_frame_boundary():
         if sock is not None:
             sock.close()
         server.close(bye=False, timeout=1.0)
+
+
+# ------------- ISSUE 16: KV migration over the wire, under ChaosProxy
+
+
+def _disagg_served(proxy_on=None, **router_kw):
+    """1-prefill/1-decode fleet over real sockets; optionally a
+    ChaosProxy on one replica's link ("p" or "d").  Returns
+    (p, d, proxy, clients, router)."""
+    p = ServedFake("p", meta={"role": "prefill"}, free_blocks=1000)
+    d = ServedFake("d", meta={"role": "decode"}, free_blocks=1000)
+    proxy = None
+    endpoints = {"p": p, "d": d}
+    clients = []
+    for name, s in endpoints.items():
+        target = s
+        if proxy_on == name:
+            proxy = ChaosProxy(s.address)
+            target = proxy
+        c = make_client(target, name=name)
+        c.wait_ready(timeout=30)
+        clients.append(c)
+    router = make_router(clients, **router_kw)
+    wait_states(router, tries=4000)
+    return p, d, proxy, clients, router
+
+
+def test_socket_disagg_migration_identity_and_counters():
+    """The tentpole over the real wire, fault-free: greedy AND seeded
+    streams prefill on ``p``, migrate block-by-block through the framed
+    transport, finish on ``d`` — bitwise the uninterrupted streams."""
+    from apex_tpu.serving import SamplingParams
+
+    sp = SamplingParams(temperature=0.8, seed=5)
+    p, d, proxy, clients, router = _disagg_served()
+    try:
+        # long streams: over the real wire tokens surface in relay
+        # bursts, so the trigger can fire several tokens in — the
+        # budget must comfortably outlast it
+        r1 = router.submit([9, 1, 4], 16)
+        r2 = router.submit([3, 5], 12, sampling=sp)
+        sock_drive(router, [p, d], max_iters=8000)
+        assert r1.state is RequestState.FINISHED
+        assert r1.output_tokens == reference([9, 1, 4], 16)
+        from test_fleet import seeded_reference
+        assert r2.state is RequestState.FINISHED
+        assert r2.output_tokens == seeded_reference([3, 5], 12, sp)
+        assert r1.replica == "d" and r2.replica == "d"
+        assert d.fake.imports_committed == 2
+        for _ in range(200):               # let the kv_ack land on p
+            router.pump()
+            p.tick()
+            if p.fake.exports == {} and len(p.fake.export_acks) == 2:
+                break
+            time.sleep(0.001)
+        assert p.fake.exports == {}
+        assert sorted(ok for _, ok in p.fake.export_acks) == [True, True]
+        snap = router.registry.snapshot()
+        assert snap.get("fleet/kv_migrate_started") == 2.0
+        assert snap.get("fleet/kv_migrate_completed") == 2.0
+        assert snap.get("fleet/kv_migrate_failed", 0.0) == 0.0
+        assert snap.get("fleet/kv_migrate_blocks", 0.0) >= 2.0
+        assert snap.get("fleet/kv_migrate_bytes", 0.0) >= 2.0
+        assert snap.get("fleet/failovers", 0.0) == 0.0
+        body = router.fleet_statusz()
+        assert set(body["roles"]) == {"prefill", "decode"}
+        assert body["migrations"]["completed"] == 2
+    finally:
+        cleanup(router, [p, d], [proxy] if proxy else [])
+
+
+def test_migration_link_partition_degrades_to_replay():
+    """Partition on the decode replica's link mid-handoff: the import
+    verdict can never arrive, the probe ladder downs the destination,
+    and the request re-prefills on the source — bitwise intact."""
+    clock = [0.0]
+    p, d, proxy, clients, router = _disagg_served(
+        proxy_on="d", heartbeat_timeout_s=0.5, probe_retries=2,
+        probe_backoff_s=0.1, clock=lambda: clock[0])
+    try:
+        req = router.submit([9, 1, 4], 16)
+        cut = False
+        for _ in range(8000):
+            router.pump()
+            if router.idle():
+                break
+            for s in (p, d):
+                s.tick()
+            if not cut and req.output_tokens:
+                proxy.partition()          # silence before the verdict
+                cut = True
+            if cut:
+                clock[0] += 0.05           # drive the detection ladder
+            time.sleep(0.001)
+        assert cut, "partition never engaged mid-stream"
+        assert req.state is RequestState.FINISHED
+        assert req.output_tokens == reference([9, 1, 4], 16)
+        assert req.replica == "p"
+        assert router._views["d"].down
+        assert router._migrations == {}
+        snap = router.registry.snapshot()
+        assert snap.get("fleet/kv_migrate_started", 0.0) >= 1.0
+        assert snap.get("fleet/kv_migrate_completed", 0.0) == 0.0
+        assert snap.get("fleet/kv_migrate_failed", 0.0) >= 1.0
+        # the source's pin released into its prefix cache (not-ok ack)
+        assert p.fake.exports == {}
+    finally:
+        cleanup(router, [p, d], [proxy])
+
+
+def test_migration_survives_dst_reconnect_churn():
+    """Per-block resumability: the connection to the decode replica is
+    severed while the block stream is in flight — the session outbox
+    resends exactly the unacked tail on reconnect, the import commits,
+    and no re-prefill ever fires."""
+    p, d, proxy, clients, router = _disagg_served(proxy_on="d")
+    c_d = clients[1]
+    try:
+        req = router.submit([9, 1, 4, 2, 6, 8, 1, 3], 16)
+        dropped = False
+        for _ in range(8000):
+            router.pump()
+            if router.idle():
+                break
+            if not dropped and router._migrations:
+                proxy.drop_connections()   # blocks mid-flight
+                dropped = True
+            for s in (p, d):
+                s.tick()
+            time.sleep(0.001)
+        assert dropped, "migration never started"
+        assert req.state is RequestState.FINISHED
+        assert req.output_tokens == reference([9, 1, 4, 2, 6, 8, 1, 3], 16)
+        assert req.replica == "d"
+        assert d.fake.imports_committed == 1
+        assert c_d.reconnects >= 1
+        snap = router.registry.snapshot()
+        assert snap.get("fleet/kv_migrate_completed") == 1.0
+        assert snap.get("fleet/kv_migrate_failed", 0.0) == 0.0
+        assert snap.get("fleet/failovers", 0.0) == 0.0
+    finally:
+        cleanup(router, [p, d], [proxy])
+
+
+def test_migration_torn_frame_degrades_to_replay():
+    """A frame torn mid-migration on the source's event leg: the
+    decoder refuses the partial frame, the source verdicts, and the
+    stream recovers through the ordinary replay — never a corrupt
+    cache, never a divergent token."""
+    clock = [0.0]
+    p, d, proxy, clients, router = _disagg_served(
+        proxy_on="p", heartbeat_timeout_s=0.5, probe_retries=2,
+        probe_backoff_s=0.1, clock=lambda: clock[0])
+    try:
+        req = router.submit([9, 1, 4], 16)
+        armed = False
+        for _ in range(8000):
+            router.pump()
+            if router.idle():
+                break
+            if not armed and router._migrations:
+                proxy.tear_next_frame()    # tears meta/block mid-export
+                armed = True
+            for s in (p, d):
+                s.tick()
+            if armed:
+                clock[0] += 0.05
+            time.sleep(0.001)
+        assert armed, "migration never started"
+        assert req.state is RequestState.FINISHED
+        assert req.output_tokens == reference([9, 1, 4], 16)
+        assert router._migrations == {}
+        snap = router.registry.snapshot()
+        started = snap.get("fleet/kv_migrate_started", 0.0)
+        done = snap.get("fleet/kv_migrate_completed", 0.0)
+        failed = snap.get("fleet/kv_migrate_failed", 0.0)
+        assert started >= 1.0 and started == done + failed
+    finally:
+        cleanup(router, [p, d], [proxy])
+
+
+def test_migration_slow_link_still_completes():
+    """A slowed (not dead) migration link: the handoff takes longer but
+    completes — block frames trickle through, the commit lands, and
+    the stream stays bitwise identical."""
+    p, d, proxy, clients, router = _disagg_served(proxy_on="d")
+    try:
+        proxy.slow(0.02)
+        req = router.submit([9, 1, 4], 16)
+        sock_drive(router, [p, d], max_iters=8000, sleep_s=0.02,
+                   tick_every=2)
+        assert req.state is RequestState.FINISHED
+        assert req.output_tokens == reference([9, 1, 4], 16)
+        assert req.replica == "d"
+        snap = router.registry.snapshot()
+        assert snap.get("fleet/kv_migrate_completed") == 1.0
+        assert snap.get("fleet/kv_migrate_failed", 0.0) == 0.0
+    finally:
+        cleanup(router, [p, d], [proxy])
+
+
+def test_migration_dst_sigkill_mid_migration_reprefills():
+    """Decode-replica SIGKILL with the handoff in flight: the crash
+    shape (no bye), the ladder downs it, and the request re-prefills on
+    the surviving prefill replica — the ISSUE 16 torn-transfer
+    contract: degrade to re-prefill, never a corrupt cache."""
+    clock = [0.0]
+    p, d, proxy, clients, router = _disagg_served(
+        heartbeat_timeout_s=0.5, probe_retries=2,
+        probe_backoff_s=0.1, clock=lambda: clock[0])
+    try:
+        req = router.submit([9, 1, 4], 16)
+        killed = False
+        for _ in range(8000):
+            router.pump()
+            if router.idle():
+                break
+            if not killed and router._migrations:
+                d.kill()                   # SIGKILL shape: no goodbye
+                killed = True
+            for s in (p, d):
+                s.tick()
+            if killed:
+                clock[0] += 0.05
+            time.sleep(0.001)
+        assert killed, "migration never started"
+        assert req.state is RequestState.FINISHED
+        assert req.output_tokens == reference([9, 1, 4], 16)
+        assert req.replica == "p"
+        assert router._views["d"].down
+        assert router._migrations == {}
+        snap = router.registry.snapshot()
+        assert snap.get("fleet/kv_migrate_completed", 0.0) == 0.0
+        assert snap.get("fleet/kv_migrate_failed", 0.0) >= 1.0
+        assert p.fake.exports == {}        # pin released on resolve
+    finally:
+        cleanup(router, [p, d], [])
