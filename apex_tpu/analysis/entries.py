@@ -304,20 +304,23 @@ def _reshard() -> List[Program]:
 
 @_entry("serving_decode")
 def _serving_decode() -> List[Program]:
-    """The ISSUE 9/12/13 serving runtime's decode step at tp=2 (the
+    """The ISSUE 9/12/13/17 serving runtime's decode step at tp=2 (the
     jit-stable continuous-batching shape — since ISSUE 13 the
     ``[max_batch, k + 1]`` speculative verify, with per-slot draft
     counts, eviction/preemption churn AND the sampling policies all
-    riding as ``[max_batch]`` data): the APX204 donation audit is the
-    point —
-    the paged KV arenas are the largest HBM tenant of a serving chip
-    and MUST alias in->out through the step (both leaves of the arenas
-    tuple, hence the exact floor of 2); a dropped ``donate_argnums`` or
-    an aliasing regression on the scatter+Pallas-read+sampling path
+    riding as ``[max_batch]`` data; since ISSUE 17 the LoRA-enabled
+    step, with per-slot adapter indices as data and the adapter A/B
+    gathers inside the same compiled program): the APX204 donation
+    audit is the point — the paged KV arenas AND the paged adapter
+    arena are the serving chip's resident HBM tenants and MUST alias
+    in->out through the step (2 KV leaves + 8 adapter leaves, hence
+    the exact floor of 10); a dropped ``donate_argnums`` or an
+    aliasing regression on the scatter+Pallas-read+sampling path
     doubles cache HBM silently.  APX201/202/203 run over the same tp
-    decode path (no ring / no sentinel: contracts default off), and the
-    jaxpr tier walks the shard_map body including the Pallas call
-    sites.  The chunked-prefill program rides along jaxpr-tier-only
+    decode path (no ring / no sentinel: contracts default off), and
+    the jaxpr tier (APX101/104 via lint_traced) walks the shard_map
+    body including the Pallas call sites and the new adapter-delta
+    kernels.  The chunked-prefill program rides along jaxpr-tier-only
     (its HLO contracts are structurally the decode step's; one XLA
     compile is enough for the tier-1 window)."""
     import jax
@@ -326,7 +329,7 @@ def _serving_decode() -> List[Program]:
 
     from apex_tpu import parallel
     from apex_tpu.serving import (
-        ServingConfig, ServingEngine, SpeculativeConfig)
+        LoRAConfig, ServingConfig, ServingEngine, SpeculativeConfig)
     from apex_tpu.transformer.testing import TransformerConfig
     from apex_tpu.transformer.testing.gpt_parallel_train import build_gpt_3d
 
@@ -342,30 +345,33 @@ def _serving_decode() -> List[Program]:
     eng = ServingEngine(
         cfg, ServingConfig(max_batch=2, block_size=4, max_seq=16,
                            prefill_len=16,
-                           speculative=SpeculativeConfig(k=2)),
+                           speculative=SpeculativeConfig(k=2),
+                           lora=LoRAConfig(rank=4, max_adapters=2)),
         params, mesh=mesh)
     b = eng.serving.max_batch
     S = eng.spec_width
     mb = eng.cache.max_blocks_per_request
+    adapter_slots = np.zeros((b,), np.int32)
     sampling = (np.zeros((b,), np.float32), np.zeros((b,), np.int32),
                 np.ones((b,), np.float32), np.zeros((b,), np.uint32),
                 np.zeros((b,), np.int32))
     decode_args = (
-        eng.arenas, eng.params,
+        eng.arenas, eng.adapters, eng.params,
         np.zeros((b, S), np.int32), np.zeros((b,), np.int32),
         jnp.zeros((b, mb), jnp.int32), np.zeros((b,), bool),
-        np.zeros((b,), np.int32)) + sampling
+        np.zeros((b,), np.int32), adapter_slots) + sampling
     T = eng.prefill_len
     prefill_args = (
-        eng.arenas, eng.params,
+        eng.arenas, eng.adapters, eng.params,
         np.zeros((b, T), np.int32), np.zeros((b, T), np.int32),
         jnp.zeros((b, mb), jnp.int32), np.zeros((b,), np.int32),
         np.zeros((b, T), np.int32), np.zeros((b, T), np.int32),
-        np.zeros((b, T), np.int32), np.full((b,), T, np.int32)) + sampling
+        np.zeros((b, T), np.int32), np.full((b,), T, np.int32),
+        adapter_slots) + sampling
     return [
         Program(name="serving_decode/decode_step",
                 fn=eng._decode, args=decode_args,
-                expect_donation=2),
+                expect_donation=10),
         Program(name="serving_decode/prefill",
                 fn=eng._prefill, args=prefill_args,
                 hlo_tier=False),

@@ -35,6 +35,13 @@ package turns those checkpoints into a *serving* runtime —
   draining on preemption via ``resilience.PreemptionGuard``.
 - :mod:`.loader` — restore-from-training-checkpoint through the PR 6
   ``ShardingSpec`` reshard layer (train on mesh N, serve on mesh M).
+- :mod:`.lora` — batched multi-LoRA serving (ISSUE 17): per-tenant
+  low-rank adapters in a refcounted paged *adapter arena* (the
+  BlockAllocator/LRU machinery applied to weights), gathered per batch
+  slot inside the one compiled decode/prefill step via the same
+  scalar-prefetch index-map trick the paged kernels use — N adapters
+  in one batch, zero recompiles, ``adapter_id=None`` bitwise the bare
+  engine.
 - :mod:`.replica` / :mod:`.fleet` — the fleet layer (ISSUE 11): N
   engine replicas as separate spawned processes (own mesh, own arenas,
   data-service process lifecycle) behind a host-side
@@ -70,6 +77,13 @@ from apex_tpu.serving.paged_attention import (
     paged_prefill_attention,
     paged_prefill_attention_unfused,
 )
+from apex_tpu.serving.lora import (
+    AdapterArena,
+    LoRAConfig,
+    OutOfAdapterSlotsError,
+    init_adapter_weights,
+    restore_adapter_for_serving,
+)
 from apex_tpu.serving.sampling import SamplingParams
 from apex_tpu.serving.scheduler import Request, RequestState, Scheduler
 from apex_tpu.serving.speculative import (
@@ -90,11 +104,14 @@ from apex_tpu.serving.transport import (
 )
 
 __all__ = [
+    "AdapterArena",
     "BlockAllocator",
     "FleetRequest",
     "FleetRouter",
     "KVCacheConfig",
+    "LoRAConfig",
     "NGramProposer",
+    "OutOfAdapterSlotsError",
     "OutOfBlocksError",
     "PrefixCache",
     "ReplicaProcess",
@@ -109,8 +126,10 @@ __all__ = [
     "SpeculativeConfig",
     "TransportError",
     "TransportServer",
+    "init_adapter_weights",
     "init_kv_arena",
     "replica_serve",
+    "restore_adapter_for_serving",
     "start_replica_server",
     "ngram_propose",
     "paged_attention_decode",

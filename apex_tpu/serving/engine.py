@@ -73,6 +73,7 @@ from __future__ import annotations
 
 import dataclasses
 import time
+import zlib
 from typing import Any, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -86,6 +87,14 @@ from apex_tpu.serving.kv_cache import (
     arena_partition_spec,
     init_kv_arena,
     scale_partition_spec,
+)
+from apex_tpu.serving.lora import (
+    AdapterArena,
+    LoRAConfig,
+    adapter_partition_specs,
+    init_adapter_arena,
+    init_adapter_weights,
+    pack_adapter_values,
 )
 from apex_tpu.serving.model import DecodeModel
 from apex_tpu.serving.sampling import SamplingParams
@@ -118,6 +127,11 @@ class ServingConfig:
     ``[max_batch, k + 1]`` self-speculative verify — ``k + 1`` pins the
     compiled decode shape (one compile; per-slot draft counts are
     data); ``None`` keeps the plain one-token step.
+    ``lora`` (a :class:`~apex_tpu.serving.lora.LoRAConfig`) enables
+    batched multi-LoRA serving: per-request adapters gathered from a
+    paged adapter arena inside the same compiled step — rank and slot
+    count pin the compile; which adapter each slot runs is data.
+    ``None`` keeps the engine byte-identical to the bare path.
     """
 
     max_batch: int = 8           # concurrent decode slots
@@ -131,6 +145,7 @@ class ServingConfig:
     admission: str = "occupancy"   # or "reserve" (PR 8 worst-case A/B)
     prefix_caching: bool = True    # share prompt-prefix blocks
     speculative: Optional[SpeculativeConfig] = None  # n-gram drafting
+    lora: Optional[LoRAConfig] = None  # multi-LoRA adapter arena
 
     def __post_init__(self):
         if self.admission not in ("occupancy", "reserve"):
@@ -216,7 +231,7 @@ class ServingEngine:
             n_blocks=serving.resolve_n_blocks(probe.max_blocks_per_request))
         self.model = DecodeModel(
             config, self.cache, fused_attention=serving.fused_attention,
-            fuse_epilogue=serving.fuse_epilogue)
+            fuse_epilogue=serving.fuse_epilogue, lora=serving.lora)
         self.prefill_len = serving.prefill_len or serving.max_seq
 
         # [vpp, pp, ...] -> [L, ...] (row-major merge == virtual-stage
@@ -246,23 +261,85 @@ class ServingEngine:
             s_spec = scale_partition_spec(tp_axis)
             arena_specs = (a_spec, a_spec, s_spec, s_spec)
 
+        # multi-LoRA (ISSUE 17): the adapter arrays are a second donated
+        # arena set threaded through both steps; each request's arena
+        # slot is [max_batch] data gathered in-kernel, so the adapter
+        # mix never pins a compile
+        self.lora = serving.lora
+        self.adapter_arena: Optional[AdapterArena] = None
+        self.adapters: Optional[Tuple[Any, ...]] = None
+        self._adapter_dtype = config.param_dtype
+        if self.lora is not None:
+            self.adapter_arena = AdapterArena(self.lora.n_slots)
+            self.adapters = init_adapter_arena(
+                config, self.lora, self.mesh, tp_axis)
+
         rep = P()
-        decode_body = cc.shard_over(
-            self.model.decode_step, mesh=self.mesh,
-            in_specs=(arena_specs, self.param_specs) + (rep,) * 10,
-            out_specs=(arena_specs, P(None, None), P(None),
-                       P(None, None, None)),
-        )
-        prefill_body = cc.shard_over(
-            self.model.prefill, mesh=self.mesh,
-            in_specs=(arena_specs, self.param_specs) + (rep,) * 13,
-            out_specs=(arena_specs, P(None), P(None, None, None)),
-        )
+        if self.lora is None:
+            decode_body = cc.shard_over(
+                self.model.decode_step, mesh=self.mesh,
+                in_specs=(arena_specs, self.param_specs) + (rep,) * 10,
+                out_specs=(arena_specs, P(None, None), P(None),
+                           P(None, None, None)),
+            )
+            prefill_body = cc.shard_over(
+                self.model.prefill, mesh=self.mesh,
+                in_specs=(arena_specs, self.param_specs) + (rep,) * 13,
+                out_specs=(arena_specs, P(None), P(None, None, None)),
+            )
+        else:
+            adapter_specs = adapter_partition_specs(tp_axis)
+            model = self.model
+
+            def decode_step_lora(arenas, adapters, params, tokens,
+                                 positions, block_tables, active, n_draft,
+                                 adapter_slots, temperature, top_k, top_p,
+                                 seeds, steps):
+                return model.decode_step(
+                    arenas, params, tokens, positions, block_tables,
+                    active, n_draft, temperature, top_k, top_p, seeds,
+                    steps, adapters=adapters,
+                    adapter_slots=adapter_slots)
+
+            def prefill_lora(arenas, adapters, params, tokens,
+                             position_ids, block_tables, lengths, limits,
+                             dest_blocks, dest_offsets, sample_index,
+                             adapter_slots, temperature, top_k, top_p,
+                             seeds, steps):
+                return model.prefill(
+                    arenas, params, tokens, position_ids, block_tables,
+                    lengths, limits, dest_blocks, dest_offsets,
+                    sample_index, temperature, top_k, top_p, seeds,
+                    steps, adapters=adapters,
+                    adapter_slots=adapter_slots)
+
+            decode_body = cc.shard_over(
+                decode_step_lora, mesh=self.mesh,
+                in_specs=(arena_specs, adapter_specs, self.param_specs)
+                + (rep,) * 11,
+                out_specs=(arena_specs, adapter_specs, P(None, None),
+                           P(None), P(None, None, None)),
+            )
+            prefill_body = cc.shard_over(
+                prefill_lora, mesh=self.mesh,
+                in_specs=(arena_specs, adapter_specs, self.param_specs)
+                + (rep,) * 14,
+                out_specs=(arena_specs, adapter_specs, P(None),
+                           P(None, None, None)),
+            )
         # the arenas are donated: the KV cache must alias in->out or the
         # biggest HBM tenant of the chip doubles (APX204, entry
-        # serving_decode)
-        self._decode = jax.jit(decode_body, donate_argnums=(0,))
-        self._prefill = jax.jit(prefill_body, donate_argnums=(0,))
+        # serving_decode); with LoRA the adapter arrays donate alongside
+        donated = (0,) if self.lora is None else (0, 1)
+        self._decode = jax.jit(decode_body, donate_argnums=donated)
+        self._prefill = jax.jit(prefill_body, donate_argnums=donated)
+        # adapter (un)load: one donated in-place row update per
+        # registration — the slot index is traced data, so churning
+        # adapters through the arena reuses one compiled scatter
+        self._adapter_set = jax.jit(
+            lambda ad, slot, vals: tuple(
+                a.at[:, slot].set(v) for a, v in zip(ad, vals)),
+            donate_argnums=(0,))
         # KV-block migration (ISSUE 16): one donated scatter lands a
         # whole imported run in the arenas per migration flush — one
         # device put per flush, never one per block
@@ -350,6 +427,16 @@ class ServingEngine:
         if trace is not None:
             req.trace_id = trace.get("trace_id")
             req.trace_attempt = int(trace.get("attempt", 0))
+        aid = getattr(sampling, "adapter_id", None) \
+            if sampling is not None else None
+        if (aid is not None and req.state is not RequestState.REJECTED
+                and (self.adapter_arena is None
+                     or not self.adapter_arena.resident(aid))):
+            # unknown adapter: refuse with the same typed terminal
+            # state as the drain window — never queued, never a hang;
+            # the router re-routes (another replica may hold it)
+            self.scheduler.waiting.remove(req)
+            req.state = RequestState.REJECTED
         timeline.emit("request_submit", rid=req.rid,
                       prompt_tokens=len(req.prompt),
                       max_new_tokens=max_new_tokens,
@@ -362,6 +449,12 @@ class ServingEngine:
             self.registry.counter("serving/requests_rejected").inc()
             timeline.emit("request_reject", rid=req.rid,
                           **trace_fields(req))
+        elif aid is not None:
+            # pinned for the request's whole life (queue wait included):
+            # its adapter can never be LRU-evicted out from under it
+            self.adapter_arena.pin(aid, req.rid)
+            self.registry.gauge("serving/adapter_active").set(
+                self.adapter_arena.active)
         return req
 
     # --------------------------------------------------------------- drain
@@ -375,6 +468,7 @@ class ServingEngine:
             self.registry.counter("serving/requests_cancelled").inc(
                 len(cancelled))
         for req in cancelled:
+            self._unpin_adapter(req)
             timeline.emit("request_cancel", rid=req.rid,
                           **trace_fields(req))
         self.registry.counter("serving/preemption_drains").inc()
@@ -424,9 +518,11 @@ class ServingEngine:
         self.exports.pin(req.rid, run, seq[:req.cache_len],
                          req.cache_len)
         # the request leaves this engine silently: the slot's table row
-        # zeroes and its own refs free (the export pin keeps the run)
+        # zeroes and its own refs free (the export pin keeps the run);
+        # the destination replica takes its own adapter pin
         self._tables[req.slot][:] = 0
         self.scheduler.finish(req)
+        self._unpin_adapter(req)
         self.registry.counter("serving/kv_export_blocks").inc(n_blocks)
         timeline.emit("request_export", rid=req.rid,
                       tokens=len(req.output_tokens), blocks=n_blocks,
@@ -494,6 +590,14 @@ class ServingEngine:
         capacity or a malformed payload — the caller reports a typed
         failure and the router degrades to re-prefill."""
         self._check_import_payloads(payloads)
+        aid = getattr(sampling, "adapter_id", None) \
+            if sampling is not None else None
+        if aid is not None and (self.adapter_arena is None
+                                or not self.adapter_arena.resident(aid)):
+            # checked BEFORE admission claims a slot: the typed failure
+            # relays as a failed import and the router degrades
+            raise ValueError(
+                f"adapter {aid!r} is not resident on this replica")
         req = self.scheduler.admit_imported(
             prompt, max_new_tokens, eos_id, sampling,
             cache_len=cache_len, n_blocks=len(payloads))
@@ -509,6 +613,10 @@ class ServingEngine:
             timeline.emit("request_reject", rid=req.rid,
                           **trace_fields(req))
             return req
+        if aid is not None:
+            self.adapter_arena.pin(aid, req.rid)
+            self.registry.gauge("serving/adapter_active").set(
+                self.adapter_arena.active)
         idx = self._jnp.asarray(
             np.asarray(req.blocks[:len(payloads)], np.int32))
         vals = tuple(
@@ -578,6 +686,70 @@ class ServingEngine:
                 return
             self.step()
         raise RuntimeError(f"not drained after {max_steps} steps")
+
+    # ------------------------------------------------------------ adapters
+
+    def register_adapter(self, adapter_id: str, weights=None, *,
+                         seed: Optional[int] = None) -> int:
+        """Load (or hot-swap) a LoRA adapter into the arena; returns
+        its slot.
+
+        ``weights`` is the ``{proj: (A [L, in, r], B [L, r, out])}``
+        dict — typically from :func:`~apex_tpu.serving.lora.
+        restore_adapter_for_serving` (the spec-layer restore path) or
+        :func:`~apex_tpu.serving.lora.init_adapter_weights`.  ``None``
+        builds a deterministic fixture seeded by ``seed`` (default: a
+        hash of the id, so the same id loads the same adapter on every
+        replica).  A resident id re-registers **in place** — the
+        hot-swap path: one donated row update, in-flight requests keep
+        decoding (the swap lands between ticks, never mid-step).  A new
+        id LRU-evicts the coldest unpinned adapter when the arena is
+        full; all-pinned raises
+        :class:`~apex_tpu.serving.lora.OutOfAdapterSlotsError`.
+        """
+        if self.adapter_arena is None:
+            raise RuntimeError(
+                "ServingConfig.lora is None; this engine serves the "
+                "bare checkpoint only")
+        if weights is None:
+            if seed is None:
+                seed = zlib.crc32(str(adapter_id).encode())
+            weights = init_adapter_weights(self.model.cfg, self.lora,
+                                           seed=int(seed))
+        vals = pack_adapter_values(self.model.cfg, self.lora, weights,
+                                   self._adapter_dtype)
+        slot, evicted = self.adapter_arena.register(adapter_id)
+        self.adapters = self._adapter_set(
+            self.adapters, np.int32(slot), vals)
+        self.registry.counter("serving/adapter_loads").inc()
+        if evicted is not None:
+            self.registry.counter("serving/adapter_evictions").inc()
+        self.registry.gauge("serving/adapter_active").set(
+            self.adapter_arena.active)
+        timeline.emit(
+            "adapter_load", adapter_id=str(adapter_id), slot=int(slot),
+            evicted=(str(evicted) if evicted is not None else None))
+        return int(slot)
+
+    def unregister_adapter(self, adapter_id: str) -> None:
+        """Drop an adapter from the registry: new submits naming it are
+        REJECTED; in-flight pinners keep their slot until they finish
+        (the rows are only reused after the last pin releases)."""
+        if self.adapter_arena is None:
+            raise RuntimeError(
+                "ServingConfig.lora is None; this engine serves the "
+                "bare checkpoint only")
+        slot = self.adapter_arena.unregister(adapter_id)
+        timeline.emit("adapter_unload", adapter_id=str(adapter_id),
+                      slot=int(slot))
+
+    def _adapter_slot_array(self) -> np.ndarray:
+        """Each slot's arena row for this tick ([max_batch] DATA; idle
+        and ``adapter_id=None`` slots gather the zero adapter)."""
+        slots = np.zeros((self.serving.max_batch,), np.int32)
+        for req in self.scheduler.running():
+            slots[req.slot] = self.adapter_arena.pinned_slot(req.rid)
+        return slots
 
     # ------------------------------------------------------------- prefill
 
@@ -659,10 +831,18 @@ class ServingEngine:
 
         with timeline.scope("prefill", rids=[r.rid for r, _ in plan],
                             tokens=int(sum(c for _, c in plan))):
-            self.arenas, next_tokens, _ = self._prefill(
-                self.arenas, self.params, tokens, pos_ids,
-                self._jnp.asarray(self._tables), lengths, limits,
-                dest_b, dest_o, sample_index, *samp)
+            if self.adapter_arena is None:
+                self.arenas, next_tokens, _ = self._prefill(
+                    self.arenas, self.params, tokens, pos_ids,
+                    self._jnp.asarray(self._tables), lengths, limits,
+                    dest_b, dest_o, sample_index, *samp)
+            else:
+                self.arenas, self.adapters, next_tokens, _ = \
+                    self._prefill(
+                        self.arenas, self.adapters, self.params, tokens,
+                        pos_ids, self._jnp.asarray(self._tables),
+                        lengths, limits, dest_b, dest_o, sample_index,
+                        self._adapter_slot_array(), *samp)
             next_np = np.asarray(next_tokens)
 
         now = time.monotonic()
@@ -745,8 +925,13 @@ class ServingEngine:
         samp = self._sampling_arrays()
 
         tables = self._jnp.asarray(self._tables)
-        args = (self.arenas, self.params, tokens, positions, tables,
-                active, n_draft) + samp
+        if self.adapter_arena is None:
+            args = (self.arenas, self.params, tokens, positions, tables,
+                    active, n_draft) + samp
+        else:
+            args = (self.arenas, self.adapters, self.params, tokens,
+                    positions, tables, active, n_draft,
+                    self._adapter_slot_array()) + samp
         if not self._flops_probed:
             # One-time FLOPs probe for the MFU gauge: lowering traces
             # the decode body (no second XLA compile, no execution —
@@ -755,7 +940,11 @@ class ServingEngine:
             # call below consumes the donated arenas.
             self._probe_decode_flops(args)
         t0 = time.perf_counter()
-        self.arenas, out_tokens, accepted, _ = self._decode(*args)
+        if self.adapter_arena is None:
+            self.arenas, out_tokens, accepted, _ = self._decode(*args)
+        else:
+            self.arenas, self.adapters, out_tokens, accepted, _ = \
+                self._decode(*args)
         out_np = np.asarray(out_tokens)
         acc_np = np.asarray(accepted)
         self._last_decode_s = time.perf_counter() - t0
@@ -866,6 +1055,18 @@ class ServingEngine:
                 round(self.spec_accepted / self.spec_proposed, 4)
                 if self.spec_proposed else None),
             "decode_calls": self._decode_calls,
+            "adapters_resident": (
+                self.adapter_arena.residents()
+                if self.adapter_arena is not None else None),
+            "adapter_active": (self.adapter_arena.active
+                               if self.adapter_arena is not None
+                               else None),
+            "adapter_loads": (self.adapter_arena.loads
+                              if self.adapter_arena is not None
+                              else None),
+            "adapter_evictions": (self.adapter_arena.evictions
+                                  if self.adapter_arena is not None
+                                  else None),
             "cache_dtype": str(np.dtype(self.cache.dtype)),
             "last_decode_ms": (round(self._last_decode_s * 1e3, 3)
                                if self._last_decode_s is not None else None),
@@ -900,7 +1101,17 @@ class ServingEngine:
     def _finish(self, req: Request) -> None:
         self._tables[req.slot][:] = 0
         self.scheduler.finish(req)
+        self._unpin_adapter(req)
         self.registry.counter("serving/requests_finished").inc()
         timeline.emit("request_finish", rid=req.rid,
                       tokens=len(req.output_tokens),
                       **trace_fields(req))
+
+    def _unpin_adapter(self, req: Request) -> None:
+        """Release a terminal request's adapter pin (no-op for the
+        ``adapter_id=None`` majority — every terminal path calls this
+        unconditionally)."""
+        if self.adapter_arena is not None:
+            self.adapter_arena.unpin(req.rid)
+            self.registry.gauge("serving/adapter_active").set(
+                self.adapter_arena.active)

@@ -130,6 +130,23 @@ the PR 15 behavior.  Counters: ``fleet/kv_migrate_started`` /
 migration backlog ride :meth:`FleetRouter.fleet_statusz`; the
 ``fleet_migrate_start`` hop event opens the trace plane's
 ``kv_migrate`` bucket (closed by the dispatch-onto-decode).
+
+ISSUE 17 — batched multi-LoRA over the fleet.  ``adapter_id`` rides
+``SamplingParams`` (data on the existing wire — both transports,
+failover replay and preemption readmit carry it for free; replays of an
+adapter-tagged request redraw the identical stream by the same
+step-offset rebase argument).  :meth:`load_adapter` broadcasts an
+adapter's weights to every live replica and pump-waits the
+``adapter_loaded`` acks; :meth:`swap_adapter` is the zero-downtime
+hot-swap — the rollout's one-replica-at-a-time discipline (``rolling``
+dispatch gate, quiesce in-flight pinners, in-place slot overwrite, no
+process replacement, no recompile).  Placement grows an
+adapter-affinity tie-break beside prefix affinity (a replica whose
+heartbeat reports the adapter resident wins ties, standing down past
+the same occupancy cap), and the SLO plane grows a per-adapter axis:
+``fleet/adapter/<id>/ttft_ms|tpot_ms`` windowed percentiles +
+finished/rejected counts in :meth:`fleet_statusz`, plus
+``fleet/adapter_loads`` / ``fleet/adapter_swaps`` counters.
 """
 
 from __future__ import annotations
@@ -188,8 +205,11 @@ class FleetRequest:
     migrated_gap: bool = False
     # bounded SLO accounting keys, resolved ONCE at submit (the token
     # path is the router's hottest loop — it must not re-derive them
-    # per token): (tenant_key, priority_key), "(other)" past the cap
-    slo_keys: tuple = ("default", "0")
+    # per token): (tenant_key, priority_key, adapter_key-or-None),
+    # "(other)" past the cap.  The adapter key (ISSUE 17) comes off
+    # ``sampling.adapter_id``; None — the bare-engine majority — costs
+    # nothing on the token path (every adapter site is a None check).
+    slo_keys: tuple = ("default", "0", None)
 
     @property
     def done(self) -> bool:
@@ -374,6 +394,13 @@ class FleetRouter:
         self.slo_key_cap = slo_key_cap
         self._slo_tenants: set = set()
         self._slo_priorities: set = set()
+        # per-adapter SLO keys (ISSUE 17): same bounded-cap discipline —
+        # adapter ids are caller-supplied strings too
+        self._slo_adapters: set = set()
+        # adapter broadcast acks: (replica_name, adapter_id) ->
+        # (ok, info), filled by the adapter_loaded/_unloaded events the
+        # load_adapter/swap_adapter pump-waits consume
+        self._adapter_acks: Dict[tuple, tuple] = {}
         # KV migration (ISSUE 16): rid -> handoff record.  A request on
         # a role="prefill" replica becomes a migration candidate once
         # it has a first token AND at least migrate_min_remaining
@@ -483,18 +510,24 @@ class FleetRouter:
     def _slo_keys(self, req: FleetRequest) -> tuple:
         """Resolve (and cache on the request) its bounded accounting
         keys — called once at submit; every later site reads the
-        cached pair."""
+        cached triple."""
+        aid = getattr(req.sampling, "adapter_id", None) \
+            if req.sampling is not None else None
         req.slo_keys = (
             self._slo_key(self._slo_tenants, req.tenant),
-            self._slo_key(self._slo_priorities, req.priority))
+            self._slo_key(self._slo_priorities, req.priority),
+            (self._slo_key(self._slo_adapters, aid)
+             if aid is not None else None))
         return req.slo_keys
 
     def _reject(self, req: FleetRequest) -> None:
         req.state = RequestState.REJECTED
         self.registry.counter("serving/requests_rejected").inc()
-        tkey, pkey = req.slo_keys
+        tkey, pkey, akey = req.slo_keys
         self.registry.counter(f"fleet/tenant/{tkey}/rejected").inc()
         self.registry.counter(f"fleet/priority/{pkey}/rejected").inc()
+        if akey is not None:
+            self.registry.counter(f"fleet/adapter/{akey}/rejected").inc()
         if req.trace_id is not None:
             timeline.emit("fleet_reject", rid=req.rid,
                           trace_id=req.trace_id)
@@ -628,7 +661,7 @@ class FleetRouter:
             if req is None or req.done:
                 return
             now = time.monotonic()
-            tkey, pkey = req.slo_keys
+            tkey, pkey, akey = req.slo_keys
             if req.t_first_token is None:
                 req.t_first_token = now
                 ttft_ms = (now - req.t_submit) * 1e3
@@ -646,6 +679,11 @@ class FleetRouter:
                 # answer "is the decode pool's p99 clean" directly
                 self._slo_hist(
                     f"fleet/role/{view.role}/ttft_ms").observe(ttft_ms)
+                if akey is not None:
+                    # per-adapter SLO window (ISSUE 17): whose tenant-
+                    # model's p99 blew up, not just whose tenant's
+                    self._slo_hist(
+                        f"fleet/adapter/{akey}/ttft_ms").observe(ttft_ms)
             else:
                 tpot_ms = (now - req.t_last_token) * 1e3
                 self.registry.histogram(
@@ -654,6 +692,9 @@ class FleetRouter:
                     f"fleet/tenant/{tkey}/tpot_ms").observe(tpot_ms)
                 self._slo_hist(
                     f"fleet/priority/{pkey}/tpot_ms").observe(tpot_ms)
+                if akey is not None:
+                    self._slo_hist(
+                        f"fleet/adapter/{akey}/tpot_ms").observe(tpot_ms)
                 if req.migrated_gap:
                     # the gap spanning the handoff is kv_migrate cost,
                     # not the decode pool's steady-state TPOT
@@ -685,6 +726,18 @@ class FleetRouter:
         elif kind == "drained":
             view.drained = True
             view.draining = True
+        elif kind in ("adapter_loaded", "adapter_unloaded"):
+            # (ISSUE 17) broadcast/hot-swap verdict: recorded for the
+            # load_adapter/swap_adapter pump-waits; failures are loud
+            # (a replica that cannot host the adapter would REJECT every
+            # request routed there naming it)
+            _, aid, ok, info = ev
+            self._adapter_acks[(view.name, aid)] = (bool(ok), info)
+            if kind == "adapter_loaded":
+                self.registry.counter("fleet/adapter_loads").inc()
+            if not ok:
+                logger.warning("fleet: replica %s %s %r failed: %r",
+                               view.name, kind, aid, info)
         elif kind in ("kv_meta", "kv_block", "kv_export_done",
                       "kv_export_failed", "kv_imported"):
             self._handle_migration_event(view, ev)
@@ -699,9 +752,11 @@ class FleetRouter:
         if view is not None:
             view.assigned.pop(req.rid, None)
         self.registry.counter("fleet/requests_finished").inc()
-        tkey, pkey = req.slo_keys
+        tkey, pkey, akey = req.slo_keys
         self.registry.counter(f"fleet/tenant/{tkey}/finished").inc()
         self.registry.counter(f"fleet/priority/{pkey}/finished").inc()
+        if akey is not None:
+            self.registry.counter(f"fleet/adapter/{akey}/finished").inc()
         if req.trace_id is not None:
             timeline.emit("fleet_finish", rid=req.rid,
                           trace_id=req.trace_id,
@@ -848,7 +903,8 @@ class FleetRouter:
         return min(keys, key=lambda k: (
             self._tenant_pass.get(k[1], 0.0), k[1]))
 
-    def _pick_replica(self, tenant: Optional[str] = None
+    def _pick_replica(self, tenant: Optional[str] = None,
+                      adapter_id: Optional[str] = None
                       ) -> Optional[_ReplicaView]:
         candidates = [v for v in self._views.values()
                       if v.dispatchable()
@@ -882,12 +938,24 @@ class FleetRouter:
             occ = float(state.get("kv_occupancy") or 0.0)
             affine = (v.name == warm
                       and occ < self.affinity_occupancy_cap)
+            # Adapter affinity (ISSUE 17): a replica whose heartbeat
+            # says the request's adapter is already RESIDENT wins ties
+            # — landing there costs zero adapter loads/evictions, while
+            # a cold replica would churn its arena.  Same discipline as
+            # prefix affinity: a tie-break only (free blocks and queue
+            # depth dominate), standing down past the same occupancy
+            # cap so affinity never forces an overloaded pool.
+            resident = (state.get("adapters_resident") or ())
+            adapter_affine = (adapter_id is not None
+                              and adapter_id in resident
+                              and occ < self.affinity_occupancy_cap)
             # link degradation leads the key (ISSUE 14): a slow link is
             # DEMOTED — any healthy-link candidate wins regardless of
             # pool shape — but never excluded, so a fleet whose every
             # link degraded still serves instead of starving
             return (1 if v.link_degraded else 0, -free,
-                    len(v.assigned), 0 if affine else 1, v.name)
+                    len(v.assigned), 0 if affine else 1,
+                    0 if adapter_affine else 1, v.name)
 
         return min(candidates, key=score)
 
@@ -908,7 +976,13 @@ class FleetRouter:
             key = self._pick_tenant(priorities[0])
             if key is None:
                 break
-            view = self._pick_replica(key[1])
+            # peek the queue head's adapter (ISSUE 17) so placement can
+            # prefer a replica already holding it resident — the head
+            # is exactly the request popped below
+            head = self._pending[key][0]
+            head_aid = getattr(head.sampling, "adapter_id", None) \
+                if head.sampling is not None else None
+            view = self._pick_replica(key[1], head_aid)
             if view is None:
                 break  # no capacity anywhere: stays in the router pool
             req = self._pending[key].popleft()
@@ -939,7 +1013,7 @@ class FleetRouter:
             if req.dispatches == 1 and req.t_first_token is None:
                 # router-side queue wait, observed once per request
                 wait_ms = (time.monotonic() - req.t_submit) * 1e3
-                tkey, pkey = req.slo_keys
+                tkey, pkey = req.slo_keys[:2]
                 self._slo_hist(
                     f"fleet/tenant/{tkey}/queue_wait_ms").observe(
                         wait_ms)
@@ -1371,6 +1445,157 @@ class FleetRouter:
             rolled.append(name)
         return rolled
 
+    # ------------------------------------------------- adapters (ISSUE 17)
+
+    def _await_adapter_acks(self, pairs: Sequence[tuple], *,
+                            timeout_s: float, poll_s: float = 0.002,
+                            on_tick: Optional[Callable[[], None]] = None
+                            ) -> Dict[str, tuple]:
+        """Pump until every ``(replica_name, adapter_id)`` pair has an
+        ack (or the deadline passes); a replica that dies mid-wait
+        reads as a failed ack, never a hang."""
+        deadline = self._clock() + timeout_s
+        while any(p not in self._adapter_acks for p in pairs):
+            self.pump()
+            if on_tick is not None:
+                on_tick()
+            if all(self._view_if_up(p[0]) is None or
+                   p in self._adapter_acks for p in pairs):
+                break
+            if self._clock() > deadline:
+                break
+            time.sleep(poll_s)
+        out = {}
+        for name, aid in pairs:
+            out[name] = self._adapter_acks.pop(
+                (name, aid), (False, "no ack (replica down or timeout)"))
+        return out
+
+    def load_adapter(self, adapter_id, *, weights=None, seed=None,
+                     names: Optional[Sequence[str]] = None,
+                     timeout_s: float = 60.0,
+                     on_tick: Optional[Callable[[], None]] = None
+                     ) -> Dict[str, tuple]:
+        """Register (or hot-swap) a LoRA adapter across the fleet: the
+        ``load_adapter`` wire command broadcast to every live replica
+        (or ``names``), then a pump-wait on the ``adapter_loaded``
+        acks.  Returns ``{replica_name: (ok, info)}`` — ``info`` is
+        ``{"slot", "evicted"}`` on success, the repr'd refusal
+        otherwise.  Failover replay depends on this being a broadcast:
+        an adapter-tagged request can only replay onto a survivor that
+        has the adapter resident."""
+        payload: dict = {}
+        if weights is not None:
+            payload["weights"] = weights
+        if seed is not None:
+            payload["seed"] = seed
+        results: Dict[str, tuple] = {}
+        pairs = []
+        for name in list(names if names is not None else self._views):
+            view = self._view_if_up(name)
+            if view is None:
+                results[name] = (False, "replica down")
+                continue
+            send = getattr(view.client, "load_adapter", None)
+            if send is None:
+                results[name] = (False, "transport has no load_adapter")
+                continue
+            try:
+                send(adapter_id, payload)
+            except Exception as e:    # dead pipe on write
+                logger.warning("fleet: load_adapter to %s failed: %r",
+                               name, e)
+                self._mark_down(view, f"dead pipe on load_adapter: {e!r}")
+                results[name] = (False, repr(e))
+                continue
+            pairs.append((name, adapter_id))
+        results.update(self._await_adapter_acks(
+            pairs, timeout_s=timeout_s, on_tick=on_tick))
+        return results
+
+    def unload_adapter(self, adapter_id, *,
+                       names: Optional[Sequence[str]] = None,
+                       timeout_s: float = 60.0,
+                       on_tick: Optional[Callable[[], None]] = None
+                       ) -> Dict[str, tuple]:
+        """Drop an adapter's registry reference fleet-wide: new submits
+        naming it are REJECTED at every replica door; in-flight pinners
+        finish on the weights they started with (slot frees on last
+        unpin — the engine's refcount contract)."""
+        results: Dict[str, tuple] = {}
+        pairs = []
+        for name in list(names if names is not None else self._views):
+            view = self._view_if_up(name)
+            if view is None:
+                results[name] = (False, "replica down")
+                continue
+            send = getattr(view.client, "unload_adapter", None)
+            if send is None:
+                results[name] = (False,
+                                 "transport has no unload_adapter")
+                continue
+            try:
+                send(adapter_id)
+            except Exception as e:
+                logger.warning("fleet: unload_adapter to %s failed: %r",
+                               name, e)
+                self._mark_down(view,
+                                f"dead pipe on unload_adapter: {e!r}")
+                results[name] = (False, repr(e))
+                continue
+            pairs.append((name, adapter_id))
+        results.update(self._await_adapter_acks(
+            pairs, timeout_s=timeout_s, on_tick=on_tick))
+        return results
+
+    def swap_adapter(self, adapter_id, *, weights=None, seed=None,
+                     names: Optional[Sequence[str]] = None,
+                     quiesce_timeout_s: float = 120.0,
+                     ack_timeout_s: float = 60.0, poll_s: float = 0.002,
+                     on_tick: Optional[Callable[[], None]] = None
+                     ) -> Dict[str, tuple]:
+        """Zero-downtime adapter hot-swap — the rollout discipline
+        without the process replacement.  One replica at a time: take
+        it out of dispatch (``rolling``, exactly the rollout gate),
+        pump until its in-flight requests naming this adapter have
+        delivered (a stream must never change weights mid-decode —
+        that is the whole difference between a swap and a corruption),
+        push the new weights through :meth:`load_adapter` (an in-place
+        slot overwrite on the replica: the arena's hot-swap path, no
+        recompile), await the ack, rejoin.  The rest of the fleet keeps
+        serving throughout — under a live request drip the swap
+        completes with ZERO failed requests (pinned in
+        ``tests/test_fleet.py``).  ``on_tick`` is the load generator's
+        hook, same as :meth:`rollout`."""
+        results: Dict[str, tuple] = {}
+        for name in list(names if names is not None else self._views):
+            view = self._view_if_up(name)
+            if view is None:
+                results[name] = (False, "replica down")
+                continue
+            self.registry.counter("fleet/adapter_swaps").inc()
+            view.rolling = True
+            try:
+                deadline = self._clock() + quiesce_timeout_s
+                while any(
+                        not r.done and r.sampling is not None
+                        and getattr(r.sampling, "adapter_id", None)
+                        == adapter_id
+                        for r in list(view.assigned.values())):
+                    self.pump()
+                    if on_tick is not None:
+                        on_tick()
+                    if view.down or self._clock() > deadline:
+                        break
+                    time.sleep(poll_s)
+                results[name] = self.load_adapter(
+                    adapter_id, weights=weights, seed=seed,
+                    names=[name], timeout_s=ack_timeout_s,
+                    on_tick=on_tick).get(name, (False, "replica down"))
+            finally:
+                view.rolling = False
+        return results
+
     # ------------------------------------------------------- introspection
 
     def introspect(self) -> dict:
@@ -1411,6 +1636,12 @@ class FleetRouter:
                     "kv_pending_imports"),
                 "kv_exports_pinned": (v.state or {}).get(
                     "kv_exports_pinned"),
+                # adapter residency (ISSUE 17), read off the state
+                # heartbeat — the same signal placement's adapter
+                # affinity keys on
+                "adapters_resident": (v.state or {}).get(
+                    "adapters_resident"),
+                "adapter_active": (v.state or {}).get("adapter_active"),
                 "ckpt_step": (v.meta or {}).get("ckpt_step"),
             }
         states = collections.Counter(
@@ -1506,6 +1737,9 @@ class FleetRouter:
                 "tenants": slo_rows("tenant", self._slo_tenants),
                 "priorities": slo_rows("priority",
                                        self._slo_priorities),
+                # per-adapter SLO windows (ISSUE 17): same row shape as
+                # tenants/priorities so scrapers need no new parser
+                "adapters": slo_rows("adapter", self._slo_adapters),
             },
             "totals": {
                 "submitted": counter("fleet/requests_submitted"),
@@ -1519,6 +1753,8 @@ class FleetRouter:
                 "relay_batch": counter("fleet/relay_batch"),
                 "relay_batch_events": counter(
                     "fleet/relay_batch_events"),
+                "adapter_loads": counter("fleet/adapter_loads"),
+                "adapter_swaps": counter("fleet/adapter_swaps"),
             },
             "fleet_ttft_ms": hist_row("fleet/ttft_ms"),
             "fleet_tpot_ms": hist_row("fleet/tpot_ms", keep=65536),

@@ -63,6 +63,9 @@ the K/V arena rows a rank touches are the heads it owns.
 from __future__ import annotations
 
 import dataclasses
+from typing import Optional
+
+import jax
 import jax.numpy as jnp
 from jax import lax
 
@@ -72,6 +75,7 @@ from apex_tpu.serving.fused_ops import (
     residual_norm_unfused,
 )
 from apex_tpu.serving.kv_cache import KVCacheConfig
+from apex_tpu.serving.lora import LoRAConfig, lora_delta
 from apex_tpu.serving.paged_attention import (
     paged_attention_decode,
     paged_attention_decode_unfused,
@@ -142,12 +146,14 @@ class DecodeModel:
     """
 
     def __init__(self, config: TransformerConfig, cache: KVCacheConfig, *,
-                 fused_attention: bool = True, fuse_epilogue: bool = True):
+                 fused_attention: bool = True, fuse_epilogue: bool = True,
+                 lora: Optional[LoRAConfig] = None):
         cfg = serving_config(config)
         self.cfg = cfg
         self.cache = cache
         self.fused_attention = fused_attention
         self.fuse_epilogue = fuse_epilogue
+        self.lora = lora
 
         d = cfg.head_dim
         n, g = cfg.num_attention_heads, cfg.query_groups
@@ -169,6 +175,31 @@ class DecodeModel:
             dtype=cfg.dtype, param_dtype=cfg.param_dtype)
         self.mlp = ParallelMLP(cfg)
         self.ln = FusedLayerNorm(cfg.hidden_size, eps=cfg.layernorm_epsilon)
+        if lora is not None:
+            # the adapter path needs the MLP's two GEMMs exposed (the
+            # fc1 delta lands before the activation), so bind the same
+            # parallel linears ParallelMLP builds, under its param
+            # names — _mlp_with_adapter replays its ops verbatim
+            self.mlp_fc1 = ColumnParallelLinear(
+                cfg.hidden_size, cfg.ffn_size,
+                sequence_parallel=cfg.sequence_parallel,
+                skip_bias_add=True, axis=cfg.tensor_axis,
+                dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                fp8=cfg.fp8, overlap_comm=cfg.overlap_comm)
+            self.mlp_gate = None
+            if cfg.swiglu:
+                self.mlp_gate = ColumnParallelLinear(
+                    cfg.hidden_size, cfg.ffn_size,
+                    sequence_parallel=cfg.sequence_parallel,
+                    skip_bias_add=True, axis=cfg.tensor_axis,
+                    dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                    fp8=cfg.fp8, overlap_comm=cfg.overlap_comm)
+            self.mlp_fc2 = RowParallelLinear(
+                cfg.ffn_size, cfg.hidden_size, input_is_parallel=True,
+                sequence_parallel=cfg.sequence_parallel,
+                skip_bias_add=True, axis=cfg.tensor_axis,
+                dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                fp8=cfg.fp8, overlap_comm=cfg.overlap_comm)
 
     # ----------------------------------------------------------------- util
 
@@ -221,21 +252,76 @@ class DecodeModel:
                                             v_scales=vs_layer)
         return layer_arenas, {}
 
-    def _layer_stack(self, params, x, arenas, attn_core):
+    def _lora_delta(self, x, a, b, slots):
+        """The gathered rank-r bypass of one projection for every batch
+        slot (``slots [max_batch]`` is DATA — see :mod:`.lora`)."""
+        return lora_delta(x, a, b, slots, fused=self.lora.fused)
+
+    def _lora_psum(self, d):
+        """Sum a row-parallel projection's partial deltas over tp (A is
+        sharded on the input dim there, so each rank holds a partial —
+        the one collective the adapter path adds)."""
+        cfg = self.cfg
+        if cfg.tensor_axis is not None \
+                and cc.bound_axis_size(cfg.tensor_axis) > 1:
+            return cc.all_reduce(d, cfg.tensor_axis)
+        return d
+
+    def _mlp_with_adapter(self, mlp_params, x, fc1_a, fc1_b, fc2_a, fc2_b,
+                          slots):
+        """``ParallelMLP`` replayed op-for-op with the gathered adapter
+        deltas injected: fc1's (column-parallel — lands pre-split like
+        the base output, before the activation) and fc2's (row-parallel
+        — per-rank partial, psum'd).  Zero-slot gathers add exact zeros,
+        keeping the bare stream bitwise."""
+        cfg = self.cfg
+        h, bias = self.mlp_fc1.apply(
+            {"params": mlp_params["dense_h_to_4h"]}, x)
+        h = h + bias + self._lora_delta(x, fc1_a, fc1_b, slots)
+        if cfg.swiglu:
+            gate, gate_bias = self.mlp_gate.apply(
+                {"params": mlp_params["dense_h_to_4h_gate"]}, x)
+            h = jax.nn.silu(gate + gate_bias) * h
+        else:
+            h = jax.nn.gelu(h, approximate=cfg.bias_gelu_fusion)
+        out, out_bias = self.mlp_fc2.apply(
+            {"params": mlp_params["dense_4h_to_h"]}, h)
+        out = out + self._lora_psum(
+            self._lora_delta(h, fc2_a, fc2_b, slots))
+        return out, out_bias
+
+    def _layer_stack(self, params, x, arenas, attn_core, adapters=None,
+                     adapter_slots=None):
         """Scan the ``[L, ...]`` layer stack; each step consumes its own
         arena slices and emits the updated ones (the scan re-stacks
-        them, which XLA aliases into the donated input arenas)."""
+        them, which XLA aliases into the donated input arenas).
+
+        With ``adapters`` (the 8 ``[L, n_slots, ...]`` LoRA arrays,
+        threaded exactly like the arenas so the engine can donate them
+        too), every projection adds its slot-gathered delta; the scan
+        re-emits the adapter slices unchanged."""
+        n_ar = len(arenas)
 
         def body(carry, xs):
             x = carry
-            lp, layer_arenas = xs[0], xs[1:]
+            lp, rest = xs[0], xs[1:]
+            layer_arenas = rest[:n_ar]
+            layer_adapters = rest[n_ar:]
             ln1 = self.ln.apply({"params": lp["input_layernorm"]}, x)
             qkv = self.qkv.apply(
                 {"params": lp["self_attention"]["query_key_value"]}, ln1)
+            if layer_adapters:
+                (qkv_a, qkv_b, dense_a, dense_b,
+                 fc1_a, fc1_b, fc2_a, fc2_b) = layer_adapters
+                qkv = qkv + self._lora_delta(ln1, qkv_a, qkv_b,
+                                             adapter_slots)
             q, k, v = self._split_qkv(qkv)
             ctx, layer_arenas = attn_core(q, k, v, layer_arenas)
             y, y_bias = self.dense.apply(
                 {"params": lp["self_attention"]["dense"]}, ctx)
+            if layer_adapters:
+                y = y + self._lora_psum(self._lora_delta(
+                    ctx, dense_a, dense_b, adapter_slots))
             ln2 = lp["post_attention_layernorm"]
             if self.fuse_epilogue:
                 ln2_out, h = fused_residual_norm(
@@ -245,11 +331,21 @@ class DecodeModel:
                 ln2_out, h = residual_norm_unfused(
                     y, x, ln2["scale"], ln2["bias"], bias=y_bias,
                     eps=self.cfg.layernorm_epsilon)
-            m, m_bias = self.mlp.apply({"params": lp["mlp"]}, ln2_out)
-            return h + m + m_bias, layer_arenas
+            if layer_adapters:
+                m, m_bias = self._mlp_with_adapter(
+                    lp["mlp"], ln2_out, fc1_a, fc1_b, fc2_a, fc2_b,
+                    adapter_slots)
+            else:
+                m, m_bias = self.mlp.apply({"params": lp["mlp"]}, ln2_out)
+            return h + m + m_bias, layer_arenas + tuple(layer_adapters)
 
-        x, arenas = lax.scan(body, x, (params.layers,) + tuple(arenas))
-        return x, arenas
+        xs = (params.layers,) + tuple(arenas)
+        if adapters is not None:
+            xs = xs + tuple(adapters)
+        x, out = lax.scan(body, x, xs)
+        if adapters is None:
+            return x, out, None
+        return x, out[:n_ar], out[n_ar:]
 
     def _head(self, params, x):
         """Final LN + tied LM head, vocab gathered over tp.
@@ -277,7 +373,7 @@ class DecodeModel:
 
     def decode_step(self, arenas, params, tokens, positions, block_tables,
                     active, n_draft, temperature, top_k, top_p, seeds,
-                    steps):
+                    steps, adapters=None, adapter_slots=None):
         """One continuously-batched decode/verify step (shard_map body).
 
         ``arenas`` — ``(k, v)`` or ``(k, v, k_scales, v_scales)``;
@@ -299,6 +395,11 @@ class DecodeModel:
         step's own outputs, so the host emits ``out_tokens[:, :a + 1]``
         and advances lengths by ``a + 1`` (rejection is a length that
         simply never advances — nothing to copy back).
+
+        With LoRA enabled the step also takes ``adapters`` (the 8
+        donated arena arrays) and ``adapter_slots [max_batch]`` (each
+        slot's arena row — DATA, like the block tables), and returns
+        ``(arenas, adapters, out, accepted, logits)``.
         """
         cfg = self.cfg
         cache = self.cache
@@ -363,7 +464,8 @@ class DecodeModel:
                 ctx = ctx.transpose(1, 0, 2, 3)
             return (ctx.reshape(S, B, -1).astype(q.dtype), layer_arenas)
 
-        x, arenas = self._layer_stack(params, x, arenas, attn_core)
+        x, arenas, adapters = self._layer_stack(
+            params, x, arenas, attn_core, adapters, adapter_slots)
         logits = self._head(params, x)             # [S, B, vocab]
         logits = logits.transpose(1, 0, 2)         # [B, S, vocab]
         # every position samples with its slot's policy at its own
@@ -384,11 +486,14 @@ class DecodeModel:
         else:
             accepted = jnp.zeros((B,), jnp.int32)
         accepted = jnp.where(active, accepted, 0).astype(jnp.int32)
+        if adapters is not None:
+            return arenas, adapters, out, accepted, logits
         return arenas, out, accepted, logits
 
     def prefill(self, arenas, params, tokens, position_ids, block_tables,
                 lengths, limits, dest_blocks, dest_offsets, sample_index,
-                temperature, top_k, top_p, seeds, steps):
+                temperature, top_k, top_p, seeds, steps, adapters=None,
+                adapter_slots=None):
         """Batched chunked prefill of one ``[max_batch, chunk]`` slice
         (shard_map body).
 
@@ -409,7 +514,10 @@ class DecodeModel:
         logits there are sampled with the slot's policy arrays (the
         request's FIRST generated token).  Out-of-range = no sample.
         Returns ``(arenas, next_tokens [max_batch],
-        logits [max_batch, chunk, vocab])``.
+        logits [max_batch, chunk, vocab])`` — with LoRA enabled,
+        ``adapters``/``adapter_slots`` join exactly as in
+        :meth:`decode_step` and the adapters return between the arenas
+        and the tokens.
         """
         cfg = self.cfg
         B, T = tokens.shape
@@ -447,7 +555,8 @@ class DecodeModel:
             return (ctx.transpose(1, 0, 2, 3).reshape(T, B, -1)
                     .astype(q.dtype), layer_arenas)
 
-        x, arenas = self._layer_stack(params, x, arenas, attn_core)
+        x, arenas, adapters = self._layer_stack(
+            params, x, arenas, attn_core, adapters, adapter_slots)
         logits = self._head(params, x)             # [T, B, vocab]
         logits = logits.transpose(1, 0, 2)         # [B, T, vocab]
         idx = jnp.clip(sample_index.astype(jnp.int32), 0, T - 1)
@@ -458,4 +567,6 @@ class DecodeModel:
         valid = (sample_index.astype(jnp.int32) >= 0) & \
             (sample_index.astype(jnp.int32) < T)
         next_tokens = jnp.where(valid, sampled, 0).astype(jnp.int32)
+        if adapters is not None:
+            return arenas, adapters, next_tokens, logits
         return arenas, next_tokens, logits

@@ -47,6 +47,19 @@ parent → child commands
                           rollouts send a real **SIGTERM** instead,
                           through the engine's ``PreemptionGuard``
     ``("stop",)``       — immediate cooperative exit
+    ``("load_adapter", adapter_id, payload)``
+                        — (ISSUE 17) register a LoRA adapter into the
+                          engine's paged adapter arena; ``payload`` may
+                          carry ``weights`` (raw per-projection A/B
+                          pairs) or a ``seed`` for the deterministic
+                          test fixture.  Acked by ``adapter_loaded``.
+                          Re-loading a resident id hot-swaps the slot
+                          in place — the zero-downtime adapter rollout
+                          is this command, per replica, staggered.
+    ``("unload_adapter", adapter_id)``
+                        — drop the registry reference (the slot frees
+                          once the last active request unpins it);
+                          acked by ``adapter_unloaded``
 
 KV-block migration (ISSUE 16 — disaggregated prefill/decode).  The
 router relays a request's paged KV from a prefill replica to a decode
@@ -129,6 +142,15 @@ child → parent events
     ``("drained", delivered)`` — the SIGTERM drain completed: every
                                  in-flight request delivered; the child
                                  exits 0 right after
+    ``("adapter_loaded", adapter_id, ok, info)`` /
+    ``("adapter_unloaded", adapter_id, ok, info)``
+                               — (ISSUE 17) adapter command verdicts:
+                                 ``info`` is ``{"slot": int,
+                                 "evicted": id-or-None}`` on success,
+                                 the repr'd error otherwise.  The
+                                 router's ``load_adapter`` broadcast
+                                 and staggered ``swap_adapter`` both
+                                 pump on these acks.
     ``("error", exc)``         — relayed fatal; the child exits
 
 A SIGKILLed child never sends ``drained`` — the router sees the dead
@@ -320,6 +342,10 @@ def _replica_worker(spec: ReplicaSpec, name: str, cmd_q, evt_q,
             "prefill_len": None,
             "debug_port": debug_port,
             "role": spec.role,
+            # ISSUE 17: whether this engine has a LoRA adapter arena —
+            # the router refuses to broadcast adapters at a bare fleet
+            # instead of failing one replica at a time mid-load
+            "lora": engine.lora is not None,
         }))
 
         reqs = {}          # frid -> engine Request
@@ -471,6 +497,35 @@ def _replica_worker(spec: ReplicaSpec, name: str, cmd_q, evt_q,
                         import_commit(cmd[1], cmd[2], cmd[3])
                     elif cmd[0] == "kv_abort":
                         imports.pop(cmd[1], None)
+                    elif cmd[0] == "load_adapter":
+                        aid, payload = cmd[1], (cmd[2] or {})
+                        try:
+                            evicted = engine.adapter_arena.residents() \
+                                if engine.adapter_arena else []
+                            slot = engine.register_adapter(
+                                aid, weights=payload.get("weights"),
+                                seed=payload.get("seed"))
+                        except Exception as e:  # noqa: BLE001 — verdict
+                            evt_q.put(("adapter_loaded", aid, False,
+                                       repr(e)))
+                        else:
+                            gone = [a for a in evicted
+                                    if a != aid and
+                                    not engine.adapter_arena.resident(a)]
+                            evt_q.put(("adapter_loaded", aid, True,
+                                       {"slot": int(slot),
+                                        "evicted": gone[0] if gone
+                                        else None}))
+                    elif cmd[0] == "unload_adapter":
+                        aid = cmd[1]
+                        try:
+                            engine.unregister_adapter(aid)
+                        except Exception as e:  # noqa: BLE001 — verdict
+                            evt_q.put(("adapter_unloaded", aid, False,
+                                       repr(e)))
+                        else:
+                            evt_q.put(("adapter_unloaded", aid, True,
+                                       None))
                     elif cmd[0] == "drain":
                         guard.trigger()
                     elif cmd[0] == "stop":
@@ -628,6 +683,18 @@ class ReplicaProcess:
 
     def kv_abort(self, frid) -> None:
         self._cmd.put(("kv_abort", frid))
+
+    # ------------------------------------------------- adapter cmds
+    # (ISSUE 17) Thin wire wrappers over the engine's adapter registry;
+    # the router's broadcast/hot-swap drives these and pumps on the
+    # ``adapter_loaded`` / ``adapter_unloaded`` ack events.
+
+    def load_adapter(self, adapter_id, payload: Optional[dict] = None
+                     ) -> None:
+        self._cmd.put(("load_adapter", adapter_id, dict(payload or {})))
+
+    def unload_adapter(self, adapter_id) -> None:
+        self._cmd.put(("unload_adapter", adapter_id))
 
     def begin_drain(self, *, sigterm: bool = True) -> None:
         """Start the drain: a real SIGTERM (the production rollout

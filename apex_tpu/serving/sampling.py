@@ -29,6 +29,7 @@ greedy by construction (only the argmax survives).
 from __future__ import annotations
 
 import dataclasses
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -59,6 +60,14 @@ class SamplingParams:
     emitted count so the survivor redraws the continuation of the SAME
     stream (the stitched sampled stream is bitwise the uninterrupted
     one — pinned in ``tests/test_fleet.py``).
+
+    ``adapter_id`` names the LoRA adapter the request decodes under
+    (:mod:`.lora`): ``None`` — the default — gathers the permanent zero
+    adapter and is bitwise the bare engine.  It rides the wire inside
+    this dataclass, so both transports, failover replay and preemption
+    readmit carry it for free; the engine resolves it to an arena slot
+    at admission (unknown id -> typed REJECTED) and the slot index is
+    per-tick ``[max_batch]`` data, never shape.
     """
 
     temperature: float = 0.0
@@ -66,6 +75,7 @@ class SamplingParams:
     top_p: float = 1.0
     seed: int = 0
     step_offset: int = 0
+    adapter_id: Optional[str] = None
 
     def __post_init__(self):
         if self.temperature < 0.0:

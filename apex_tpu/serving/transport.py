@@ -352,6 +352,21 @@ class SocketTransport:
     def kv_abort(self, frid) -> None:
         self._send_cmd(("kv_abort", frid))
 
+    # ------------------------------------------------- adapter cmds
+    # (ISSUE 17) One frame each; the ``adapter_loaded`` /
+    # ``adapter_unloaded`` ack events ride the ordinary event stream.
+    # Adapter weights cross as plain pickled arrays inside the frame —
+    # rank-8 pairs for the test configs are a few KB, far under
+    # MAX_FRAME_BYTES.
+
+    def load_adapter(self, adapter_id, payload: Optional[dict] = None
+                     ) -> None:
+        self._send_cmd(("load_adapter", adapter_id,
+                        dict(payload or {})))
+
+    def unload_adapter(self, adapter_id) -> None:
+        self._send_cmd(("unload_adapter", adapter_id))
+
     # -------------------------------------------------------------- events
 
     def poll(self) -> list:

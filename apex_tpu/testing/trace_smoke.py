@@ -263,6 +263,18 @@ def main() -> int:
         log("trace_report.py output:\n"
             + cli.stdout.decode(errors="replace"))
 
+        # ---- tier gating (ISSUE 17 satellite) -----------------------
+        # Phase D stands up a SECOND fleet (4 more daemons, 4 more
+        # engine compiles) and was the single slowest fast-tier phase;
+        # TRACE_SMOKE_PHASES=ABC keeps the kill/merge/statusz coverage
+        # in the fast tier and defers the disagg leg to the slow tier
+        # (tests/test_aux_subsystems.py runs both).
+        phases = os.environ.get("TRACE_SMOKE_PHASES", "ABCD").upper()
+        if "D" not in phases:
+            log(f"phase D skipped (TRACE_SMOKE_PHASES={phases})")
+            print("PASS", file=sys.stderr, flush=True)
+            return 0
+
         # ---- phase D: the disaggregated 2-prefill/2-decode fleet ----
         # (ISSUE 16) — the kv_migrate hop on REAL daemons: prefill
         # replicas admit, KV runs stream to the decode side, and the

@@ -1999,6 +1999,152 @@ def bench_serving_spec(jax, on_tpu):
     }
 
 
+def bench_serving_lora(jax, on_tpu):
+    """Batched multi-LoRA serving (ISSUE 17): emitted-tokens/sec of the
+    LoRA-enabled engine on waves tagged round-robin over 1 / 8 / 64
+    concurrent adapters, vs the bare (``lora=None``) engine on the same
+    untagged wave.
+
+    Every request in the tagged wave carries an ``adapter_id`` through
+    ``SamplingParams``, so every decode tick runs the per-slot gathered
+    low-rank delta (the scalar-prefetch kernel indexes the paged
+    adapter arena with the per-slot adapter-slot vector — data, never
+    shape).  ``tokens_per_sec_at`` keys on the number of *distinct*
+    concurrent adapters; ``vs_bare_at`` the per-level ratios; and
+    ``vs_bare_1adapter`` — the single-tenant ratio, where the delta is
+    pure overhead — is the floored acceptance signal (>= 0.9: one
+    adapter must cost <= ~10%).  The decode compile count is asserted
+    == 1 across all levels: 1 adapter and 64 adapters run the exact
+    same jit program.  NB the CPU row runs the ``jnp.take`` unfused
+    twin (``fused=False`` — same values): interpret-mode Pallas would
+    gate interpreter dispatch, not the adapter math; the TPU window
+    measures the real fused scalar-prefetch gather riding the decode
+    tick."""
+    import numpy as np
+
+    from apex_tpu import parallel
+    from apex_tpu.observability.metrics import MetricRegistry
+    from apex_tpu.serving import (
+        LoRAConfig, SamplingParams, ServingConfig, ServingEngine)
+    from apex_tpu.transformer.testing import TransformerConfig
+    from apex_tpu.transformer.testing.gpt_parallel_train import build_gpt_3d
+
+    devices = jax.devices()
+    mesh = parallel.initialize_model_parallel(
+        tensor_model_parallel_size=1, devices=devices[:1])
+    # rank deliberately small relative to hidden: the production regime
+    # is r << h (16 vs 4096) — at the tiny-model r/h the delta's FLOPs
+    # fraction stops representing what the floor gates.  max_batch is
+    # the other half of that argument: the delta adds a fixed handful
+    # of ops per layer, so a thin batch gates op-dispatch overhead
+    # instead of the adapter math
+    hidden, layers, heads, vocab, rank = (
+        (512, 4, 8, 2048, 8) if on_tpu else (256, 2, 8, 512, 4))
+    max_batch, block, gen = 32, 16, 32
+    n_adapters, n_reqs, rounds = 64, 64, 3
+    prompt_len = 16
+    max_seq = prompt_len + gen + block
+    cfg = TransformerConfig(
+        hidden_size=hidden, num_layers=layers, num_attention_heads=heads,
+        padded_vocab_size=vocab, max_position_embeddings=max_seq,
+        hidden_dropout=0.0, attention_dropout=0.0, tensor_axis="tp",
+        use_flash_attention=True)
+    init_fn, _, _ = build_gpt_3d(cfg, num_chunks=layers,
+                                 num_microbatches=1, mesh=mesh)
+    params, _ = init_fn(jax.random.PRNGKey(0),
+                        jax.numpy.zeros((2, 8), jax.numpy.int32))
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(1, vocab - 1, size=prompt_len).tolist()
+               for _ in range(n_reqs)]
+
+    def build(lora):
+        eng = ServingEngine(
+            cfg, ServingConfig(max_batch=max_batch, block_size=block,
+                               max_seq=max_seq, prefill_len=64,
+                               lora=lora),
+            params, mesh=mesh, registry=MetricRegistry(rank=0))
+        if lora is not None:
+            # adapter registration (pack + device put) happens outside
+            # every timed window — the steady state being measured is
+            # decode with residents, not cold loads
+            for i in range(n_adapters):
+                eng.register_adapter(f"tenant-{i}", seed=i)
+        # warmup: pay the prefill + decode compiles (including the
+        # gathered-delta path) outside the timed windows
+        warm = (SamplingParams(adapter_id="tenant-0")
+                if lora is not None else None)
+        eng.submit(rng.randint(1, vocab - 1, size=8).tolist(), 2,
+                   sampling=warm)
+        eng.run_until_drained(max_steps=500)
+        return eng
+
+    def level(eng, c):
+        registry = MetricRegistry(rank=0)   # steady-state window only
+        eng.registry = registry
+        reqs = []
+        for i, p in enumerate(prompts):
+            sp = (SamplingParams(adapter_id=f"tenant-{i % c}")
+                  if c else None)
+            reqs.append(eng.submit(p, gen, sampling=sp))
+        t0 = time.perf_counter()
+        eng.run_until_drained(max_steps=50_000)
+        dt = time.perf_counter() - t0
+        assert all(len(r.output_tokens) == gen for r in reqs)
+        # the jit-stability claim, measured where it matters: adapter
+        # mix is data, so the whole sweep shares ONE decode program
+        assert eng.decode_compile_count() == 1
+        tokens = registry.counter("serving/tokens_generated").value
+        return tokens / max(dt, 1e-9)
+
+    # fused only where the kernel is real: the CPU fallback row would
+    # otherwise gate the Pallas interpreter's dispatch overhead (~4x)
+    # instead of the adapter math the floor is about
+    lora_eng = build(LoRAConfig(rank=rank, max_adapters=n_adapters,
+                                fused=on_tpu))
+    base_eng = build(None)
+    levels = [1, 8, n_adapters]
+    tps, base_tps, ratio = {}, {}, {}
+    for c in levels:
+        key = str(c)
+        # paired rounds, median ratio: host drift cancels (the
+        # serving_trace_overhead discipline — the gated signal is a
+        # ratio near 1, so single-window noise would flip the floor)
+        pairs = [(level(lora_eng, c), level(base_eng, 0))
+                 for _ in range(rounds)]
+        ratios = sorted(r / max(b, 1e-9) for r, b in pairs)
+        rates = sorted(r for r, _ in pairs)
+        base_rates = sorted(b for _, b in pairs)
+        tps[key] = round(rates[rounds // 2], 1)
+        base_tps[key] = round(base_rates[rounds // 2], 1)
+        ratio[key] = round(ratios[rounds // 2], 3)
+        _log(f"serving_lora: adapters={c} lora {tps[key]} vs bare "
+             f"{base_tps[key]} tok/s (x{ratio[key]} median of "
+             f"{[round(x, 3) for x in ratios]})")
+    parallel.destroy_model_parallel()
+    top = str(n_adapters)
+    return {
+        "value": tps[top],
+        "unit": "tokens/sec",
+        "config": (f"gpt h{hidden} L{layers} max_batch{max_batch} "
+                   f"rank{rank} adapters{n_adapters} reqs{n_reqs} "
+                   f"prompt{prompt_len} gen{gen}"),
+        "tokens_per_sec_at": tps,
+        "bare_tokens_per_sec_at": base_tps,
+        "vs_bare_at": ratio,
+        "vs_bare_1adapter": ratio["1"],
+        "measured": (
+            f"{n_reqs}-request greedy waves tagged round-robin over "
+            f"{levels} distinct adapters (rank-{rank} deltas gathered "
+            "per slot from the paged arena via scalar-prefetch) vs the "
+            "bare lora=None engine on the same untagged wave — "
+            f"median of {rounds} paired rounds per level, so host "
+            "drift cancels out of the gated ratio; one decode program "
+            "across the whole sweep (CPU runs the jnp.take unfused "
+            "twin — the TPU window measures the fused HBM-bound "
+            "gather)"),
+    }
+
+
 def bench_serving_disagg(jax, on_tpu):
     """Disaggregated prefill/decode fleets (ISSUE 16): decode p99 TPOT
     under a concurrent prefill flood, 1-prefill + 1-decode vs 2
@@ -2476,6 +2622,7 @@ BENCHES = {
     "serving_spec": bench_serving_spec,
     "serving_disagg": bench_serving_disagg,
     "serving_trace_overhead": bench_serving_trace_overhead,
+    "serving_lora": bench_serving_lora,
     "input_pipeline": bench_input_pipeline,
     "real_data_rn50": bench_real_data_rn50,
     # Diagnostic-only combos (run via ``--one``, not in BENCH_ORDER):
@@ -2499,7 +2646,7 @@ BENCH_ORDER = ["resnet50_o2", "gpt_flash", "bert_large",
                "zero_adam_step", "ckpt_save_restore", "ckpt_reshard",
                "telemetry_overhead", "serving", "serving_occupancy",
                "serving_fleet", "serving_spec", "serving_disagg",
-               "serving_trace_overhead",
+               "serving_trace_overhead", "serving_lora",
                "gpt_flash_fp8", "gpt_long_context", "input_pipeline",
                "real_data_rn50", "tp_gpt"]
 
@@ -2580,6 +2727,7 @@ _TPU_BENCH_CAP_S = {"fused_adam_step": 420.0, "zero_adam_step": 420.0,
                     "serving_fleet": 600.0, "serving_spec": 600.0,
                     "serving_disagg": 600.0,
                     "serving_trace_overhead": 600.0,
+                    "serving_lora": 600.0,
                     "tp_gpt": 900.0}
 
 
@@ -2757,7 +2905,8 @@ def compact_record(record, max_bytes: int = 1500) -> dict:
                 "p99_tpot_ms_steady", "p99_tpot_ms_roll",
                 "roll_vs_steady", "wire_vs_inproc",
                 "vs_colocated", "p99_tpot_ms_colocated",
-                "kv_migrate_ms_per_req", "kv_migrate_kb_per_req")
+                "kv_migrate_ms_per_req", "kv_migrate_kb_per_req",
+                "vs_bare_1adapter")
     rows = {}
     for name, row in list(record.get("extras", {}).items()):
         if not isinstance(row, dict):

@@ -89,6 +89,10 @@ TOLERANCES = {
     # absolute wave rate on a shared CPU host is noisy; the gated
     # signal is the vs_bare ceiling above, not the rate
     "serving_trace_overhead": 0.6,
+    # the delta kernel runs interpret-mode Pallas on CPU, so the
+    # absolute rate couples to host load twice over; the gated signal
+    # is the vs_bare_1adapter floor below
+    "serving_lora": 0.6,
 }
 
 # Hard ceilings on whitelist fields — standing acceptance gates, not
@@ -110,6 +114,10 @@ FLOORS = {
     # decode tail — co-located p99 / disaggregated p99 under the same
     # prefill flood at equal pool size
     ("serving_disagg", "vs_colocated"): 1.0,
+    # ISSUE 17: a single resident adapter may cost at most ~10% of the
+    # bare engine's decode rate — the gathered delta rides the tick,
+    # it must not own it
+    ("serving_lora", "vs_bare_1adapter"): 0.9,
 }
 
 

@@ -197,12 +197,18 @@ def test_trace_smoke_script():
     within 2%; /fleet/statusz serves the per-tenant SLO plane; and
     scripts/trace_report.py parses the spill dir strictly.  Subprocess
     because the smoke spawns replica daemons and owns its platform
-    pinning (the fleet-smoke pattern)."""
+    pinning (the fleet-smoke pattern).
+
+    Fast tier runs phases A-C only (TRACE_SMOKE_PHASES=ABC): phase D
+    stands up a second 4-daemon fleet and was the slowest fast-tier
+    phase — the slow-tier twin below runs all phases (ISSUE 17 tier
+    budget satellite)."""
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env.pop("XLA_FLAGS", None)
     env["PYTHON"] = sys.executable
+    env["TRACE_SMOKE_PHASES"] = "ABC"
     proc = subprocess.run(
         ["bash", os.path.join(repo, "scripts", "trace_smoke.sh")],
         cwd=repo, env=env, capture_output=True, timeout=600)
@@ -211,6 +217,30 @@ def test_trace_smoke_script():
         f"stderr tail:\n{proc.stderr.decode(errors='replace')[-3000:]}")
     assert b"PASS" in proc.stderr
     for phase in (b"phase A OK", b"phase B OK", b"phase C OK"):
+        assert phase in proc.stderr
+
+
+@pytest.mark.slow
+def test_trace_smoke_script_disagg():
+    """The full trace smoke including phase D (the disaggregated
+    2-prefill/2-decode fleet with kv_migrate hops on real daemons) —
+    slow tier: it stands up a second fleet of four daemons on top of
+    the phase A-C fleet."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    env["PYTHON"] = sys.executable
+    env["TRACE_SMOKE_PHASES"] = "ABCD"
+    proc = subprocess.run(
+        ["bash", os.path.join(repo, "scripts", "trace_smoke.sh")],
+        cwd=repo, env=env, capture_output=True, timeout=900)
+    assert proc.returncode == 0, (
+        f"trace_smoke.sh rc={proc.returncode}\n"
+        f"stderr tail:\n{proc.stderr.decode(errors='replace')[-3000:]}")
+    assert b"PASS" in proc.stderr
+    for phase in (b"phase A OK", b"phase B OK", b"phase C OK",
+                  b"phase D OK"):
         assert phase in proc.stderr
 
 

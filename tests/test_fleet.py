@@ -70,7 +70,7 @@ class FakeReplica:
     def __init__(self, name, *, free_blocks=100, max_batch=4,
                  die_after_tokens=None, fn=fake_fn, meta=None,
                  kv_occupancy=0.0, prefix_cache_hits=0,
-                 fail_export=False, refuse_import=False):
+                 fail_export=False, refuse_import=False, adapters=()):
         self.name = name
         self._fn = fn
         self.free_blocks = free_blocks
@@ -97,6 +97,10 @@ class FakeReplica:
         self.imports_committed = 0
         self.defer_import_verdict = False   # hold kv_imported until flush
         self._deferred_verdicts = []
+        # --- ISSUE 17 adapter surface ---
+        self.adapters = set(adapters)       # resident adapter ids
+        self.adapter_loads = []             # (adapter_id, payload) log
+        self.refuse_adapter = False
         self._emit_state()
 
     # --- client surface -------------------------------------------------
@@ -200,6 +204,29 @@ class FakeReplica:
             raise BrokenPipeError("dead replica")
         self.pending_imports.pop(frid, None)
 
+    # --- ISSUE 17 adapter surface (batched multi-LoRA serving) ---
+
+    def load_adapter(self, adapter_id, payload=None):
+        if not self._alive:
+            raise BrokenPipeError("dead replica")
+        if self.refuse_adapter:
+            self._events.append(("adapter_loaded", adapter_id, False,
+                                 "fake adapter refused"))
+            return
+        self.adapters.add(adapter_id)
+        self.adapter_loads.append((adapter_id, dict(payload or {})))
+        self._events.append(("adapter_loaded", adapter_id, True,
+                             {"slot": len(self.adapters),
+                              "evicted": None}))
+        self._emit_state()
+
+    def unload_adapter(self, adapter_id):
+        if not self._alive:
+            raise BrokenPipeError("dead replica")
+        self.adapters.discard(adapter_id)
+        self._events.append(("adapter_unloaded", adapter_id, True, None))
+        self._emit_state()
+
     def begin_drain(self, **kw):
         self.draining = True
         for frid, *_ in self.waiting:
@@ -226,6 +253,7 @@ class FakeReplica:
             "prefix_cache_hits": self.prefix_cache_hits,
             "kv_pending_imports": len(self.pending_imports),
             "kv_exports_pinned": len(self.exports),
+            "adapters_resident": sorted(self.adapters),
         }))
 
     def _maybe_finish_drain(self):
@@ -1375,3 +1403,182 @@ def test_statusz_splits_roles_and_reports_migration_backlog():
     assert intro["d"]["role"] == "decode"
     assert intro["p"]["kv_exports_pinned"] == 0
     assert intro["d"]["kv_pending_imports"] == 0
+
+
+# --------------------- ISSUE 17: batched multi-LoRA serving
+
+
+def test_adapter_load_broadcast_acks_and_introspect():
+    """``router.load_adapter`` broadcasts the wire command to every
+    live replica, pump-waits the ``adapter_loaded`` acks, and the
+    residency rides the next state heartbeat into ``introspect()``;
+    ``unload_adapter`` reverses it.  A dead replica reads as a failed
+    ack, never a hang."""
+    a = FakeReplica("a")
+    b = FakeReplica("b")
+    router = make_router([a, b])
+    router.pump()
+    acks = router.load_adapter("tenant-a", seed=3)
+    assert acks["a"][0] and acks["b"][0], acks
+    assert acks["a"][1]["slot"] >= 1
+    assert a.adapter_loads[0][1] == {"seed": 3}
+    assert int(router.registry.counter("fleet/adapter_loads").value) == 2
+    intro = router.introspect()["replicas"]
+    assert intro["a"]["adapters_resident"] == ["tenant-a"]
+    assert intro["b"]["adapters_resident"] == ["tenant-a"]
+    acks = router.unload_adapter("tenant-a")
+    assert acks["a"][0] and acks["b"][0]
+    assert router.introspect()["replicas"]["a"]["adapters_resident"] == []
+    # the dead-replica shape: failed ack, not a hang
+    b.kill()
+    router.pump()
+    acks = router.load_adapter("tenant-b", seed=1)
+    assert acks["a"][0]
+    assert not acks["b"][0]
+
+
+def test_adapter_id_rides_wire_and_slo_plane():
+    """The tentpole wire contract at the fleet layer: ``adapter_id``
+    rides SamplingParams through dispatch unchanged, and the finished
+    stream lands in the per-adapter SLO rows of /fleet/statusz —
+    bare requests contribute nothing to the adapter axis."""
+    from apex_tpu.serving import SamplingParams
+
+    rep = FakeReplica("a")
+    router = make_router([rep])
+    router.pump()
+    router.load_adapter("tenant-a", seed=3)
+    sp = SamplingParams(temperature=0.8, seed=5, adapter_id="tenant-a")
+    tagged = router.submit([3, 5], 6, sampling=sp)
+    bare = router.submit([3, 5], 6)
+    drive(router, [rep])
+    assert tagged.state is RequestState.FINISHED
+    assert bare.state is RequestState.FINISHED
+    assert tagged.output_tokens == seeded_reference([3, 5], 6, sp)
+    wire_sp = next(s for _, _, _, _, s in rep.submissions
+                   if s is not None)
+    assert wire_sp.adapter_id == "tenant-a"
+    rows = router.fleet_statusz()["slo"]["adapters"]
+    assert list(rows) == ["tenant-a"]
+    assert rows["tenant-a"]["finished"] == 1
+    assert rows["tenant-a"]["rejected"] == 0
+    assert rows["tenant-a"]["ttft_ms"]["count"] == 1
+    assert rows["tenant-a"]["tpot_ms"]["count"] == 5   # 6 tokens, 5 gaps
+
+
+@pytest.mark.parametrize("k", [1, 3])
+def test_adapter_tagged_stream_survives_failover_replay(k):
+    """The acceptance-criteria replay contract: SIGKILL an adapter-
+    tagged seeded stream at token k — the replay wire carries the SAME
+    ``adapter_id`` (and the rebased draw counter), so the survivor
+    gathers the same adapter rows and the stitched stream is bitwise
+    the uninterrupted one."""
+    from apex_tpu.serving import SamplingParams
+
+    n_new, prompt = 6, [9, 1, 4]
+    sp = SamplingParams(temperature=0.8, seed=5, adapter_id="tenant-a")
+    victim = FakeReplica("victim", free_blocks=1000, die_after_tokens=k)
+    survivor = FakeReplica("survivor", free_blocks=10)
+    router = make_router([victim, survivor])
+    router.pump()
+    acks = router.load_adapter("tenant-a", seed=3)
+    assert all(ok for ok, _ in acks.values())
+    req = router.submit(prompt, n_new, sampling=sp)
+    drive(router, [victim, survivor])
+    assert req.state is RequestState.FINISHED
+    assert req.replays == 1
+    ref = seeded_reference(prompt, n_new, sp)
+    assert req.output_tokens == ref
+    frid, wire_prompt, _, _, wire_sp = survivor.submissions[0]
+    assert frid == req.rid
+    assert wire_prompt == prompt + ref[:k]
+    assert wire_sp.adapter_id == "tenant-a"
+    assert wire_sp.step_offset == k
+    # the stream closed into the per-adapter SLO rows exactly once
+    rows = router.fleet_statusz()["slo"]["adapters"]
+    assert rows["tenant-a"]["finished"] == 1
+
+
+def test_adapter_affinity_tie_break():
+    """Placement's adapter axis (satellite): with free blocks level, an
+    adapter-tagged request lands on the replica whose heartbeat says
+    the adapter is RESIDENT (zero arena churn) instead of the
+    name-order default — and the tie-break stands down past the same
+    occupancy cap prefix affinity honors."""
+    from apex_tpu.serving import SamplingParams
+
+    a = FakeReplica("a", free_blocks=50)
+    b = FakeReplica("b", free_blocks=50, adapters=("lora-x",))
+    router = make_router([a, b], affinity_occupancy_cap=0.95)
+    router.pump()
+    sp = SamplingParams(temperature=0.8, seed=5, adapter_id="lora-x")
+    warm = router.submit([1, 2], 2, sampling=sp)
+    cold = router.submit([1, 2], 2)
+    drive(router, [a, b])
+    assert warm.replica == "b", "adapter residency should win the tie"
+    assert cold.replica == "a", "bare request takes the name-order default"
+    # pool pressure on the warm replica: affinity yields
+    b.kv_occupancy = 0.99
+    a._emit_state()
+    b._emit_state()
+    router.pump()
+    nxt = router.submit([1, 2], 2,
+                        sampling=SamplingParams(temperature=0.8, seed=5,
+                                                adapter_id="lora-x"))
+    drive(router, [a, b])
+    assert nxt.replica == "a", \
+        "the tie-break must stand down past the occupancy cap"
+
+
+def test_adapter_hot_swap_under_live_drip_zero_failures():
+    """The acceptance-criteria hot-swap contract: ``swap_adapter``
+    walks the fleet one replica at a time under a live request drip —
+    every request (tagged and bare) finishes, ZERO rejects, every
+    replica took the new weights exactly once, and the rolling gate
+    released."""
+    from apex_tpu.serving import SamplingParams
+
+    reps = [FakeReplica(n, max_batch=2) for n in ("a", "b")]
+    router = make_router(reps, replica_queue_limit=4,
+                         max_queue_depth=200)
+    router.pump()
+    acks = router.load_adapter("tenant-a", seed=3)
+    assert all(ok for ok, _ in acks.values())
+
+    submitted = []
+    budget = [12]
+
+    def drip():
+        if budget[0] > 0:
+            sp = SamplingParams(temperature=0.8, seed=budget[0],
+                                adapter_id="tenant-a") \
+                if budget[0] % 2 else None
+            submitted.append(router.submit([budget[0]], 2, sampling=sp))
+            budget[0] -= 1
+        for rep in reps:
+            rep.tick()
+
+    acks = router.swap_adapter("tenant-a", seed=7, on_tick=drip)
+    assert all(ok for ok, _ in acks.values()), acks
+    # top the wave up (fakes drain near-instantly — what matters is
+    # that load flowed THROUGH the swap; the rollout-test pattern)
+    while budget[0] > 0:
+        drip()
+    drive(router, reps)
+    assert len(submitted) == 12
+    for req in submitted:
+        assert req.state is RequestState.FINISHED, (req.rid, req.state)
+        ref = (seeded_reference(req.prompt.tolist(), 2, req.sampling)
+               if req.sampling is not None
+               else reference(req.prompt.tolist(), 2))
+        assert req.output_tokens == ref
+    snap = router.registry.snapshot()
+    assert snap.get("serving/requests_rejected", 0.0) == 0.0
+    assert snap["fleet/adapter_swaps"] == 2.0
+    for rep in reps:
+        # initial load + the swap's in-place overwrite
+        assert [aid for aid, _ in rep.adapter_loads] \
+            == ["tenant-a", "tenant-a"]
+        assert rep.adapter_loads[1][1] == {"seed": 7}
+    intro = router.introspect()["replicas"]
+    assert not intro["a"]["rolling"] and not intro["b"]["rolling"]

@@ -1,0 +1,358 @@
+"""apex_tpu.serving.lora — batched multi-LoRA serving (ISSUE 17).
+
+The tentpole contracts the acceptance bar names: the gathered-delta
+kernel pair (fused Pallas scalar-prefetch vs the jnp.take twin vs a
+dense host loop), the refcounted adapter arena under 200-step
+register/evict/pin churn (no slot ever strands), ``adapter_id=None``
+bitwise token-identical to the bare engine — greedy, seeded AND
+speculative with an int8 cache — zero decode/prefill recompiles across
+mixed-adapter churn including a mid-flight hot-swap and an LRU
+eviction, the unknown-adapter typed REJECTED, and the spec-layer
+adapter checkpoint restore (corrupt newest falls back).
+
+Engines are cached per shape and reused across tests (adapter mix,
+registration churn and policies are all data — the test_speculative
+reuse pattern); the shared tiny GPT comes from ``test_serving``'s
+module-level model cache.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from apex_tpu.serving import (
+    LoRAConfig,
+    SamplingParams,
+    ServingConfig,
+    SpeculativeConfig,
+)
+from apex_tpu.serving.lora import (
+    AdapterArena,
+    OutOfAdapterSlotsError,
+    adapter_shapes,
+    init_adapter_weights,
+    lora_delta_fused,
+    lora_delta_unfused,
+    pack_adapter_values,
+    restore_adapter_for_serving,
+)
+from apex_tpu.serving.scheduler import RequestState
+
+from test_serving import MAX_SEQ, VOCAB, _build_engine, _tiny_cfg, _wave
+
+# ------------------------------------------------------------- kernel
+
+
+def _dense_delta_reference(x, a, b, slots):
+    """O(everything) host loop: per batch slot, gather A/B and contract
+    in fp64 (tighter than both kernels — the arbiter)."""
+    S, B, _ = x.shape
+    out = np.zeros((S, B, b.shape[2]), np.float64)
+    for i in range(B):
+        ai = np.asarray(a[slots[i]], np.float64)
+        bi = np.asarray(b[slots[i]], np.float64)
+        out[:, i, :] = np.asarray(x[:, i, :], np.float64) @ ai @ bi
+    return out
+
+
+def test_delta_kernel_fused_matches_unfused_and_dense():
+    rng = np.random.RandomState(7)
+    S, B, IN, r, OUT, n_slots = 4, 3, 32, 4, 24, 5
+    x = jnp.asarray(rng.randn(S, B, IN), jnp.float32)
+    a = jnp.asarray(rng.randn(n_slots, IN, r), jnp.float32)
+    b = jnp.asarray(rng.randn(n_slots, r, OUT), jnp.float32)
+    # slot 0 is the zero adapter; mixed repeats exercise the gather
+    a = a.at[0].set(0.0)
+    b = b.at[0].set(0.0)
+    slots = jnp.asarray([2, 0, 4], jnp.int32)
+    fused = lora_delta_fused(x, a, b, slots)
+    unfused = lora_delta_unfused(x, a, b, slots)
+    ref = _dense_delta_reference(np.asarray(x), np.asarray(a),
+                                 np.asarray(b), np.asarray(slots))
+    np.testing.assert_allclose(np.asarray(fused), np.asarray(unfused),
+                               atol=2e-5)
+    np.testing.assert_allclose(np.asarray(fused), ref, atol=2e-4)
+    # the zero slot produces EXACT zeros — that exactness is what makes
+    # adapter_id=None bitwise the bare engine, not merely close to it
+    assert np.abs(np.asarray(fused[:, 1, :])).max() == 0.0
+
+
+# -------------------------------------------------------------- arena
+
+
+def test_arena_refcount_churn_strands_no_capacity():
+    """The satellite bar: 200 steps of register / pin / unpin /
+    unregister churn against a 4-slot arena — the allocator invariants
+    hold at every step, and after the storm drains every slot but the
+    permanent zero adapter is free again."""
+    rng = np.random.RandomState(17)
+    arena = AdapterArena(n_slots=5)          # zero slot + 4 residents
+    ids = [f"tenant-{i}" for i in range(12)]
+    live_pins = {}                            # rid -> adapter_id
+    next_rid = [0]
+    for step in range(200):
+        op = rng.randint(4)
+        if op == 0:                           # register (may LRU-evict)
+            aid = ids[rng.randint(len(ids))]
+            try:
+                slot, evicted = arena.register(aid)
+                assert 0 < slot < arena.n_slots
+                assert evicted is None or not arena.resident(evicted)
+            except OutOfAdapterSlotsError:
+                # legal exactly when every resident adapter is pinned
+                pinned = set(live_pins.values())
+                assert all(r in pinned for r in arena.residents())
+        elif op == 1 and arena.residents():   # pin a resident
+            aid = arena.residents()[rng.randint(len(arena.residents()))]
+            rid = next_rid[0]
+            next_rid[0] += 1
+            arena.pin(aid, rid)
+            live_pins[rid] = aid
+        elif op == 2 and live_pins:           # a request finishes
+            rid = list(live_pins)[rng.randint(len(live_pins))]
+            del live_pins[rid]
+            arena.unpin(rid)
+        elif op == 3 and arena.residents():   # unregister a resident
+            aid = arena.residents()[rng.randint(len(arena.residents()))]
+            arena.unregister(aid)
+        arena.check()
+    for rid in list(live_pins):
+        arena.unpin(rid)
+    for aid in list(arena.residents()):
+        arena.unregister(aid)
+    arena.check()
+    # nothing stranded: all 4 resident slots free, zero slot held
+    assert arena.allocator.n_free == arena.n_slots - 1
+    assert arena.active == 0
+    assert arena.loads > 0 and arena.evictions > 0, \
+        "the churn never exercised eviction — the test is not testing"
+
+
+def test_arena_all_pinned_raises_and_unpin_is_idempotent():
+    arena = AdapterArena(n_slots=3)           # zero slot + 2 residents
+    arena.register("a")
+    arena.register("b")
+    arena.pin("a", rid=1)
+    arena.pin("b", rid=2)
+    with pytest.raises(OutOfAdapterSlotsError, match="pinned"):
+        arena.register("c")
+    # unregistered-but-pinned: the slot survives until the last unpin
+    arena.unregister("b")
+    assert not arena.resident("b")
+    assert arena.allocator.n_free == 0        # rid=2 still holds it
+    arena.unpin(2)
+    assert arena.allocator.n_free == 1
+    slot, evicted = arena.register("c")       # now it fits
+    assert evicted is None
+    arena.unpin(2)                            # idempotent no-op
+    arena.unpin(99)                           # never-pinned no-op
+    arena.check()
+
+
+def test_pack_adapter_values_validates_shapes():
+    cfg = _tiny_cfg()
+    lora = LoRAConfig(rank=4, max_adapters=2)
+    w = init_adapter_weights(cfg, lora, seed=0)
+    vals = pack_adapter_values(cfg, lora, w, np.float32)
+    assert len(vals) == 8
+    # B comes back pre-scaled by alpha/rank
+    np.testing.assert_allclose(
+        vals[1], w["qkv"][1] * (lora.alpha / lora.rank), rtol=1e-6)
+    with pytest.raises(ValueError, match="missing projection"):
+        pack_adapter_values(cfg, lora, {"qkv": w["qkv"]}, np.float32)
+    bad = dict(w)
+    bad["fc1"] = (w["fc1"][0][:, :-1, :], w["fc1"][1])
+    with pytest.raises(ValueError, match="do not match arena"):
+        pack_adapter_values(cfg, lora, bad, np.float32)
+
+
+# ------------------------------------------------------------- engine
+
+# One cached engine per (lora, speculative+int8) shape, reused across
+# waves — registration churn, adapter mixes and sampling policies are
+# data, so reuse keeps the tier-1 compile budget flat.
+_ENGINES = {}
+
+
+def _engine(*, lora=False, spec_int8=False):
+    key = (lora, spec_int8)
+    if key not in _ENGINES:
+        _, _, eng = _build_engine(
+            tp=1, serving=ServingConfig(
+                max_batch=3, block_size=4, max_seq=MAX_SEQ,
+                prefill_len=8,
+                cache_dtype=jnp.int8 if spec_int8 else None,
+                speculative=(SpeculativeConfig(k=2, backoff=4)
+                             if spec_int8 else None),
+                lora=LoRAConfig(rank=4, max_adapters=3) if lora else None))
+        _ENGINES[key] = eng
+    return _ENGINES[key]
+
+
+def _serve(eng, wave, *, sampling=None):
+    reqs = [eng.submit(p, n, sampling=sampling) for p, n in wave]
+    eng.run_until_drained(max_steps=5000)
+    eng.scheduler.allocator.check()
+    assert eng.decode_compile_count() == 1, \
+        "adapter churn must never recompile the decode step"
+    assert eng.prefill_compile_count() == 1
+    assert all(r.state is RequestState.FINISHED for r in reqs)
+    return [r.output_tokens for r in reqs]
+
+
+def test_adapter_none_bitwise_identity_vs_bare_engine():
+    """The acceptance bar: a lora-enabled engine (adapters registered,
+    arena non-trivial) serving ``adapter_id=None`` requests emits
+    BITWISE the bare engine's streams — greedy and seeded — because the
+    zero-slot gather contributes an exact-zero delta, not a small one."""
+    bare = _engine(lora=False)
+    lora = _engine(lora=True)
+    for aid in ("tenant-a", "tenant-b"):      # non-trivial arena rows
+        lora.register_adapter(aid)
+    wave = _wave(seed=5, n=5)
+    assert _serve(lora, wave) == _serve(bare, wave)
+    sp = SamplingParams(temperature=1.2, top_p=0.9, seed=42)
+    assert _serve(lora, wave, sampling=sp) == _serve(bare, wave,
+                                                     sampling=sp)
+
+
+def test_adapter_none_identity_speculative_int8():
+    """Same identity through the hard path: speculative drafting (k=2
+    with the k+1 verify) over an int8 KV cache."""
+    bare = _engine(lora=False, spec_int8=True)
+    lora = _engine(lora=True, spec_int8=True)
+    lora.register_adapter("tenant-a")
+    wave = _wave(seed=9, n=5)
+    assert _serve(lora, wave) == _serve(bare, wave)
+    assert lora.spec_proposed > 0, \
+        "speculation never engaged — the test is not testing"
+
+
+def test_mixed_adapter_churn_zero_recompiles_and_eviction():
+    """Mixed tagged/bare batches, a mid-flight hot-swap and an LRU
+    eviction: all data, zero recompiles, distinct adapters produce
+    distinct streams, the same adapter reproduces its stream, and the
+    arena books close."""
+    eng = _engine(lora=True)
+    arena = eng.adapter_arena
+    snap0 = eng.registry.snapshot()
+    for aid in ("t0", "t1", "t2"):
+        eng.register_adapter(aid)
+    prompt = [9, 8, 7, 6]
+    reqs = {
+        aid: eng.submit(prompt, 6, sampling=SamplingParams(adapter_id=aid)
+                        if aid else None)
+        for aid in ("t0", "t1", None)
+    }
+    eng.step()                      # admit + first tokens (pins live)
+    # hot-swap t2 mid-flight (resident, unpinned: in-place, no evict)
+    eng.register_adapter("t2")
+    eng.run_until_drained(max_steps=5000)
+    # a 4th adapter LRU-evicts the coldest unpinned resident
+    eng.register_adapter("t3")
+    assert len(arena) == 3
+    late = eng.submit(prompt, 6,
+                      sampling=SamplingParams(adapter_id="t3"))
+    again = eng.submit(prompt, 6,
+                       sampling=SamplingParams(adapter_id="t0")
+                       if arena.resident("t0") else None)
+    eng.run_until_drained(max_steps=5000)
+    arena.check()
+    assert eng.decode_compile_count() == 1
+    assert eng.prefill_compile_count() == 1
+    streams = {aid: r.output_tokens for aid, r in reqs.items()}
+    # the LOUD fixture weights guarantee visible divergence per tenant
+    assert streams["t0"] != streams[None]
+    assert streams["t1"] != streams[None]
+    assert streams["t0"] != streams["t1"]
+    assert late.state is RequestState.FINISHED
+    assert late.output_tokens != streams[None]
+    if again.sampling is not None and again.sampling.adapter_id == "t0":
+        # same id -> same default seed -> same weights -> same stream
+        assert again.output_tokens == streams["t0"]
+    snap = eng.registry.snapshot()
+    assert snap["serving/adapter_loads"] - \
+        snap0.get("serving/adapter_loads", 0.0) == 5.0
+    assert snap["serving/adapter_evictions"] - \
+        snap0.get("serving/adapter_evictions", 0.0) >= 1.0
+    assert arena.active == 0        # every pin released at finish
+    intro = eng.introspect()
+    assert set(intro["adapters_resident"]) == set(arena.residents())
+    assert intro["adapter_active"] == 0
+
+
+def test_unknown_adapter_submit_typed_rejected():
+    """An unknown (or never-enabled) adapter id is refused AT THE DOOR
+    with the same typed terminal REJECTED the drain window uses — never
+    queued, never a hang, counted for the router to re-route on."""
+    eng = _engine(lora=True)
+    before = eng.registry.snapshot().get("serving/requests_rejected", 0.0)
+    ghost = eng.submit([1, 2, 3], 4,
+                       sampling=SamplingParams(adapter_id="ghost"))
+    assert ghost.state is RequestState.REJECTED and ghost.done
+    assert ghost.output_tokens == []
+    snap = eng.registry.snapshot()
+    assert snap["serving/requests_rejected"] - before == 1.0
+    assert eng.scheduler.idle        # never entered the queue
+    # a lora-less engine rejects EVERY adapter-tagged submit the same way
+    bare = _engine(lora=False)
+    before = bare.registry.snapshot().get("serving/requests_rejected", 0.0)
+    req = bare.submit([1, 2, 3], 4,
+                      sampling=SamplingParams(adapter_id="tenant-a"))
+    assert req.state is RequestState.REJECTED
+    assert bare.registry.snapshot()["serving/requests_rejected"] \
+        - before == 1.0
+    # an unregister closes the door for NEW submits of that id
+    eng.register_adapter("fleeting")
+    eng.unregister_adapter("fleeting")
+    gone = eng.submit([1, 2], 3,
+                      sampling=SamplingParams(adapter_id="fleeting"))
+    assert gone.state is RequestState.REJECTED
+
+
+# ------------------------------------------------- checkpoint restore
+
+
+def test_restore_adapter_round_trip_with_corrupt_fallback(tmp_path):
+    """The spec-layer restore path on adapter checkpoints: save two
+    steps, corrupt the newest, and the restore falls back to the intact
+    step with the weights bit-exact — then registers clean."""
+    from apex_tpu.resilience import CheckpointManager
+
+    cfg = _tiny_cfg()
+    lora = LoRAConfig(rank=4, max_adapters=2)
+    root = str(tmp_path / "adapters")
+
+    def tree(seed):
+        w = init_adapter_weights(cfg, lora, seed=seed)
+        return w, {"lora": {proj: {"a": a, "b": b}
+                            for proj, (a, b) in w.items()}}
+
+    mgr = CheckpointManager(root, sharded=False)
+    w0, t0 = tree(seed=0)
+    mgr.save(t0, 0)
+    _, t1 = tree(seed=1)
+    path1 = mgr.save(t1, 1)
+    with open(path1, "r+b") as f:             # torn newest
+        f.seek(0)
+        f.write(b"\x00" * 64)
+    weights, step = restore_adapter_for_serving(
+        root, cfg, lora, sharded=False, with_step=True)
+    assert step == 0, "corrupt newest must fall back, not fail"
+    shapes = adapter_shapes(cfg, lora)
+    for proj, (a, b) in weights.items():
+        np.testing.assert_array_equal(a, w0[proj][0], err_msg=proj)
+        np.testing.assert_array_equal(b, w0[proj][1], err_msg=proj)
+        assert a.shape == (cfg.num_layers,) + shapes[proj][0]
+    eng = _engine(lora=True)
+    slot = eng.register_adapter("restored", weights=weights)
+    assert 0 < slot < eng.lora.n_slots
+    # a wrong-rank checkpoint refuses loudly at registration
+    with pytest.raises(ValueError, match="do not match arena"):
+        eng.register_adapter(
+            "bad-rank",
+            weights=init_adapter_weights(cfg, LoRAConfig(rank=2), seed=3))
+    with pytest.raises(FileNotFoundError, match="no adapter checkpoint"):
+        restore_adapter_for_serving(str(tmp_path / "empty"), cfg, lora,
+                                    sharded=False)
