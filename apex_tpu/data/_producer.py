@@ -206,7 +206,12 @@ class ProducerLoader:
         """Tear down one iteration: stop+join its producer, then rewind
         the samplers by its undelivered batches (``st.mine``)."""
         st.stop.set()
-        if st.thread is not None:
+        # claim the thread under the lock: _finish can race itself (an
+        # iterator finalizer vs an explicit stop) and only one caller
+        # may join/drain — the loser must see None, not a torn teardown
+        with self._lock:
+            thread, st.thread = st.thread, None
+        if thread is not None:
             # unblock a producer waiting on a full queue; drained batches
             # stay counted in st.mine (they were never delivered)
             try:
@@ -214,7 +219,7 @@ class ProducerLoader:
                     st.queue.get_nowait()
             except queue.Empty:
                 pass
-            st.thread.join(timeout=5.0)
+            thread.join(timeout=5.0)
             # wake a consumer still blocked in queue.get() (a preempted
             # iterator whose producer exited without a sentinel): drain
             # anything the producer managed to enqueue before stopping,
@@ -228,7 +233,7 @@ class ProducerLoader:
                 st.queue.put_nowait(None)
             except queue.Full:
                 pass
-            if st.thread.is_alive():
+            if thread.is_alive():
                 # a producer stuck >5 s (cold memmap page-in on a slow
                 # disk) is left daemonized but must be visible, not a
                 # silently leaked thread holding the drained queue
@@ -236,7 +241,6 @@ class ProducerLoader:
                     "%s: producer thread did not exit within 5 s of stop; "
                     "leaking it as a daemon (likely blocked in a gather)",
                     type(self).__name__)
-            st.thread = None
         with self._lock:
             if st in self._active:
                 self._active.remove(st)

@@ -54,7 +54,24 @@ kind                   payload (beyond ``t`` / ``dur_s``)
 ``request_cancel``     ``rid``
 ``request_reject``     ``rid`` — refused at submit (drain window /
                        overload shed), never queued
+``autopilot_observe``  ``decision_id``, ``loop`` + the signal snapshot
+                       (queue depth, p99 trend, attribution, ...) the
+                       decision was made on (ISSUE 18)
+``autopilot_decide``   ``decision_id``, ``loop``, ``action``,
+                       ``reason`` — what the autopilot chose and why
+``autopilot_act``      ``decision_id``, ``action`` + actuation detail
+                       (``replica`` spawned/drained/quarantined, knob
+                       ``payload`` + ``canary`` host, ...)
+``autopilot_verdict``  ``decision_id``, ``verdict`` — how the decision
+                       resolved: ``joined`` / ``drained`` / ``reaped``
+                       / ``quarantined`` / ``commit`` / ``rollback`` /
+                       ``inconclusive`` / ``no action`` (+ ``ratio``,
+                       ``rounds`` for canary judges)
 =====================  ====================================================
+
+The four ``autopilot_*`` kinds share one ``decision_id`` per decision
+(observe → decide → act → verdict), so ``scripts/trace_report.py`` can
+reconstruct *why* the fleet changed shape next to the request traces.
 
 Arming is process-global and **opt-in**: the module-level
 :func:`emit`/:func:`scope` used by the instrumented subsystems

@@ -87,6 +87,7 @@ __all__ = [
     "read_fleet_spills",
     "stitch_traces",
     "summarize_traces",
+    "collect_decisions",
     "merge_dir",
     "format_trace_report",
 ]
@@ -480,15 +481,47 @@ def summarize_traces(traces: dict, *, tail_pct: float = 99.0) -> dict:
     }
 
 
+def collect_decisions(router_run: Optional[List[dict]]) -> List[dict]:
+    """(ISSUE 18) Reconstruct the autopilot's decision timeline from
+    the router spill: the four ``autopilot_*`` event kinds grouped by
+    ``decision_id`` into ``{decision_id, t, loop, action, reason,
+    verdict, events}`` rows in decision order — the "why did the fleet
+    change shape" answer printed next to the request traces."""
+    by_id: Dict[str, dict] = {}
+    for ev in router_run or []:
+        kind = ev.get("kind", "")
+        if not kind.startswith("autopilot_"):
+            continue
+        did = ev.get("decision_id")
+        rec = by_id.setdefault(did, {
+            "decision_id": did, "t": ev.get("t"), "loop": None,
+            "action": None, "reason": None, "verdict": None,
+            "events": []})
+        rec["events"].append(dict(ev))
+        if ev.get("loop") is not None:
+            rec["loop"] = ev["loop"]
+        if kind == "autopilot_decide":
+            rec["action"] = ev.get("action")
+            rec["reason"] = ev.get("reason")
+        elif kind == "autopilot_verdict":
+            rec["verdict"] = ev.get("verdict")
+    return sorted(by_id.values(),
+                  key=lambda r: (r["t"] if r["t"] is not None else 0.0,
+                                 str(r["decision_id"])))
+
+
 def merge_dir(timeline_dir: str, *, strict: bool = True,
               tail_pct: float = 99.0) -> dict:
     """The one-call merge: read a fleet run's spills, stitch, and
-    summarize — ``{"traces": {...}, "summary": {...}}``."""
+    summarize — ``{"traces": {...}, "summary": {...}, "decisions":
+    [...]}`` (``decisions`` is the autopilot's reconstructed timeline,
+    empty when no autopilot ran)."""
     router_run, replica_runs = read_fleet_spills(timeline_dir,
                                                  strict=strict)
     traces = stitch_traces(router_run, replica_runs)
     return {"traces": traces,
-            "summary": summarize_traces(traces, tail_pct=tail_pct)}
+            "summary": summarize_traces(traces, tail_pct=tail_pct),
+            "decisions": collect_decisions(router_run)}
 
 
 def format_trace_report(report: dict) -> str:
@@ -517,4 +550,14 @@ def format_trace_report(report: dict) -> str:
                 f"({row['slowest_hop_s']:.3f}s, "
                 f"attempts={row['attempts']}, "
                 f"replicas={row['replicas']})")
+    decisions = report.get("decisions") or []
+    if decisions:
+        lines.append(f"autopilot decisions: {len(decisions)}")
+        for rec in decisions:
+            verdict = rec["verdict"] if rec["verdict"] is not None \
+                else "(open)"
+            lines.append(
+                f"  {rec['decision_id']} t={rec['t']:.3f} "
+                f"[{rec['loop']}] {rec['action']} -> {verdict}"
+                + (f"  # {rec['reason']}" if rec.get("reason") else ""))
     return "\n".join(lines)

@@ -60,6 +60,14 @@ package turns those checkpoints into a *serving* runtime —
   session replay + bounded-outbox backpressure + link-RTT pings, and
   a :func:`~apex_tpu.serving.transport.replica_serve` host daemon
   wrapping the existing replica worker lifecycle.
+- :mod:`.autopilot` — the SLO autopilot (ISSUE 18): a jax-free control
+  loop beside ``FleetRouter.pump()`` that scales (spawn/drain through
+  the ready-handshake and SIGTERM-drain paths, flap quarantine under
+  capped back-off), retunes (trace attribution → live engine/router
+  knobs via acked broadcast), and canaries every knob change on one
+  replica with a paired median-of-ratios A/B judge + automatic
+  rollback — every decision a typed timeline event on an injectable
+  clock.
 
 See ``docs/serving.md`` for the architecture and cookbook.
 """
@@ -95,6 +103,11 @@ from apex_tpu.serving.engine import ServingConfig, ServingEngine
 from apex_tpu.serving.loader import restore_gpt_for_serving
 from apex_tpu.serving.replica import ReplicaProcess, ReplicaSpec
 from apex_tpu.serving.fleet import FleetRequest, FleetRouter
+from apex_tpu.serving.autopilot import (
+    AutopilotConfig,
+    FleetAutopilot,
+    trace_attribution,
+)
 from apex_tpu.serving.transport import (
     SocketTransport,
     TransportError,
@@ -105,7 +118,9 @@ from apex_tpu.serving.transport import (
 
 __all__ = [
     "AdapterArena",
+    "AutopilotConfig",
     "BlockAllocator",
+    "FleetAutopilot",
     "FleetRequest",
     "FleetRouter",
     "KVCacheConfig",
@@ -137,4 +152,5 @@ __all__ = [
     "paged_prefill_attention",
     "paged_prefill_attention_unfused",
     "restore_gpt_for_serving",
+    "trace_attribution",
 ]

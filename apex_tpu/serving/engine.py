@@ -74,7 +74,7 @@ from __future__ import annotations
 import dataclasses
 import time
 import zlib
-from typing import Any, List, Optional, Sequence, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -233,6 +233,14 @@ class ServingEngine:
             config, self.cache, fused_attention=serving.fused_attention,
             fuse_epilogue=serving.fuse_epilogue, lora=serving.lora)
         self.prefill_len = serving.prefill_len or serving.max_seq
+        # Live-retunable knobs (ISSUE 18): data-only caps an autopilot
+        # can actuate at runtime over the command wire.  Neither touches
+        # a compiled shape — the prefill call keeps its [B, T] program
+        # and the verify keeps [B, k+1]; the caps only shrink how much
+        # of each fixed-shape call is *used*, so retuning never
+        # recompiles.  None means "engine default" (the knob is unset).
+        self.live_prefill_chunk: Optional[int] = None
+        self.live_spec_k: Optional[int] = None
 
         # [vpp, pp, ...] -> [L, ...] (row-major merge == virtual-stage
         # major == plain layer order; gpt3d_logical_folds rationale)
@@ -379,6 +387,10 @@ class ServingEngine:
         self._counted_evictions = 0
         self.spec_proposed = 0         # drafted tokens (lifetime)
         self.spec_accepted = 0         # drafts accepted by the verify
+        # adapter_id -> [proposed, accepted] (ISSUE 18 satellite):
+        # per-tenant acceptance so one template-poor adapter is visible
+        # on /fleet/statusz instead of hidden inside the fleet mean
+        self.spec_by_adapter: Dict[str, List[int]] = {}
         # MFU bookkeeping (ISSUE 10 satellite): FLOPs of the decode
         # program probed once (lazily, pre-donation), last decode wall
         # time measured each step; serving/mfu flushed as a gauge when
@@ -402,6 +414,63 @@ class ServingEngine:
         """Compiled-variant count of the chunked prefill (the fixed
         ``[max_batch, prefill_len]`` chunk shape: also exactly 1)."""
         return int(self._prefill._cache_size())
+
+    # -------------------------------------------------------------- knobs
+
+    def knobs(self) -> Dict[str, Any]:
+        """Current live-knob state plus the engine's compile-time
+        bounds — the autopilot reads the bounds off the state heartbeat
+        to pick targets, and the ack of ``set_knobs`` echoes this dict
+        so the controller's committed view matches the replica's."""
+        return {"prefill_chunk": self.live_prefill_chunk,
+                "spec_k": self.live_spec_k,
+                "prefill_len": int(self.prefill_len),
+                "spec_k_max": int(self.spec_width - 1)}
+
+    def set_knobs(self, payload: Dict[str, Any]) -> Dict[str, Any]:
+        """Apply live-retunable serving knobs (ISSUE 18).
+
+        Recognized keys (each optional; ``None`` resets to the engine
+        default):
+
+        - ``prefill_chunk``: cap on tokens prefilled per slot per tick
+          (clamped to ``[1, prefill_len]``).  Shrinking it trades
+          prefill throughput for decode-tick latency when ``prefill``
+          dominates tail traces.
+        - ``spec_k``: cap on drafted tokens per tick (clamped to
+          ``[0, spec_width - 1]``; 0 disables drafting).  Lowering it
+          cuts wasted verify work when acceptance sags.
+
+        Both are data-only: the compiled [B, T] prefill and
+        [B, spec_width] verify shapes never change, so a knob change
+        never recompiles.  Unknown keys raise (a typo'd controller must
+        fail its ack, not silently no-op).  Returns :meth:`knobs` — the
+        applied state, echoed back over the ack wire."""
+        unknown = set(payload) - {"prefill_chunk", "spec_k"}
+        if unknown:
+            raise ValueError(f"unknown knobs: {sorted(unknown)}")
+        if "prefill_chunk" in payload:
+            v = payload["prefill_chunk"]
+            if v is not None:
+                v = int(v)
+                if v < 1:
+                    raise ValueError(
+                        f"prefill_chunk must be >= 1, got {v}")
+                v = min(v, int(self.prefill_len))
+            self.live_prefill_chunk = v
+            # mirror into admission's first-chunk sizing so the ask for
+            # blocks matches what the device call will actually cover
+            self.scheduler.chunk_tokens = (
+                v if v is not None else int(self.prefill_len))
+        if "spec_k" in payload:
+            v = payload["spec_k"]
+            if v is not None:
+                v = int(v)
+                if v < 0:
+                    raise ValueError(f"spec_k must be >= 0, got {v}")
+                v = min(v, int(self.spec_width - 1))
+            self.live_spec_k = v
+        return self.knobs()
 
     @property
     def draining(self) -> bool:
@@ -798,6 +867,10 @@ class ServingEngine:
             if req.slot is None or not req.prefilling:
                 continue    # preempted by an older request's growth
             chunk = min(req.prefill_target - req.cache_len, T)
+            if self.live_prefill_chunk is not None:
+                # live retune (ISSUE 18): the cap is data — the device
+                # call keeps its compiled [B, T] shape and fills less
+                chunk = min(chunk, self.live_prefill_chunk)
             covered = self.scheduler.try_grow_to(
                 req, req.cache_len + chunk)
             chunk = min(chunk, covered - req.cache_len)
@@ -871,6 +944,10 @@ class ServingEngine:
         max_k = min(self.spec_width - 1,
                     self.cache.max_seq - (req.cache_len + 1),
                     req.max_new_tokens - len(req.output_tokens) - 1)
+        if self.live_spec_k is not None:
+            # live retune (ISSUE 18): verify keeps its compiled
+            # [B, spec_width] shape; k=0 disables drafting entirely
+            max_k = min(max_k, self.live_spec_k)
         if max_k <= 0:
             return []
         return list(self.proposer.propose(req, max_k))[:max_k]
@@ -962,6 +1039,16 @@ class ServingEngine:
                 accepted_total += acc
                 if self.proposer is not None:
                     self.proposer.observe(req, len(d), acc)
+                aid = getattr(req.sampling, "adapter_id", None)
+                if aid is not None and (
+                        aid in self.spec_by_adapter
+                        or len(self.spec_by_adapter) < 256):
+                    # per-adapter acceptance (ISSUE 18 satellite) —
+                    # the signal behind LoRA-aware back-off and the
+                    # autopilot's spec-k retune; bounded key set
+                    row = self.spec_by_adapter.setdefault(aid, [0, 0])
+                    row[0] += len(d)
+                    row[1] += acc
             # rejection rollback is O(1) by construction: positions past
             # the accepted prefix were written but cache_len simply does
             # not advance over them — pointer/length moves on the host,
@@ -1054,6 +1141,11 @@ class ServingEngine:
             "spec_acceptance": (
                 round(self.spec_accepted / self.spec_proposed, 4)
                 if self.spec_proposed else None),
+            "spec_by_adapter": {
+                aid: {"proposed": int(p), "accepted": int(a),
+                      "acceptance": round(a / p, 4) if p else None}
+                for aid, (p, a) in sorted(self.spec_by_adapter.items())},
+            "knobs": self.knobs(),
             "decode_calls": self._decode_calls,
             "adapters_resident": (
                 self.adapter_arena.residents()

@@ -323,6 +323,7 @@ class FleetRouter:
                  slo_key_cap: int = 64,
                  migrate_min_remaining: int = 2,
                  migrate_max_inflight: int = 16,
+                 trend_window_s: float = 1.0, trend_windows: int = 8,
                  registry=None, clock: Callable[[], float] = time.monotonic):
         from apex_tpu.observability.metrics import default_registry
 
@@ -413,6 +414,31 @@ class FleetRouter:
         self.migrate_min_remaining = int(migrate_min_remaining)
         self.migrate_max_inflight = int(migrate_max_inflight)
         self._migrations: Dict[int, dict] = {}
+        # controller-readable p99-trend (ISSUE 18 satellite): every
+        # trend_window_s (on the injected clock) the pump snapshots the
+        # fleet TTFT/TPOT p99 into a bounded window; the least-squares
+        # slope over the last trend_windows snapshots is the "is the
+        # tail getting worse" signal — first-class on introspect() /
+        # fleet_statusz so the autopilot and external probes read the
+        # SAME number instead of each diffing histogram scrapes.
+        self.trend_window_s = float(trend_window_s)
+        self.trend_windows = int(trend_windows)
+        self._trend: Dict[str, collections.deque] = {
+            "ttft_ms": collections.deque(maxlen=self.trend_windows),
+            "tpot_ms": collections.deque(maxlen=self.trend_windows)}
+        self._trend_last_t = now
+        # per-replica SLO windows exist only while a FleetAutopilot is
+        # attached (it flips this on) — the canary judge needs paired
+        # per-replica p99s, but a disarmed fleet must observe NOTHING
+        # extra (the acceptance criterion: disarmed == the PR 17 fleet)
+        self.per_replica_slo = False
+        # live-retune broadcast acks (ISSUE 18): the adapter-ack
+        # discipline applied to set_knobs — (replica_name, token) ->
+        # (ok, info), filled by knobs_set events, consumed by the
+        # set_knobs pump-wait; tokens come from a deterministic counter
+        # so knob rounds are reproducible under injected clocks
+        self._knob_acks: Dict[tuple, tuple] = {}
+        self._knob_tokens = itertools.count(1)
 
     # ----------------------------------------------------------- tenants
 
@@ -571,6 +597,37 @@ class FleetRouter:
         self.registry.gauge("fleet/replicas_live").set(live)
         self.registry.gauge("fleet/queue_depth").set(
             self.total_queue_depth())
+        self._update_trend()
+
+    def _update_trend(self) -> None:
+        """One p99 snapshot per elapsed trend window (injected clock)."""
+        now = self._clock()
+        if now - self._trend_last_t < self.trend_window_s:
+            return
+        self._trend_last_t = now
+        for metric in ("ttft_ms", "tpot_ms"):
+            # read-only peek: never CREATE the histogram (an idle
+            # fleet's registry must stay byte-identical to a router
+            # without trend windows)
+            hist = self.registry._histograms.get(f"fleet/{metric}")
+            p99 = hist.percentile(99) if hist is not None else None
+            if p99 is not None:
+                self._trend[metric].append((now, float(p99)))
+
+    def p99_trend(self, metric: str = "tpot_ms") -> float:
+        """Slope of the windowed p99 in ms per second — least-squares
+        over the last ``trend_windows`` (t, p99) snapshots; 0.0 until
+        two windows exist.  Positive = the tail is getting worse."""
+        pts = self._trend.get(metric)
+        if pts is None or len(pts) < 2:
+            return 0.0
+        n = len(pts)
+        mt = sum(t for t, _ in pts) / n
+        mv = sum(v for _, v in pts) / n
+        denom = sum((t - mt) ** 2 for t, _ in pts)
+        if denom <= 0.0:
+            return 0.0
+        return sum((t - mt) * (v - mv) for t, v in pts) / denom
 
     # ------------------------------------------------------------- events
 
@@ -703,6 +760,12 @@ class FleetRouter:
                     self._slo_hist(
                         f"fleet/role/{view.role}/tpot_ms").observe(
                         tpot_ms)
+                if self.per_replica_slo:
+                    # canary judging (ISSUE 18): per-replica TPOT
+                    # windows exist only while an autopilot is attached
+                    self._slo_hist(
+                        f"fleet/replica/{view.name}/tpot_ms").observe(
+                        tpot_ms)
             req.t_last_token = now
             req.output_tokens.append(int(token))
         elif kind == "finished":
@@ -738,6 +801,16 @@ class FleetRouter:
             if not ok:
                 logger.warning("fleet: replica %s %s %r failed: %r",
                                view.name, kind, aid, info)
+        elif kind == "knobs_set":
+            # (ISSUE 18) live-retune verdict: recorded for the
+            # set_knobs pump-wait (the adapter-ack discipline); a
+            # refused payload is loud — the autopilot's canary treats
+            # a failed ack as a failed action, never a silent no-op
+            _, token, ok, info = ev
+            self._knob_acks[(view.name, token)] = (bool(ok), info)
+            if not ok:
+                logger.warning("fleet: replica %s set_knobs failed: %r",
+                               view.name, info)
         elif kind in ("kv_meta", "kv_block", "kv_export_done",
                       "kv_export_failed", "kv_imported"):
             self._handle_migration_event(view, ev)
@@ -1447,29 +1520,39 @@ class FleetRouter:
 
     # ------------------------------------------------- adapters (ISSUE 17)
 
-    def _await_adapter_acks(self, pairs: Sequence[tuple], *,
-                            timeout_s: float, poll_s: float = 0.002,
-                            on_tick: Optional[Callable[[], None]] = None
-                            ) -> Dict[str, tuple]:
-        """Pump until every ``(replica_name, adapter_id)`` pair has an
-        ack (or the deadline passes); a replica that dies mid-wait
-        reads as a failed ack, never a hang."""
+    def _await_acks(self, acks: Dict[tuple, tuple],
+                    pairs: Sequence[tuple], *,
+                    timeout_s: float, poll_s: float = 0.002,
+                    on_tick: Optional[Callable[[], None]] = None
+                    ) -> Dict[str, tuple]:
+        """Pump until every ``(replica_name, key)`` pair has an ack in
+        ``acks`` (or the deadline passes); a replica that dies mid-wait
+        reads as a failed ack, never a hang.  Shared by the adapter
+        broadcasts (ISSUE 17) and the live-retune broadcast (ISSUE 18)."""
         deadline = self._clock() + timeout_s
-        while any(p not in self._adapter_acks for p in pairs):
+        while any(p not in acks for p in pairs):
             self.pump()
             if on_tick is not None:
                 on_tick()
             if all(self._view_if_up(p[0]) is None or
-                   p in self._adapter_acks for p in pairs):
+                   p in acks for p in pairs):
                 break
             if self._clock() > deadline:
                 break
             time.sleep(poll_s)
         out = {}
-        for name, aid in pairs:
-            out[name] = self._adapter_acks.pop(
-                (name, aid), (False, "no ack (replica down or timeout)"))
+        for name, key in pairs:
+            out[name] = acks.pop(
+                (name, key), (False, "no ack (replica down or timeout)"))
         return out
+
+    def _await_adapter_acks(self, pairs: Sequence[tuple], *,
+                            timeout_s: float, poll_s: float = 0.002,
+                            on_tick: Optional[Callable[[], None]] = None
+                            ) -> Dict[str, tuple]:
+        return self._await_acks(self._adapter_acks, pairs,
+                                timeout_s=timeout_s, poll_s=poll_s,
+                                on_tick=on_tick)
 
     def load_adapter(self, adapter_id, *, weights=None, seed=None,
                      names: Optional[Sequence[str]] = None,
@@ -1596,6 +1679,94 @@ class FleetRouter:
                 view.rolling = False
         return results
 
+    # --------------------------------------------- live knobs (ISSUE 18)
+
+    def set_knobs(self, payload: dict, *,
+                  names: Optional[Sequence[str]] = None,
+                  timeout_s: float = 60.0,
+                  on_tick: Optional[Callable[[], None]] = None
+                  ) -> Dict[str, tuple]:
+        """Live-retune broadcast — the adapter-ack discipline applied
+        to serving knobs.  ``payload`` is what
+        :meth:`~apex_tpu.serving.engine.ServingEngine.set_knobs`
+        accepts (``prefill_chunk`` / ``spec_k``; ``None`` values reset
+        to engine defaults).  Each named replica (default: all) gets
+        one ``set_knobs`` wire command stamped with a per-call token;
+        the router pump-waits the ``knobs_set`` acks.  Returns
+        ``{replica_name: (ok, info)}`` — ``info`` is the replica's
+        applied knob state on success (the engine echo), the repr'd
+        refusal otherwise.  This is the autopilot's retune actuator:
+        canary first (``names=[one]``), fleet-wide only after the
+        canary verdict."""
+        token = next(self._knob_tokens)
+        wire = dict(payload)
+        wire["token"] = token
+        results: Dict[str, tuple] = {}
+        pairs = []
+        for name in list(names if names is not None else self._views):
+            view = self._view_if_up(name)
+            if view is None:
+                results[name] = (False, "replica down")
+                continue
+            send = getattr(view.client, "set_knobs", None)
+            if send is None:
+                results[name] = (False, "transport has no set_knobs")
+                continue
+            try:
+                send(wire)
+            except Exception as e:    # dead pipe on write
+                logger.warning("fleet: set_knobs to %s failed: %r",
+                               name, e)
+                self._mark_down(view, f"dead pipe on set_knobs: {e!r}")
+                results[name] = (False, repr(e))
+                continue
+            pairs.append((name, token))
+        results.update(self._await_acks(
+            self._knob_acks, pairs, timeout_s=timeout_s,
+            on_tick=on_tick))
+        return results
+
+    # ----------------------------------------- fleet membership (ISSUE 18)
+
+    def add_replica(self, client) -> None:
+        """Seat a new replica — the autopilot's scale-up actuator.  The
+        client joins through the ordinary ready handshake (``pump``
+        flips the view ready on its first event); until then it is not
+        dispatchable, so a half-born replica never receives work.  A
+        live name collision raises (the rollout path retires the old
+        holder first); a DOWN holder is retired in place — respawning
+        under the same name is how a dead replica is replaced."""
+        old = self._views.get(client.name)
+        if old is not None:
+            if not old.down:
+                raise ValueError(
+                    f"replica {client.name!r} is already live")
+            try:
+                old.client.close()
+            except Exception as e:  # noqa: BLE001 — already dead
+                logger.warning("fleet: closing retired %s failed: %r",
+                               client.name, e)
+        self._views[client.name] = _ReplicaView(client, self._clock())
+
+    def remove_replica(self, name: str) -> None:
+        """Retire a replica from the routing table (scale-down
+        completion, or reaping a half-born join).  A still-live holder
+        is marked down first so its in-flight requests replay through
+        the ordinary failover path — removal never strands a request.
+        Unknown names are a no-op (reap paths race with failure
+        detection)."""
+        view = self._views.pop(name, None)
+        if view is None:
+            return
+        if not view.down:
+            self._mark_down(view, "removed by controller",
+                            clean=not view.assigned)
+        try:
+            view.client.close()
+        except Exception as e:  # noqa: BLE001 — already dead
+            logger.warning("fleet: closing removed %s failed: %r",
+                           name, e)
+
     # ------------------------------------------------------- introspection
 
     def introspect(self) -> dict:
@@ -1651,6 +1822,18 @@ class FleetRouter:
             "tenant_affinity": dict(self._tenant_affinity),
             "queue_depth": self.total_queue_depth(),
             "pending": sum(len(q) for q in self._pending.values()),
+            # controller-readable signals (ISSUE 18 satellite):
+            # dispatched-but-not-yet-decoding backlog and the windowed
+            # p99 slope, first-class — the autopilot and external
+            # probes read the same numbers the scrape shows
+            "backlog": sum(v.backlog() for v in self._views.values()
+                           if not v.down),
+            "p99_trend": {
+                "ttft_ms_per_s": round(self.p99_trend("ttft_ms"), 4),
+                "tpot_ms_per_s": round(self.p99_trend("tpot_ms"), 4),
+                "windows": {m: len(d) for m, d in self._trend.items()},
+                "window_s": self.trend_window_s,
+            },
             "requests": dict(states),
             # the fleet is "draining" only when every replica is —
             # /healthz on the router stays ok through a staggered roll
@@ -1713,12 +1896,31 @@ class FleetRouter:
             if not v.down:
                 row["assigned"] += len(v.assigned)
                 row["backlog"] += v.backlog()
+        # per-adapter speculative acceptance (ISSUE 18 satellite):
+        # summed across the live replicas' state heartbeats so the
+        # template-poor tenant is visible fleet-wide, not hidden in
+        # one replica's introspect
+        spec_acc: Dict[str, List[int]] = {}
+        for v in self._views.values():
+            if v.down:
+                continue
+            rows = (v.state or {}).get("spec_by_adapter") or {}
+            for aid, row in rows.items():
+                agg = spec_acc.setdefault(str(aid), [0, 0])
+                agg[0] += int(row.get("proposed") or 0)
+                agg[1] += int(row.get("accepted") or 0)
         return {
             "replicas": base["replicas"],
             "queue_depth": base["queue_depth"],
             "pending": base["pending"],
+            "backlog": base["backlog"],
+            "p99_trend": base["p99_trend"],
             "requests": base["requests"],
             "draining": base["draining"],
+            "spec_acceptance": {
+                aid: {"proposed": p, "accepted": a,
+                      "acceptance": round(a / p, 4) if p else None}
+                for aid, (p, a) in sorted(spec_acc.items())},
             "roles": roles,
             "migrations": {
                 "inflight": len(self._migrations),

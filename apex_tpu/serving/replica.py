@@ -60,6 +60,13 @@ parent → child commands
                         — drop the registry reference (the slot frees
                           once the last active request unpins it);
                           acked by ``adapter_unloaded``
+    ``("set_knobs", payload)``
+                        — (ISSUE 18) live-retune: apply data-only
+                          engine knob caps (``prefill_chunk`` /
+                          ``spec_k`` — never a shape, never a
+                          recompile); ``payload`` also carries the
+                          router's ack ``token``.  Acked by
+                          ``knobs_set``.
 
 KV-block migration (ISSUE 16 — disaggregated prefill/decode).  The
 router relays a request's paged KV from a prefill replica to a decode
@@ -151,6 +158,12 @@ child → parent events
                                  router's ``load_adapter`` broadcast
                                  and staggered ``swap_adapter`` both
                                  pump on these acks.
+    ``("knobs_set", token, ok, info)``
+                               — (ISSUE 18) retune verdict: ``info``
+                                 is the engine's applied knob dict on
+                                 success, the repr'd error otherwise;
+                                 the router's ``set_knobs`` broadcast
+                                 pumps on these, keyed by ``token``.
     ``("error", exc)``         — relayed fatal; the child exits
 
 A SIGKILLed child never sends ``drained`` — the router sees the dead
@@ -526,6 +539,21 @@ def _replica_worker(spec: ReplicaSpec, name: str, cmd_q, evt_q,
                         else:
                             evt_q.put(("adapter_unloaded", aid, True,
                                        None))
+                    elif cmd[0] == "set_knobs":
+                        # (ISSUE 18) live retune: apply and ack with the
+                        # engine's resulting knob state — the router
+                        # pump-waits this verdict (adapter-ack
+                        # discipline); a refused payload acks False
+                        payload = dict(cmd[1] or {})
+                        token = payload.pop("token", None)
+                        try:
+                            applied = engine.set_knobs(payload)
+                        except Exception as e:  # noqa: BLE001 — verdict
+                            evt_q.put(("knobs_set", token, False,
+                                       repr(e)))
+                        else:
+                            evt_q.put(("knobs_set", token, True,
+                                       applied))
                     elif cmd[0] == "drain":
                         guard.trigger()
                     elif cmd[0] == "stop":
@@ -695,6 +723,12 @@ class ReplicaProcess:
 
     def unload_adapter(self, adapter_id) -> None:
         self._cmd.put(("unload_adapter", adapter_id))
+
+    def set_knobs(self, payload: dict) -> None:
+        """(ISSUE 18) Live-retune: ship the knob payload (plus the
+        router's ack token) to the worker; the ``knobs_set`` verdict
+        rides the ordinary event stream like the adapter acks."""
+        self._cmd.put(("set_knobs", dict(payload or {})))
 
     def begin_drain(self, *, sigterm: bool = True) -> None:
         """Start the drain: a real SIGTERM (the production rollout

@@ -41,7 +41,7 @@ adversarial drafts.
 from __future__ import annotations
 
 import dataclasses
-from typing import List, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -127,10 +127,39 @@ def ngram_propose(tokens: Sequence[int], k: int, *, max_ngram: int = 3,
 class NGramProposer:
     """Per-request adaptive wrapper over :func:`ngram_propose` — the
     engine's default proposer when ``ServingConfig.speculative`` is
-    set."""
+    set.
+
+    Back-off keying (ISSUE 18 satellite): an adapter-tagged request
+    (``req.sampling.adapter_id`` set) keys its back-off/re-arm state
+    per ``(slot, adapter_id)`` instead of per request, so one
+    template-poor tenant backing off cannot silence drafting for a
+    different adapter that later lands in the same slot — and a
+    well-predicted adapter's re-arm survives across that tenant's
+    consecutive requests.  Bare requests keep the original per-request
+    counters (``req.spec_fails`` / ``req.spec_quiet``) untouched."""
+
+    _STATE_CAP = 1024   # bounded (slot, adapter) memory
 
     def __init__(self, config: SpeculativeConfig):
         self.config = config
+        # (slot, adapter_id) -> [fails, quiet]
+        self._adapter_state: Dict[Tuple[int, str], List[int]] = {}
+
+    def _keyed(self, req) -> Optional[List[int]]:
+        """The (slot, adapter) back-off cell, or None for bare/unslotted
+        requests (those keep per-request state)."""
+        aid = getattr(req.sampling, "adapter_id", None) \
+            if req.sampling is not None else None
+        if aid is None or req.slot is None:
+            return None
+        key = (req.slot, aid)
+        cell = self._adapter_state.get(key)
+        if cell is None:
+            if len(self._adapter_state) >= self._STATE_CAP:
+                self._adapter_state.pop(
+                    next(iter(self._adapter_state)))
+            cell = self._adapter_state[key] = [0, 0]
+        return cell
 
     def propose(self, req, max_k: int) -> List[int]:
         """Draft up to ``max_k`` tokens for ``req`` (the engine has
@@ -139,11 +168,19 @@ class NGramProposer:
         proposes nothing — except one probe every ``probe_every`` quiet
         ticks, which is what makes the documented re-arm reachable (the
         engine only reports verify outcomes for ticks that drafted)."""
-        if req.spec_fails >= self.config.backoff:
-            req.spec_quiet += 1
-            if req.spec_quiet < self.config.probe_every:
+        cell = self._keyed(req)
+        fails = cell[0] if cell is not None else req.spec_fails
+        if fails >= self.config.backoff:
+            if cell is not None:
+                cell[1] += 1
+                quiet, reset = cell[1], (lambda: cell.__setitem__(1, 0))
+            else:
+                req.spec_quiet += 1
+                quiet = req.spec_quiet
+                reset = (lambda: setattr(req, "spec_quiet", 0))
+            if quiet < self.config.probe_every:
                 return []
-            req.spec_quiet = 0
+            reset()
             max_k = min(max_k, 1)   # a probe wastes ONE query position
         return ngram_propose(
             req.sequence_tokens(), max_k,
@@ -152,10 +189,14 @@ class NGramProposer:
 
     def observe(self, req, proposed: int, accepted: int) -> None:
         """Account one verify outcome: a fully-rejected proposal counts
-        toward the back-off, any acceptance re-arms the request."""
+        toward the back-off, any acceptance re-arms the request (for an
+        adapter-tagged request: re-arms the *(slot, adapter)* cell)."""
         if proposed <= 0:
             return
-        if accepted > 0:
+        cell = self._keyed(req)
+        if cell is not None:
+            cell[0] = 0 if accepted > 0 else cell[0] + 1
+        elif accepted > 0:
             req.spec_fails = 0
         else:
             req.spec_fails += 1

@@ -367,6 +367,12 @@ class SocketTransport:
     def unload_adapter(self, adapter_id) -> None:
         self._send_cmd(("unload_adapter", adapter_id))
 
+    def set_knobs(self, payload: dict) -> None:
+        """(ISSUE 18) Live-retune broadcast: one frame carrying the knob
+        payload (plus the router's ack token); the ``knobs_set`` verdict
+        rides the ordinary event stream like the adapter acks."""
+        self._send_cmd(("set_knobs", dict(payload or {})))
+
     # -------------------------------------------------------------- events
 
     def poll(self) -> list:

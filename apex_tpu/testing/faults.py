@@ -28,7 +28,11 @@ drives save→kill→resume and corrupt→fallback→resume end to end:
   throws: partition, half-open (accept-then-silence), slow link, torn
   frame, crc-corrupt frame, and reconnect churn — each deterministic
   and healable, so the socket transport's contracts are driven, not
-  asserted.
+  asserted;
+- :class:`flapping_replica` — scripted up/down churn on a ChaosProxy
+  link or a test double (ISSUE 18): deterministic edges on an injected
+  clock, so the autopilot's flap-quarantine is driven by the same fake
+  clock that drives its decisions.
 
 Everything restores global state on exit; the context managers are
 reentrancy-hostile by design (one fault at a time — compose scenarios
@@ -59,6 +63,7 @@ __all__ = [
     "hung_writes",
     "simulate_sigterm",
     "ChaosProxy",
+    "flapping_replica",
 ]
 
 
@@ -540,3 +545,99 @@ def simulate_sigterm(pid: Optional[int] = None) -> None:
     drain flag; without one, default signal disposition applies — so
     install the guard first."""
     os.kill(os.getpid() if pid is None else pid, signal.SIGTERM)
+
+
+# ---------------------------------------------------------------------------
+# Flapping replica (ISSUE 18)
+# ---------------------------------------------------------------------------
+
+
+class flapping_replica:
+    """Scripted up/down churn — the fault the SLO autopilot's
+    quarantine exists for.  Wraps anything with a down/up actuator
+    pair and toggles it on a deterministic schedule read off an
+    injected clock:
+
+    - a :class:`ChaosProxy` link: ``down = partition``, ``up = heal``
+      (auto-detected);
+    - a test double (e.g. the fleet tests' ``FakeReplica``): pass
+      ``down=replica.fail, up=replica.revive`` (or any callables);
+      ``fail``/``revive`` attribute pairs are auto-detected too.
+
+    The schedule is pure arithmetic on the clock — first :meth:`tick`
+    pins ``t0``; edges land at ``t0 + k * period_s`` and each edge
+    flips the state (even k → down, odd k → up), so the same fake
+    clock replays the same churn run after run.  A driver loop calls
+    :meth:`tick` as often as it likes; missed edges are applied in
+    order on the next call.  ``max_flaps`` bounds the churn: after
+    that many down-edges the replica is brought (and stays) up, so a
+    test can assert the autopilot quarantined it *during* the storm
+    and releases it after back-off.
+
+    ``flaps`` counts down-edges applied so far; :meth:`stop` ends the
+    churn and restores up.
+    """
+
+    def __init__(self, target=None, *, down=None, up=None,
+                 period_s: float = 1.0, max_flaps: Optional[int] = None,
+                 clock=time.monotonic):
+        if target is not None:
+            if down is None:
+                down = getattr(target, "partition", None) or \
+                    getattr(target, "fail", None)
+            if up is None:
+                up = getattr(target, "heal", None) or \
+                    getattr(target, "revive", None)
+        if down is None or up is None:
+            raise TypeError(
+                "flapping_replica needs a down/up actuator pair "
+                "(ChaosProxy, a fail/revive double, or explicit "
+                "down=/up= callables)")
+        if period_s <= 0:
+            raise ValueError(f"period_s must be > 0, got {period_s}")
+        self._down, self._up = down, up
+        self.period_s = float(period_s)
+        self.max_flaps = max_flaps
+        self._clock = clock
+        self._t0: Optional[float] = None
+        self._edges = 0          # schedule edges consumed
+        self.flaps = 0           # down-edges applied
+        self.is_down = False
+        self._stopped = False
+
+    def tick(self) -> bool:
+        """Apply every schedule edge at or before ``clock()``; returns
+        the current down-ness.  Call from the same loop that pumps the
+        router/autopilot."""
+        if self._stopped:
+            return self.is_down
+        now = self._clock()
+        if self._t0 is None:
+            self._t0 = now
+        while self._t0 + self._edges * self.period_s <= now:
+            if self.max_flaps is not None and \
+                    self.flaps >= self.max_flaps:
+                self.stop()
+                return self.is_down
+            self._edges += 1
+            if self.is_down:
+                self._up()
+                self.is_down = False
+            else:
+                self._down()
+                self.is_down = True
+                self.flaps += 1
+        return self.is_down
+
+    def stop(self) -> None:
+        """End the churn and leave the replica up."""
+        self._stopped = True
+        if self.is_down:
+            self._up()
+            self.is_down = False
+
+    def __enter__(self) -> "flapping_replica":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

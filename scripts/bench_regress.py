@@ -93,6 +93,10 @@ TOLERANCES = {
     # absolute rate couples to host load twice over; the gated signal
     # is the vs_bare_1adapter floor below
     "serving_lora": 0.6,
+    # four replica processes timesharing a CPU host: the absolute
+    # burst token rate is scheduling-noise bound; the gated signal is
+    # the vs_static floor below
+    "serving_autopilot": 0.6,
 }
 
 # Hard ceilings on whitelist fields — standing acceptance gates, not
@@ -118,6 +122,10 @@ FLOORS = {
     # bare engine's decode rate — the gathered delta rides the tick,
     # it must not own it
     ("serving_lora", "vs_bare_1adapter"): 0.9,
+    # ISSUE 18: the SLO autopilot must beat the static fleet it
+    # operates on the burst tail it exists to protect — paired
+    # median-of-ratios of p99 TTFT, static / autopilot
+    ("serving_autopilot", "vs_static"): 1.0,
 }
 
 

@@ -20,6 +20,8 @@
 # tests/test_aux_subsystems.py like the PR 8/9 smokes.
 #
 # Usage: scripts/fleet_smoke.sh
+#   FLEET_SMOKE_PHASES=ABC skips the socket-chaos phase D (fast tier;
+#   the slow-tier twin runs ABCD — ISSUE 18 tier-budget satellite).
 set -euo pipefail
 
 REPO="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"

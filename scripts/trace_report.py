@@ -23,6 +23,12 @@ kv_migrate = the disaggregation handoff (ISSUE 16): KV export on the
 prefill replica + the per-block relay + the import commit, from
 ``fleet_migrate_start`` to the dispatch onto the decode replica.
 
+When an SLO autopilot ran (ISSUE 18), the router spill also carries
+its typed decision events; the report appends the reconstructed
+decision timeline (``apN [loop] action -> verdict  # reason``) so the
+"why did the fleet change shape" answer prints next to the request
+traces that made it.
+
 Usage::
 
     python scripts/trace_report.py <spill-dir>            # human block

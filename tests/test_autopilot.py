@@ -1,0 +1,737 @@
+"""apex_tpu.serving.autopilot — the SLO control loop, hermetically
+(ISSUE 18).
+
+Every decision path of :class:`FleetAutopilot` is driven against the
+fleet tests' in-memory :class:`FakeReplica` on an injected fake clock —
+no process spawn, no jax, no wall time.  The fault matrix rows pinned
+here: a flapping replica is quarantined under capped back-off (never
+respawned in a hot loop), a slow link is demoted-not-scaled, a tenant
+burst scales up and drains back, a partition during scale-up reaps the
+half-born replica, and a canary host dying mid-observation yields an
+inconclusive verdict with no rollback storm.  Determinism is pinned
+directly: the same scripted signals produce the identical decision
+sequence, run after run — and a fleet WITHOUT an autopilot emits no
+event, no counter, and no per-replica histogram (disarmed is free).
+"""
+
+import pytest
+
+from apex_tpu.observability import timeline
+from apex_tpu.observability.timeline import FlightRecorder
+from apex_tpu.serving.autopilot import AutopilotConfig, FleetAutopilot
+
+from test_fleet import FakeReplica, drive, make_router
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += float(dt)
+
+
+def make_fleet(names, *, clock, router_kw=None, **ap_kw):
+    """Router + autopilot over FakeReplicas on one fake clock."""
+    reps = {n: FakeReplica(n) for n in names}
+    router = make_router(list(reps.values()), clock=clock,
+                         **(router_kw or {}))
+    ap = FleetAutopilot(router, clock=clock, **ap_kw)
+    router.pump()        # consume ready handshakes + first heartbeats
+    return router, ap, reps
+
+
+def decision_kinds(ap, kind=None):
+    if kind is None:
+        return [(d["kind"], d.get("action"), d.get("verdict"))
+                for d in ap.decisions]
+    return [d for d in ap.decisions if d["kind"] == kind]
+
+
+def counters(router, prefix="fleet/autopilot/"):
+    return {k: v for k, v in router.registry.snapshot().items()
+            if k.startswith(prefix)}
+
+
+# ------------------------------------------------------------ scale loop
+
+
+def burst(router, n, max_new=3):
+    return [router.submit([3, 5, 7 + i], max_new) for i in range(n)]
+
+
+def test_tenant_burst_scales_up_then_drains_back():
+    """The burst row of the fault matrix: queue depth over threshold
+    grows the pool through the ordinary ready handshake; once the
+    burst drains and the tail is flat, the autopilot drains the
+    spawned replica back — and no request is ever lost or left
+    non-terminal."""
+    clk = FakeClock()
+    spawned = []
+
+    def spawn(name):
+        rep = FakeReplica(name)
+        spawned.append(rep)
+        return rep
+
+    cfg = AutopilotConfig(min_replicas=1, max_replicas=3,
+                          scale_up_queue_depth=4,
+                          scale_down_queue_depth=1,
+                          scale_cooldown_s=5.0)
+    router, ap, reps = make_fleet(["a"], clock=clk, spawn=spawn,
+                                  config=cfg)
+    reqs = burst(router, 6)
+    router.pump()
+    ap.tick()
+    assert [r.name for r in spawned] == ["auto1"]
+    assert "auto1" in router._views
+    assert ap.introspect()["joining"] == ["auto1"]
+    router.pump()        # ready handshake arrives
+    ap.tick()
+    assert ap.introspect()["joining"] == []
+    joined = [d for d in ap.decisions
+              if d.get("verdict") == "joined"]
+    assert [d["replica"] for d in joined] == ["auto1"]
+    # burst served to completion across the grown pool
+    drive(router, [reps["a"], spawned[0]])
+    assert all(r.done for r in reqs)
+    # pressure gone, cool-down elapsed: drain the spawned replica back
+    clk.advance(10.0)
+    ap.tick()
+    assert ap.introspect()["draining"] == ["auto1"]
+    router.pump()
+    ap.tick()
+    assert "auto1" not in router._views
+    assert [d["verdict"] for d in ap.decisions
+            if d["kind"] == "autopilot_verdict"][-1] == "drained"
+    snap = counters(router)
+    assert snap["fleet/autopilot/scale_up"] == 1
+    assert snap["fleet/autopilot/scale_down"] == 1
+    # min pool respected: the seed replica was never the drain victim
+    assert not reps["a"].draining
+    # one scale action per cool-down: the next tick does nothing
+    before = len(ap.decisions)
+    ap.tick()
+    assert len(ap.decisions) == before
+
+
+def test_scale_capped_at_max_replicas_and_cooldown():
+    clk = FakeClock()
+    spawned = []
+
+    def spawn(name):
+        rep = FakeReplica(name)
+        spawned.append(rep)
+        return rep
+
+    cfg = AutopilotConfig(min_replicas=1, max_replicas=2,
+                          scale_up_queue_depth=2, scale_cooldown_s=5.0)
+    router, ap, reps = make_fleet(["a"], clock=clk, spawn=spawn,
+                                  config=cfg)
+    burst(router, 8)
+    router.pump()
+    ap.tick()            # spawns auto1
+    router.pump()
+    ap.tick()            # auto1 joined; still deep, but cooling
+    assert len(spawned) == 1
+    clk.advance(10.0)
+    router.pump()
+    ap.tick()            # cool-down over, but pool is at max
+    assert len(spawned) == 1
+
+
+def test_slow_link_demoted_not_scaled():
+    """A rising p99 slope explained by a degraded link must NOT grow
+    the pool — placement already demotes the slow replica; the
+    explicit null decision is the proof the signal was read."""
+    clk = FakeClock()
+    spawned = []
+    cfg = AutopilotConfig(min_replicas=2, scale_up_queue_depth=100,
+                          scale_up_trend_ms_per_s=5.0,
+                          scale_cooldown_s=5.0)
+    router, ap, reps = make_fleet(
+        ["a", "b"], clock=clk,
+        spawn=lambda n: spawned.append(n) or FakeReplica(n),
+        config=cfg)
+    # a steep injected p99 slope + one degraded link
+    router._trend["tpot_ms"].extend(
+        [(0.0, 10.0), (0.5, 20.0), (1.0, 30.0)])
+    router._views["b"].link_degraded = True
+    ap.tick()
+    assert spawned == []
+    none = [d for d in ap.decisions
+            if d["kind"] == "autopilot_decide"]
+    assert len(none) == 1 and none[0]["action"] == "none"
+    assert "degraded link" in none[0]["reason"]
+    # the null decision is throttled, not re-emitted every tick
+    ap.tick()
+    assert len([d for d in ap.decisions
+                if d["kind"] == "autopilot_decide"]) == 1
+    # link heals -> the same trend NOW scales
+    router._views["b"].link_degraded = False
+    clk.advance(10.0)
+    ap.tick()
+    assert spawned == ["auto1"]
+
+
+def test_flapping_replica_quarantined_with_capped_backoff():
+    """The flap row: a replica that keeps dying is respawned at most
+    ``flap_threshold`` times inside the window, then QUARANTINED under
+    exponential back-off — never a respawn hot loop.  The quarantine
+    releases after the back-off and doubles on relapse."""
+    clk = FakeClock()
+    spawned = []
+
+    def spawn(name):
+        rep = FakeReplica(name)
+        spawned.append(rep)
+        return rep
+
+    cfg = AutopilotConfig(min_replicas=2, flap_threshold=3,
+                          flap_window_s=100.0, quarantine_base_s=30.0,
+                          quarantine_cap_s=120.0)
+    router, ap, reps = make_fleet(["a", "b"], clock=clk, spawn=spawn,
+                                  config=cfg)
+
+    def kill_current_b():
+        (spawned[-1] if spawned else reps["b"]).kill()
+        router.pump()            # failure detection marks it down
+
+    for edge in range(3):
+        kill_current_b()
+        clk.advance(1.0)
+        ap.tick()                # notes the edge; respawns (or not)
+        router.pump()            # respawned b's ready handshake
+        ap.tick()
+    # 3 edges in the window: quarantined after 2 respawns, and the
+    # 3rd death did NOT respawn
+    assert len(spawned) == 2
+    snap = counters(router)
+    assert snap["fleet/autopilot/quarantines"] == 1
+    assert snap["fleet/autopilot/respawns"] == 2
+    assert "b" in ap.introspect()["quarantined"]
+    # hot-loop check: ticking inside the quarantine never respawns
+    for _ in range(5):
+        clk.advance(1.0)
+        ap.tick()
+    assert len(spawned) == 2
+    # back-off elapses: repair resumes
+    clk.advance(40.0)
+    ap.tick()
+    assert len(spawned) == 3
+    assert ap.introspect()["quarantined"] == {}
+
+
+def test_partition_during_scale_up_reaps_half_born_replica():
+    """The partition row: a spawned replica that dies before its ready
+    handshake is REAPED — removed from the routing table, counted,
+    never dispatched to and never leaked — and the burst still
+    completes on the survivor."""
+    clk = FakeClock()
+
+    class HalfBorn(FakeReplica):
+        def __init__(self, name):
+            super().__init__(name)
+            self._events = []        # partitioned before the hello
+
+    spawned = []
+
+    def spawn(name):
+        rep = HalfBorn(name)
+        spawned.append(rep)
+        return rep
+
+    cfg = AutopilotConfig(min_replicas=1, scale_up_queue_depth=4,
+                          scale_cooldown_s=100.0)
+    router, ap, reps = make_fleet(["a"], clock=clk, spawn=spawn,
+                                  config=cfg)
+    reqs = burst(router, 6)
+    router.pump()
+    ap.tick()                        # scale_up: spawns auto1
+    assert [r.name for r in spawned] == ["auto1"]
+    spawned[0].kill()                # the partition
+    router.pump()                    # dead pipe -> down verdict
+    ap.tick()                        # join pump reaps it
+    assert "auto1" not in router._views
+    snap = counters(router)
+    assert snap["fleet/autopilot/reaps"] == 1
+    reaped = [d for d in ap.decisions
+              if d.get("verdict") == "reaped"]
+    assert len(reaped) == 1 and reaped[0]["replica"] == "auto1"
+    assert reaped[0]["reason"] == "died before ready"
+    # nothing was ever dispatched to the half-born replica
+    assert spawned[0].submissions == []
+    # and no request was lost: the survivor serves the whole burst
+    drive(router, [reps["a"]])
+    assert all(r.done for r in reqs)
+
+
+def test_min_pool_repair_respawns_dead_replica():
+    clk = FakeClock()
+    spawned = []
+
+    def spawn(name):
+        rep = FakeReplica(name)
+        spawned.append(rep)
+        return rep
+
+    router, ap, reps = make_fleet(
+        ["a", "b"], clock=clk, spawn=spawn,
+        config=AutopilotConfig(min_replicas=2))
+    reps["b"].kill()
+    router.pump()
+    ap.tick()
+    assert [r.name for r in spawned] == ["b"]
+    router.pump()
+    ap.tick()
+    assert [d["verdict"] for d in ap.decisions
+            if d["kind"] == "autopilot_verdict"] == ["joined"]
+    assert counters(router)["fleet/autopilot/respawns"] == 1
+    live = [n for n, v in router._views.items() if not v.down]
+    assert live == ["a", "b"]
+
+
+def test_spawn_failure_is_a_verdict_not_a_crash():
+    clk = FakeClock()
+
+    def spawn(name):
+        raise RuntimeError("no capacity")
+
+    router, ap, reps = make_fleet(
+        ["a"], clock=clk, spawn=spawn,
+        config=AutopilotConfig(min_replicas=1, scale_up_queue_depth=2,
+                               scale_cooldown_s=1.0))
+    burst(router, 4)
+    router.pump()
+    ap.tick()
+    failed = [d for d in ap.decisions
+              if d.get("verdict") == "spawn failed"]
+    assert len(failed) == 1
+    assert "no capacity" in failed[0]["reason"]
+
+
+# ----------------------------------------------------------- retune loop
+
+
+def canary_fleet(clk, *, attribution=None, names=("a", "b", "c"),
+                 **cfg_kw):
+    cfg_kw.setdefault("min_replicas", len(names))
+    cfg_kw.setdefault("retune_cooldown_s", 60.0)
+    cfg_kw.setdefault("canary_observe_s", 10.0)
+    cfg_kw.setdefault("canary_rounds", 5)
+    cfg_kw.setdefault("canary_min_rounds", 3)
+    return make_fleet(list(names), clock=clk,
+                      attribution=attribution,
+                      config=AutopilotConfig(**cfg_kw))
+
+
+def observe_tpot(router, name, values):
+    h = router._slo_hist(f"fleet/replica/{name}/tpot_ms")
+    for v in values:
+        h.observe(float(v))
+
+
+def run_canary_window(clk, ap, rounds=5, step=2.0):
+    for _ in range(rounds):
+        clk.advance(step)
+        ap.tick()
+
+
+def test_prefill_retune_canary_commits_when_healthy():
+    """prefill dominates the tail -> shrink ``prefill_chunk`` on ONE
+    canary replica; a non-regressing paired window commits the knob
+    fleet-wide (every decision stage a typed event under one id)."""
+    clk = FakeClock()
+    attr = {"slowest_hop": "prefill", "share": 0.9, "tail": 10}
+    router, ap, reps = canary_fleet(clk, attribution=lambda: attr)
+    ap.tick()
+    # canary = first live name; controls untouched so far
+    assert reps["a"].knob_calls == [{"prefill_chunk": 64}]
+    assert reps["b"].knob_calls == []
+    assert ap.introspect()["canary"]["payload"] == {"prefill_chunk": 64}
+    # healthy observation: canary p99 == control p99
+    observe_tpot(router, "a", [10.0] * 8)
+    observe_tpot(router, "b", [10.0] * 8)
+    observe_tpot(router, "c", [10.0] * 8)
+    run_canary_window(clk, ap)
+    assert ap.introspect()["canary"] is None
+    assert ap.knobs == {"prefill_chunk": 64}
+    # committed to the controls too
+    assert reps["b"].knob_calls == [{"prefill_chunk": 64}]
+    assert reps["c"].knob_calls == [{"prefill_chunk": 64}]
+    snap = counters(router)
+    assert snap["fleet/autopilot/commits"] == 1
+    assert "fleet/autopilot/rollbacks" not in snap
+    verdict = [d for d in ap.decisions
+               if d["kind"] == "autopilot_verdict"][-1]
+    assert verdict["verdict"] == "commit"
+    # the whole decision shares one id across its four stages
+    did = verdict["decision_id"]
+    stages = [d["kind"] for d in ap.decisions
+              if d["decision_id"] == did]
+    assert stages == ["autopilot_observe", "autopilot_decide",
+                      "autopilot_act", "autopilot_verdict"]
+
+
+def test_regressing_canary_rolls_back_automatically():
+    """The acceptance-criteria leg: a deliberately-regressing knob
+    change is rolled back automatically, and the rollback is visible
+    as a typed decision event."""
+    clk = FakeClock()
+    attr = {"slowest_hop": "prefill", "share": 1.0, "tail": 4}
+    router, ap, reps = canary_fleet(clk, attribution=lambda: attr)
+    ap.tick()
+    assert reps["a"].live_knobs["prefill_chunk"] == 64
+    # the canary regresses: its paired p99 is 10x the controls'
+    observe_tpot(router, "a", [100.0] * 8)
+    observe_tpot(router, "b", [10.0] * 8)
+    observe_tpot(router, "c", [10.0] * 8)
+    run_canary_window(clk, ap)
+    # rolled back on the canary, never applied to the controls
+    assert reps["a"].live_knobs["prefill_chunk"] is None
+    assert reps["b"].knob_calls == []
+    assert ap.knobs == {}
+    snap = counters(router)
+    assert snap["fleet/autopilot/rollbacks"] == 1
+    verdict = [d for d in ap.decisions
+               if d["kind"] == "autopilot_verdict"][-1]
+    assert verdict["verdict"] == "rollback"
+    assert verdict["ratio"] > 1.2
+    assert verdict["rolled_back"] == {"prefill_chunk": None}
+
+
+def test_canary_host_death_is_inconclusive_no_rollback_storm():
+    """The canary-death row: the host dying mid-observation yields
+    verdict ``inconclusive`` — no rollback broadcast (the knob died
+    with the host), no repeat verdicts, and the retune loop stays
+    cooled down."""
+    clk = FakeClock()
+    attr = {"slowest_hop": "prefill", "share": 1.0, "tail": 4}
+    router, ap, reps = canary_fleet(clk, attribution=lambda: attr)
+    ap.tick()
+    assert ap.introspect()["canary"]["canary"] == "a"
+    reps["a"].kill()
+    router.pump()                    # down verdict
+    clk.advance(2.0)
+    ap.tick()
+    snap = counters(router)
+    assert snap["fleet/autopilot/inconclusive"] == 1
+    assert "fleet/autopilot/rollbacks" not in snap
+    verdicts = [d for d in ap.decisions
+                if d["kind"] == "autopilot_verdict"]
+    assert [v["verdict"] for v in verdicts] == ["inconclusive"]
+    assert verdicts[0]["reason"] == "canary host died mid-observation"
+    # no rollback storm: further ticks emit no more verdicts and no
+    # knob traffic to the survivors
+    for _ in range(5):
+        clk.advance(2.0)
+        ap.tick()
+    assert len([d for d in ap.decisions
+                if d["kind"] == "autopilot_verdict"]) == 1
+    assert reps["b"].knob_calls == [] and reps["c"].knob_calls == []
+
+
+def test_too_few_samples_is_inconclusive_and_restores():
+    clk = FakeClock()
+    attr = {"slowest_hop": "prefill", "share": 1.0, "tail": 4}
+    router, ap, reps = canary_fleet(clk, attribution=lambda: attr)
+    ap.tick()
+    # no per-replica samples at all -> every paired sample is None
+    clk.advance(20.0)
+    ap.tick()
+    verdict = [d for d in ap.decisions
+               if d["kind"] == "autopilot_verdict"][-1]
+    assert verdict["verdict"] == "inconclusive"
+    assert verdict["restored"] is True
+    # the live canary was restored to the committed state (None)
+    assert reps["a"].live_knobs["prefill_chunk"] is None
+    assert counters(router)["fleet/autopilot/inconclusive"] == 1
+
+
+def test_spec_acceptance_sag_lowers_spec_k():
+    clk = FakeClock()
+    router, ap, reps = canary_fleet(clk, attribution=lambda: None)
+    reps["b"].spec_acceptance = 0.1          # below the 0.3 floor
+    for rep in reps.values():
+        rep._emit_state()
+    router.pump()
+    ap.tick()
+    assert reps["a"].knob_calls == [{"spec_k": 3}]   # spec_k_max - 1
+    decide = [d for d in ap.decisions
+              if d["kind"] == "autopilot_decide"][-1]
+    assert "spec acceptance" in decide["reason"]
+
+
+def test_router_queue_retune_tightens_shed_bound():
+    """router_queue dominating the tail tightens ``max_queue_depth``
+    (shed earlier, protect admitted tails), judged before/after on the
+    fleet window since the knob is router-local."""
+    clk = FakeClock()
+    attr = {"slowest_hop": "router_queue", "share": 0.8, "tail": 5}
+    router, ap, reps = canary_fleet(clk, attribution=lambda: attr)
+    base = router.max_queue_depth
+    # a stable fleet p99 window: before == after -> commit
+    h = router._slo_hist("fleet/tpot_ms")
+    for _ in range(8):
+        h.observe(10.0)
+    ap.tick()
+    assert router.max_queue_depth == base // 2
+    run_canary_window(clk, ap)
+    assert router.max_queue_depth == base // 2       # committed
+    assert counters(router)["fleet/autopilot/commits"] == 1
+
+
+def test_retune_cooldown_gates_one_knob_change_per_window():
+    clk = FakeClock()
+    attr = {"slowest_hop": "prefill", "share": 1.0, "tail": 4}
+    router, ap, reps = canary_fleet(clk, attribution=lambda: attr,
+                                    retune_cooldown_s=100.0)
+    ap.tick()
+    observe_tpot(router, "a", [10.0] * 8)
+    observe_tpot(router, "b", [10.0] * 8)
+    run_canary_window(clk, ap)
+    assert counters(router)["fleet/autopilot/retunes"] == 1
+    ap.tick()                        # still cooling: no second canary
+    assert counters(router)["fleet/autopilot/retunes"] == 1
+    assert ap.introspect()["canary"] is None
+
+
+# --------------------------------------------------------- determinism
+
+
+def scripted_run():
+    """One full scripted scenario: burst -> scale -> flap -> retune."""
+    clk = FakeClock()
+    spawned = {}
+
+    def spawn(name):
+        rep = FakeReplica(name)
+        spawned[name] = rep
+        return rep
+
+    attr = {"slowest_hop": "prefill", "share": 1.0, "tail": 4}
+    cfg = AutopilotConfig(min_replicas=2, max_replicas=4,
+                          scale_up_queue_depth=4, scale_cooldown_s=5.0,
+                          retune_cooldown_s=3.0, canary_observe_s=4.0,
+                          canary_rounds=2, canary_min_rounds=2)
+    router, ap, reps = make_fleet(["a", "b"], clock=clk, spawn=spawn,
+                                  config=cfg,
+                                  attribution=lambda: dict(attr))
+    reqs = burst(router, 6)
+    router.pump()
+    ap.tick()                                    # scale up
+    router.pump()
+    ap.tick()                                    # joined
+    drive(router, [reps["a"], reps["b"]] + list(spawned.values()))
+    # SLO token timing is wall-clock by design (real serving latency);
+    # the determinism pin judges the canary on injected samples only
+    for h in router.registry._histograms.values():
+        h._samples.clear()
+    clk.advance(6.0)
+    ap.tick()                                    # scale down
+    router.pump()
+    ap.tick()                                    # drained
+    clk.advance(6.0)
+    ap.tick()                                    # retune canary opens
+    observe_tpot(router, "a", [10.0] * 4)
+    observe_tpot(router, "b", [10.0] * 4)
+    for _ in range(2):
+        clk.advance(2.0)
+        ap.tick()                                # canary judged
+    reps["b"].kill()
+    router.pump()
+    clk.advance(1.0)
+    ap.tick()                                    # down edge + repair
+    assert all(r.done for r in reqs)
+    return ap.decisions
+
+
+def test_same_signals_same_decision_sequence():
+    """The reproducibility criterion: two runs of the identical
+    scripted scenario on identical injected clocks produce the
+    byte-identical decision timeline — ids, times, reasons, verdicts
+    and all."""
+    first = scripted_run()
+    second = scripted_run()
+    assert first == second
+    assert len(first) > 8            # the script actually decided things
+    kinds = {d["kind"] for d in first}
+    assert kinds == {"autopilot_observe", "autopilot_decide",
+                     "autopilot_act", "autopilot_verdict"}
+
+
+# -------------------------------------------------------- disarmed-inert
+
+
+def test_disarmed_fleet_is_untouched():
+    """No autopilot constructed -> no decision event, no
+    ``fleet/autopilot/*`` counter, no per-replica SLO histogram: the
+    PR 17 fleet, byte for byte."""
+    rec = FlightRecorder(None)
+    timeline.arm(rec)
+    try:
+        clk = FakeClock()
+        reps = [FakeReplica("a"), FakeReplica("b")]
+        router = make_router(reps, clock=clk)
+        reqs = burst(router, 6)
+        drive(router, reps)
+        assert all(r.done for r in reqs)
+        assert router.per_replica_slo is False
+        snap = router.registry.snapshot()
+        assert not any("autopilot" in k for k in snap)
+        assert not any(k.startswith("fleet/replica/")
+                       for k in router.registry._histograms)
+        assert [e for e in rec.events()
+                if e["kind"].startswith("autopilot_")] == []
+    finally:
+        timeline.disarm()
+
+
+def test_armed_autopilot_emits_timeline_decisions():
+    """Armed, every decision rides the trace plane: the four typed
+    kinds land in the flight recorder with their shared decision_id,
+    and ``trace.collect_decisions`` reconstructs the timeline."""
+    from apex_tpu.observability.trace import collect_decisions
+
+    rec = FlightRecorder(None)
+    timeline.arm(rec)
+    try:
+        clk = FakeClock()
+        router, ap, reps = make_fleet(
+            ["a"], clock=clk, spawn=FakeReplica,
+            config=AutopilotConfig(min_replicas=1,
+                                   scale_up_queue_depth=2))
+        burst(router, 4)
+        router.pump()
+        ap.tick()
+        router.pump()
+        ap.tick()
+        evs = [e for e in rec.events()
+               if e["kind"].startswith("autopilot_")]
+        assert {e["kind"] for e in evs} == {
+            "autopilot_observe", "autopilot_decide",
+            "autopilot_act", "autopilot_verdict"}
+        rows = collect_decisions(evs)
+        assert len(rows) == 1
+        assert rows[0]["action"] == "scale_up"
+        assert rows[0]["verdict"] == "joined"
+        assert len(rows[0]["events"]) == 4
+    finally:
+        timeline.disarm()
+
+
+# ------------------------------------------- controller-readable signals
+
+
+def test_knob_broadcast_acks_and_down_replica():
+    clk = FakeClock()
+    reps = [FakeReplica("a"), FakeReplica("b")]
+    router = make_router(reps, clock=clk)
+    router.pump()
+    res = router.set_knobs({"prefill_chunk": 16})
+    assert res["a"][0] and res["b"][0]
+    assert res["a"][1]["prefill_chunk"] == 16
+    reps[1].kill()
+    router.pump()
+    res = router.set_knobs({"spec_k": 1})
+    assert res["a"][0] is True
+    assert res["b"] == (False, "replica down")
+    # a refusal is a failed ack carrying the reason, not a hang
+    reps[0].refuse_knobs = True
+    res = router.set_knobs({"spec_k": 0})
+    assert res["a"][0] is False and "refused" in res["a"][1]
+
+
+def test_statusz_trend_backlog_and_spec_acceptance():
+    """ISSUE 18 satellites 1+2: the windowed p99 slope, the backlog
+    gauge, and per-adapter speculative acceptance are first-class
+    controller-readable fields on introspect()/fleet_statusz()."""
+    clk = FakeClock()
+    reps = [FakeReplica("a"), FakeReplica("b")]
+    router = make_router(reps, clock=clk, trend_window_s=1.0)
+    reps[0].spec_by_adapter = {"t1": {"proposed": 10, "accepted": 5}}
+    reps[1].spec_by_adapter = {"t1": {"proposed": 10, "accepted": 1},
+                               "t2": {"proposed": 4, "accepted": 4}}
+    for rep in reps:
+        rep._emit_state()
+    router.pump()
+    # rising p99 across three trend windows on the injected clock
+    h = router._slo_hist("fleet/tpot_ms")
+    for v in (10.0, 20.0, 30.0):
+        h.observe(v)
+        clk.advance(1.1)
+        router.pump()
+    assert router.p99_trend("tpot_ms") > 0
+    intro = router.introspect()
+    assert intro["p99_trend"]["tpot_ms_per_s"] > 0
+    assert intro["p99_trend"]["windows"]["tpot_ms"] == 3
+    assert intro["backlog"] == 0
+    statusz = router.fleet_statusz()
+    assert statusz["p99_trend"] == intro["p99_trend"]
+    assert statusz["backlog"] == 0
+    acc = statusz["spec_acceptance"]
+    assert acc["t1"] == {"proposed": 20, "accepted": 6,
+                         "acceptance": 0.3}
+    assert acc["t2"]["acceptance"] == 1.0
+    # backlog rises with dispatched-but-not-decoding requests
+    burst(router, 3)
+    router.pump()
+    assert router.introspect()["backlog"] == 3
+
+
+# ----------------------------------------------- flapping_replica helper
+
+
+def test_flapping_replica_helper_deterministic_schedule():
+    from apex_tpu.testing.faults import flapping_replica
+
+    clk = FakeClock()
+    log = []
+    flap = flapping_replica(down=lambda: log.append("down"),
+                            up=lambda: log.append("up"),
+                            period_s=1.0, max_flaps=2, clock=clk)
+    assert flap.tick() is True            # t0 edge: down
+    clk.advance(0.5)
+    assert flap.tick() is True            # mid-period: unchanged
+    clk.advance(0.5)
+    assert flap.tick() is False           # edge: back up
+    clk.advance(1.0)
+    assert flap.tick() is True            # second flap
+    clk.advance(1.0)
+    assert flap.tick() is False
+    clk.advance(5.0)
+    assert flap.tick() is False           # max_flaps reached: stays up
+    assert log == ["down", "up", "down", "up"]
+    assert flap.flaps == 2
+
+
+def test_flapping_replica_helper_autodetects_fake_replica():
+    from apex_tpu.testing.faults import flapping_replica
+
+    clk = FakeClock()
+    rep = FakeReplica("a")
+    with flapping_replica(rep, period_s=1.0, clock=clk) as flap:
+        flap.tick()
+        assert rep.alive() is False
+        clk.advance(1.0)
+        flap.tick()
+        assert rep.alive() is True
+    assert rep.alive() is True            # exit restores up
+    with pytest.raises(TypeError, match="actuator"):
+        flapping_replica(object())
+
+
+def test_config_validation():
+    with pytest.raises(ValueError, match="min_replicas"):
+        AutopilotConfig(min_replicas=0)
+    with pytest.raises(ValueError, match="max_replicas"):
+        AutopilotConfig(min_replicas=4, max_replicas=2)
+    with pytest.raises(ValueError, match="prefill_shrink"):
+        AutopilotConfig(prefill_shrink=1.5)
+    with pytest.raises(ValueError, match="flap_threshold"):
+        AutopilotConfig(flap_threshold=1)
+    with pytest.raises(ValueError, match="queue_bound_step"):
+        AutopilotConfig(queue_bound_step=1.0)

@@ -5,7 +5,7 @@ last JSON line.  Rounds 1-4 all delivered ``parsed: null`` because the
 full record line grew past the tail size.  These tests pin the fix: every
 emission ends with a compact line that (a) is <= 1500 bytes, (b) parses,
 (c) carries the driver contract fields, and (d) survives a simulated
-2000-byte tail even in the worst case (all eighteen BENCH_ORDER rows
+2000-byte tail even in the worst case (all nineteen BENCH_ORDER rows
 verbose — including ``real_data_rn50`` with its ``vs_synthetic``
 composition, ``zero_adam_step`` with ``vs_per_leaf``, ``tp_gpt``
 with its overlap_comm A/B fields (``overlap_tokens_per_sec`` /
@@ -17,8 +17,9 @@ tokens/sec + p50/p99 TPOT sub-rows and ``vs_unfused``,
 ``vs_reserve`` and the prefix-cache TTFT A/B, ``serving_fleet``
 with its steady/roll p99-TPOT pair and ``roll_vs_steady``, and
 ``serving_spec`` with its speculative-vs-baseline curve,
-``vs_baseline`` and ``mean_accept_len`` — + embedded prior TPU
-evidence).
+``vs_baseline`` and ``mean_accept_len``, and ``serving_autopilot``
+with its burst-TTFT A/B (``vs_static``) and drain-back timing — +
+embedded prior TPU evidence).
 """
 
 import io
@@ -32,7 +33,7 @@ import bench  # noqa: E402
 
 
 def _worst_case_results():
-    """All eighteen BENCH_ORDER rows, each fattened with prose fields,
+    """All nineteen BENCH_ORDER rows, each fattened with prose fields,
     like a CPU-fallback day — the REAL worst case (the pre-fix nine-row
     set under-tested the <=1500-byte guarantee once ``real_data_rn50``,
     ``zero_adam_step``, ``ckpt_save_restore``, ``ckpt_reshard``,
@@ -95,6 +96,13 @@ def _worst_case_results():
                              "1": 120.5, "4": 478.4, "8": 954.7},
                          "vs_baseline_at": {"1": 2.969, "4": 2.547,
                                             "8": 2.256}},
+        "serving_autopilot": {"value": 612.4, "unit": "tokens/sec",
+                              "p99_ttft_ms_burst": 112.6,
+                              "p99_ttft_ms_static": 403.5,
+                              "p99_tpot_ms_burst": 9.4,
+                              "vs_static": 3.583,
+                              "actions": 4,
+                              "recover_s": 9.7},
         "gpt_flash_fp8": {"value": 4112.3, "unit": "tokens/sec/chip"},
         "gpt_long_context": {"value": 2580.7, "unit": "tokens/sec/chip"},
         "input_pipeline": {
@@ -145,11 +153,14 @@ def test_compact_record_under_1500_bytes():
     assert compact["rows"]["ckpt_save_restore"]["vs_sharded"] == 1.113
     assert compact["rows"]["ckpt_reshard"]["vs_same_mesh"] == 1.74
     assert compact["rows"]["telemetry_overhead"]["vs_bare"] == 1.012
-    # ISSUE 9 serving sub-rows survive the distillation
+    # ISSUE 9 serving sub-rows survive the distillation; at the worst
+    # case the per-concurrency curves degrade to their top point (the
+    # headline the gates read) — the full record keeps the full curves
     sv = compact["rows"]["serving"]
     assert sv["vs_unfused"] == 1.31
     assert sv["tokens_per_sec_at"]["8"] == 1843.7
     assert sv["tpot_p99_ms_at"]["8"] == 9.8
+    assert record["extras"]["serving"]["tokens_per_sec_at"]["1"] == 241.2
     # ISSUE 12 occupancy sub-rows survive the distillation
     # (``preemptions_at`` stays in the full record only)
     oc = compact["rows"]["serving_occupancy"]
@@ -176,6 +187,18 @@ def test_compact_record_under_1500_bytes():
     assert sp["mean_accept_len"] == 4.0
     assert sp["tokens_per_sec_at"]["8"] == 2154.2
     assert record["extras"]["serving_spec"]["acceptance_rate"] == 0.933
+    # ISSUE 18 autopilot sub-rows: the worst case sheds everything but
+    # the gated A/B ratio — the absolute burst/static TTFTs, drain-back
+    # wall, and action count all stay in the full record
+    apn = compact["rows"]["serving_autopilot"]
+    assert apn["vs_static"] == 3.583
+    assert "p99_ttft_ms_burst" not in apn
+    assert "recover_s" not in apn
+    assert "p99_ttft_ms_static" not in apn
+    extras_ap = record["extras"]["serving_autopilot"]
+    assert extras_ap["p99_ttft_ms_burst"] == 112.6
+    assert extras_ap["recover_s"] == 9.7
+    assert extras_ap["actions"] == 4
     # ISSUE 8 input-pipeline sub-rows survive the distillation
     ip = compact["rows"]["input_pipeline"]
     assert ip["loader_ips_per_backend"]["process"] == 9685.0

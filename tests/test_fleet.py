@@ -101,6 +101,14 @@ class FakeReplica:
         self.adapters = set(adapters)       # resident adapter ids
         self.adapter_loads = []             # (adapter_id, payload) log
         self.refuse_adapter = False
+        # --- ISSUE 18 autopilot surface ---
+        self.prefill_len = 128              # engine default (knob base)
+        self.spec_k_max = 4
+        self.live_knobs = {"prefill_chunk": None, "spec_k": None}
+        self.knob_calls = []                # payload log (token popped)
+        self.refuse_knobs = False
+        self.spec_acceptance = None         # None = no drafting stats
+        self.spec_by_adapter = {}
         self._emit_state()
 
     # --- client surface -------------------------------------------------
@@ -227,6 +235,25 @@ class FakeReplica:
         self._events.append(("adapter_unloaded", adapter_id, True, None))
         self._emit_state()
 
+    # --- ISSUE 18 knob surface (live retune) ---
+
+    def set_knobs(self, payload):
+        if not self._alive:
+            raise BrokenPipeError("dead replica")
+        payload = dict(payload or {})
+        token = payload.pop("token", None)
+        self.knob_calls.append(dict(payload))
+        if self.refuse_knobs:
+            self._events.append(("knobs_set", token, False,
+                                 "fake knobs refused"))
+            return
+        self.live_knobs.update(payload)
+        applied = dict(self.live_knobs,
+                       prefill_len=self.prefill_len,
+                       spec_k_max=self.spec_k_max)
+        self._events.append(("knobs_set", token, True, applied))
+        self._emit_state()
+
     def begin_drain(self, **kw):
         self.draining = True
         for frid, *_ in self.waiting:
@@ -242,6 +269,14 @@ class FakeReplica:
     def kill(self):
         self._alive = False
 
+    # fail/revive: the flapping_replica helper's auto-detected
+    # actuator pair (testing/faults.py, ISSUE 18)
+    def fail(self):
+        self._alive = False
+
+    def revive(self):
+        self._alive = True
+
     # --- fake engine ----------------------------------------------------
 
     def _emit_state(self):
@@ -254,6 +289,11 @@ class FakeReplica:
             "kv_pending_imports": len(self.pending_imports),
             "kv_exports_pinned": len(self.exports),
             "adapters_resident": sorted(self.adapters),
+            "spec_acceptance": self.spec_acceptance,
+            "spec_by_adapter": dict(self.spec_by_adapter),
+            "knobs": dict(self.live_knobs,
+                          prefill_len=self.prefill_len,
+                          spec_k_max=self.spec_k_max),
         }))
 
     def _maybe_finish_drain(self):

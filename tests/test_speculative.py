@@ -96,6 +96,60 @@ class TestNGramPropose:
         with pytest.raises(ValueError, match="probe_every"):
             SpeculativeConfig(probe_every=0)
 
+    def test_backoff_keyed_per_slot_adapter(self):
+        """ISSUE 18 satellite: adapter-tagged requests key back-off per
+        ``(slot, adapter_id)`` — one template-poor adapter backing off
+        neither touches the bare per-request counters nor silences a
+        different adapter sharing the slot later."""
+        prop = NGramProposer(SpeculativeConfig(k=2, backoff=2,
+                                               probe_every=3))
+
+        def tagged(rid, slot, aid):
+            req = Request(rid=rid,
+                          prompt=np.asarray([1, 2, 1, 2], np.int32),
+                          max_new_tokens=8,
+                          sampling=SamplingParams(adapter_id=aid))
+            req.slot = slot
+            return req
+
+        poor = tagged(0, slot=3, aid="poor")
+        for _ in range(2):
+            assert prop.propose(poor, 2) == [1, 2]
+            prop.observe(poor, 2, 0)
+        assert prop.propose(poor, 2) == []       # (3, "poor") backed off
+        # the bare per-request counters were NEVER touched
+        assert poor.spec_fails == 0 and poor.spec_quiet == 0
+        # a different adapter landing in the SAME slot drafts at full k
+        rich = tagged(1, slot=3, aid="rich")
+        assert prop.propose(rich, 2) == [1, 2]
+        # ... and the poor adapter's NEXT request (same slot) inherits
+        # the cell: still silenced, probe on the 3rd quiet tick
+        poor2 = tagged(2, slot=3, aid="poor")
+        assert prop.propose(poor2, 2) == []      # quiet 2
+        assert prop.propose(poor2, 2) == [1]     # quiet 3: the probe
+        prop.observe(poor2, 1, 1)                # accepted: cell re-arms
+        assert prop.propose(poor2, 2) == [1, 2]
+        # a bare request in the same engine keeps per-request state
+        bare = Request(rid=3, prompt=np.asarray([1, 2, 1, 2], np.int32),
+                       max_new_tokens=8)
+        assert prop.propose(bare, 2) == [1, 2]
+        prop.observe(bare, 2, 0)
+        assert bare.spec_fails == 1
+
+    def test_keyed_state_capped(self):
+        """The (slot, adapter) table is bounded: the oldest cell is
+        evicted at the cap, never unbounded growth."""
+        prop = NGramProposer(SpeculativeConfig(k=2))
+        cap = NGramProposer._STATE_CAP
+        for i in range(cap + 7):
+            req = Request(rid=i,
+                          prompt=np.asarray([1, 2, 1, 2], np.int32),
+                          max_new_tokens=8,
+                          sampling=SamplingParams(adapter_id=f"a{i}"))
+            req.slot = i % 8
+            prop.propose(req, 2)
+        assert len(prop._adapter_state) <= cap
+
 
 # ------------------------------------------------------------- kernel
 
