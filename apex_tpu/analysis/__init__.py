@@ -23,6 +23,15 @@ Two inspection tiers (``docs/analysis.md`` has the full rulebook):
   ``collective-permute`` pair well-formedness (APX202), ``conditional``
   survival for the sentinel-guarded apply (APX203), and the
   donation/aliasing audit (APX204).
+- **control tier** (:mod:`~apex_tpu.analysis.control_plane`): AST lint
+  over the jax-free serving control plane — wire-protocol completeness
+  across both transports (APX301), timeline event-schema closure
+  (APX302), metric-catalog drift against the docs tables (APX303), and
+  cross-thread lock discipline (APX304).
+- **stability tier** (:mod:`~apex_tpu.analysis.stability`): the APX305
+  jit-stability lint — each registered serving program traced at N
+  churn configurations must produce one identical jaxpr structure hash
+  ("churn is data, not shape" as a gated invariant).
 
 Entry points:
 
@@ -60,6 +69,15 @@ from apex_tpu.analysis.runner import (  # noqa: F401
     lint_hlo,
     lint_traced,
 )
+from apex_tpu.analysis.control_plane import (  # noqa: F401
+    ControlCtx,
+    run_control_plane,
+)
+from apex_tpu.analysis.stability import (  # noqa: F401
+    StabilityCtx,
+    run_stability,
+    structure_hash,
+)
 
 __all__ = [
     "ERROR",
@@ -78,4 +96,9 @@ __all__ = [
     "analyze_program",
     "lint_traced",
     "lint_hlo",
+    "ControlCtx",
+    "run_control_plane",
+    "StabilityCtx",
+    "run_stability",
+    "structure_hash",
 ]

@@ -39,7 +39,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--list-entries", action="store_true")
     ap.add_argument("--list-rules", action="store_true")
     ap.add_argument("--json", action="store_true",
-                    help="emit findings as a JSON array")
+                    help="emit one structured JSON object (findings, "
+                         "per-entry counts, verdict) on stdout")
     args = ap.parse_args(argv)
 
     from apex_tpu.analysis.registry import RULEBOOK
@@ -51,22 +52,31 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     # entry builders import jax lazily; platform must be pinned first
     _ensure_platform()
+    from apex_tpu.analysis.control_plane import run_control_plane
     from apex_tpu.analysis.entries import ENTRIES, run_entry
     from apex_tpu.analysis.findings import Report
+    from apex_tpu.analysis.stability import run_stability
+
+    # the graph entries plus the two whole-tier pseudo-entries: the
+    # control tier (AST lint over the serving sources) and the
+    # stability tier (churn-sweep traces of the serving programs)
+    runners = dict.fromkeys(ENTRIES, run_entry)
+    runners["control_plane"] = lambda _name: run_control_plane()
+    runners["stability"] = lambda _name: run_stability()
 
     if args.list_entries:
-        for name in ENTRIES:
+        for name in runners:
             print(name)
         return 0
 
     if args.all_entries:
-        names = list(ENTRIES)
+        names = list(runners)
     elif args.entries:
         names = [n.strip() for n in args.entries.split(",") if n.strip()]
-        unknown = [n for n in names if n not in ENTRIES]
+        unknown = [n for n in names if n not in runners]
         if unknown:
             print(f"unknown entries: {unknown} "
-                  f"(known: {list(ENTRIES)})", file=sys.stderr)
+                  f"(known: {list(runners)})", file=sys.stderr)
             return 2
     else:
         ap.print_help()
@@ -74,18 +84,28 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     report = Report()
     n_programs = 0
+    per_entry = []
     for name in names:
-        sub, n = run_entry(name)
+        sub, n = runners[name](name)
         n_programs += n
         report.extend(sub)
+        e, w, _ = sub.counts()
+        per_entry.append({"name": name, "programs": n,
+                          "errors": e, "warnings": w})
         if not args.json:
-            e, w, _ = sub.counts()
             status = "FAIL" if sub.errors() else "ok"
             print(f"[{status}] {name}: {n} program(s), "
                   f"{e} error(s), {w} warning(s)")
 
     if args.json:
-        print(json.dumps([vars(f) for f in report], indent=1))
+        e, w, i = report.counts()
+        print(json.dumps({
+            "verdict": "FAIL" if e else "PASS",
+            "rules": len(RULEBOOK),
+            "counts": {"errors": e, "warnings": w, "info": i},
+            "entries": per_entry,
+            "findings": [vars(f) for f in report],
+        }, indent=1))
     elif report.findings:
         print(report.format())
     e, w, _ = report.counts()

@@ -1,0 +1,741 @@
+"""APX3xx — the control-plane tier: AST lint over the serving fleet.
+
+The jaxpr/HLO tiers guard the *graph*; every recent production-class bug
+lived in the jax-free half of the system instead — the PR 15 wire drift
+(one transport's submit tuple grew a 6th element, the other's did not),
+the PR 16 false-DOWN, the PR 18 ``_producer`` teardown race.  These
+rules mechanize those postmortems the same way APX1xx/2xx mechanized the
+shard_map ones: parse the serving/observability sources (and the docs
+catalog tables) and check the cross-file contracts no unit test owns.
+
+- **APX301** wire-protocol completeness: every command tuple a client
+  transport sends has exactly one ``_replica_worker`` handler, and BOTH
+  transports (socket and in-proc) carry the same command set at the
+  same tuple arity.
+- **APX302** event-schema closure: every timeline event kind emitted
+  anywhere is consumed by the trace/goodput mergers or explicitly
+  listed in ``trace.TRACE_UNATTRIBUTED_KINDS`` (and that allowlist
+  cannot go stale); the autopilot's decision events form exactly the
+  observe/decide/act/verdict set, stamped with a ``decision_id``.
+- **APX303** metric-catalog drift: every ``serving/*`` / ``fleet/*``
+  metric name flushed by the engine/router/autopilot appears in the
+  docs catalog tables, and every catalog row names a metric the code
+  actually emits — both directions, so the docs cannot rot.
+- **APX304** lock/teardown discipline: an attribute mutated from more
+  than one thread domain (a ``threading.Thread`` target's call graph
+  vs. everything else) must be written under the object's lock or be
+  single-assignment.
+
+All rules are *total*: a rule skips silently when the sources it needs
+are absent from the :class:`ControlCtx`, so red-fixture tests can feed
+one rule an injected violation without tripping its neighbours.
+``run_control_plane()`` (the ``control_plane`` pseudo-entry of
+``python -m apex_tpu.analysis``) runs the tier over the real tree.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from apex_tpu.analysis.findings import ERROR, Finding, Report
+from apex_tpu.analysis.registry import register, rules_for
+
+__all__ = ["ControlCtx", "run_control_plane"]
+
+_PKG = Path(__file__).resolve().parents[1]       # apex_tpu/
+_ROOT = _PKG.parent                              # repo root (docs/ lives here)
+
+# The logical file set each rule keys on.  ControlCtx.sources maps these
+# names to source text; a missing name makes the rules that need it skip.
+_WIRE_CLIENT_SOCKET = "serving/transport.py"
+_WIRE_CLIENT_INPROC = "serving/replica.py"
+_EVENT_EMITTERS = (
+    "serving/engine.py", "serving/fleet.py", "serving/autopilot.py",
+    "serving/scheduler.py", "serving/replica.py", "data/prefetch.py",
+    "resilience/manager.py", "observability/timeline.py",
+)
+_EVENT_CONSUMERS = ("observability/trace.py", "observability/goodput.py")
+_METRIC_EMITTERS = (
+    "serving/engine.py", "serving/fleet.py", "serving/autopilot.py",
+)
+_THREAD_FILES = (
+    "serving/transport.py", "data/_producer.py", "data/prefetch.py",
+)
+_METRIC_DOCS = ("docs/serving.md", "docs/observability.md")
+
+_SOURCE_FILES = sorted({
+    _WIRE_CLIENT_SOCKET, _WIRE_CLIENT_INPROC,
+    *_EVENT_EMITTERS, *_EVENT_CONSUMERS, *_METRIC_EMITTERS, *_THREAD_FILES,
+})
+
+
+@dataclasses.dataclass
+class ControlCtx:
+    """Parsed inputs for the control tier: python sources keyed by their
+    ``apex_tpu``-relative path and markdown docs keyed repo-relative.
+    Tests inject violation fixtures by building one with only the files
+    a single rule reads."""
+
+    sources: Dict[str, str]
+    docs: Dict[str, str]
+
+    def __post_init__(self):
+        self._trees: Dict[str, ast.Module] = {}
+
+    @classmethod
+    def default(cls) -> "ControlCtx":
+        sources = {}
+        for rel in _SOURCE_FILES:
+            p = _PKG / rel
+            if p.exists():
+                sources[rel] = p.read_text()
+        docs = {}
+        for rel in _METRIC_DOCS:
+            p = _ROOT / rel
+            if p.exists():
+                docs[rel] = p.read_text()
+        return cls(sources=sources, docs=docs)
+
+    def tree(self, name: str) -> Optional[ast.Module]:
+        if name not in self.sources:
+            return None
+        if name not in self._trees:
+            self._trees[name] = ast.parse(self.sources[name], filename=name)
+        return self._trees[name]
+
+
+# --------------------------------------------------------------------------
+# shared AST helpers
+# --------------------------------------------------------------------------
+
+def _is_self_attr(node: ast.AST, attr: Optional[str] = None) -> bool:
+    return (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"
+            and (attr is None or node.attr == attr))
+
+
+def _const_str(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _fstring_pattern(node: ast.AST) -> Optional[str]:
+    """A str constant or f-string as a segment pattern: every
+    ``{interpolation}`` becomes a ``*`` wildcard segment piece."""
+    s = _const_str(node)
+    if s is not None:
+        return s
+    if isinstance(node, ast.JoinedStr):
+        out = []
+        for part in node.values:
+            if isinstance(part, ast.Constant):
+                out.append(str(part.value))
+            else:
+                out.append("*")
+        return "".join(out)
+    return None
+
+
+def _class_defs(tree: ast.Module) -> Dict[str, ast.ClassDef]:
+    return {n.name: n for n in tree.body if isinstance(n, ast.ClassDef)}
+
+
+def _non_docstrings(tree: ast.AST) -> Iterable[ast.Constant]:
+    """Every string constant that is not a docstring/bare-expression."""
+    doc_pos = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Expr) and isinstance(node.value, ast.Constant):
+            doc_pos.add(id(node.value))
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Constant) and isinstance(node.value, str)
+                and id(node) not in doc_pos):
+            yield node
+
+
+# --------------------------------------------------------------------------
+# APX301 — wire-protocol completeness
+# --------------------------------------------------------------------------
+
+def _sent_socket(cls: ast.ClassDef) -> Dict[str, Set[int]]:
+    """Commands the socket client sends: ``self._send_cmd((name, ...))``
+    plus raw ``("cmd", seq, (name, ...))`` frame literals (the stop
+    path, which bypasses ``_send_cmd`` to pin its own sequence)."""
+    out: Dict[str, Set[int]] = {}
+    for node in ast.walk(cls):
+        if (isinstance(node, ast.Call)
+                and _is_self_attr(node.func, "_send_cmd")
+                and node.args and isinstance(node.args[0], ast.Tuple)):
+            tup = node.args[0]
+            name = _const_str(tup.elts[0]) if tup.elts else None
+            if name is not None:
+                out.setdefault(name, set()).add(len(tup.elts))
+        if isinstance(node, ast.Tuple) and len(node.elts) == 3 \
+                and _const_str(node.elts[0]) == "cmd" \
+                and isinstance(node.elts[2], ast.Tuple):
+            tup = node.elts[2]
+            name = _const_str(tup.elts[0]) if tup.elts else None
+            if name is not None:
+                out.setdefault(name, set()).add(len(tup.elts))
+    return out
+
+
+def _sent_inproc(cls: ast.ClassDef) -> Dict[str, Set[int]]:
+    """Commands the in-proc client sends: ``self._cmd.put[_nowait](
+    (name, ...))``."""
+    out: Dict[str, Set[int]] = {}
+    for node in ast.walk(cls):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in ("put", "put_nowait")
+                and _is_self_attr(node.func.value, "_cmd")):
+            continue
+        if node.args and isinstance(node.args[0], ast.Tuple):
+            tup = node.args[0]
+            name = _const_str(tup.elts[0]) if tup.elts else None
+            if name is not None:
+                out.setdefault(name, set()).add(len(tup.elts))
+    return out
+
+
+def _worker_handlers(fn: ast.FunctionDef) -> Dict[str, int]:
+    """``cmd[0] == "name"`` dispatch arms in the worker, with counts."""
+    out: Dict[str, int] = {}
+    for node in ast.walk(fn):
+        if not (isinstance(node, ast.Compare) and len(node.ops) == 1
+                and isinstance(node.ops[0], ast.Eq)):
+            continue
+        left = node.left
+        if not (isinstance(left, ast.Subscript)
+                and isinstance(left.value, ast.Name)):
+            continue
+        idx = left.slice
+        if not (isinstance(idx, ast.Constant) and idx.value == 0):
+            continue
+        name = _const_str(node.comparators[0])
+        if name is not None:
+            out[name] = out.get(name, 0) + 1
+    return out
+
+
+@register("APX301", tier="control", title="wire-protocol-completeness",
+          catches="a transport command with no worker handler, a dead "
+                  "handler, or the two transports drifting in command "
+                  "set / tuple arity",
+          motivation="PR 15: the socket submit tuple grew a 6th element "
+                     "the in-proc transport (and a stale worker) never "
+                     "learned about — caught in integration, not lint")
+def _apx301(ctx: ControlCtx):
+    t_tree = ctx.tree(_WIRE_CLIENT_SOCKET)
+    r_tree = ctx.tree(_WIRE_CLIENT_INPROC)
+    if t_tree is None or r_tree is None:
+        return
+    sock_cls = _class_defs(t_tree).get("SocketTransport")
+    proc_cls = _class_defs(r_tree).get("ReplicaProcess")
+    worker = next((n for n in ast.walk(r_tree)
+                   if isinstance(n, ast.FunctionDef)
+                   and n.name == "_replica_worker"), None)
+    if sock_cls is None or proc_cls is None or worker is None:
+        return
+
+    sock = _sent_socket(sock_cls)
+    proc = _sent_inproc(proc_cls)
+    handlers = _worker_handlers(worker)
+    loc_w = f"{_WIRE_CLIENT_INPROC}:_replica_worker"
+
+    for name, count in sorted(handlers.items()):
+        if count > 1:
+            yield Finding(
+                rule="APX301", severity=ERROR, location=loc_w,
+                message=f"command {name!r} has {count} dispatch arms — "
+                        "exactly one handler per command",
+                remediation="collapse the duplicate arm; the first match "
+                            "shadows the rest silently")
+    sent = set(sock) | set(proc)
+    for name in sorted(sent - set(handlers)):
+        senders = [k for k, d in (("socket", sock), ("in-proc", proc))
+                   if name in d]
+        yield Finding(
+            rule="APX301", severity=ERROR, location=loc_w,
+            message=f"command {name!r} is sent by the {'/'.join(senders)} "
+                    "transport but has no _replica_worker handler",
+            remediation="add the dispatch arm (or delete the dead send); "
+                        "an unhandled command is dropped on the floor at "
+                        "the replica")
+    for name in sorted(set(handlers) - sent):
+        yield Finding(
+            rule="APX301", severity=ERROR, location=loc_w,
+            message=f"handler for {name!r} is dead: no transport sends it",
+            remediation="delete the arm or wire the missing client send — "
+                        "a one-sided protocol change is exactly the PR 15 "
+                        "drift")
+    for name in sorted(set(sock) & set(proc)):
+        if sock[name] != proc[name]:
+            yield Finding(
+                rule="APX301", severity=ERROR,
+                location=f"{_WIRE_CLIENT_SOCKET}:SocketTransport",
+                message=f"command {name!r} arity drift: socket sends "
+                        f"{sorted(sock[name])} elements, in-proc sends "
+                        f"{sorted(proc[name])}",
+                remediation="grow BOTH client tuples (and the worker "
+                            "unpack) in the same change")
+    for name in sorted(set(sock) ^ set(proc)):
+        have = "socket" if name in sock else "in-proc"
+        lack = "in-proc" if name in sock else "socket"
+        yield Finding(
+            rule="APX301", severity=ERROR,
+            location=f"{_WIRE_CLIENT_SOCKET}:SocketTransport",
+            message=f"command {name!r} exists on the {have} transport "
+                    f"only — the {lack} transport cannot express it",
+            remediation="both transports must carry the same command set "
+                        "so a fleet can swap transports without losing "
+                        "protocol surface")
+
+
+# --------------------------------------------------------------------------
+# APX302 — event-schema closure
+# --------------------------------------------------------------------------
+
+_EMIT_ATTRS = ("emit", "scope", "_emit")
+_DECISION_KINDS = frozenset({
+    "autopilot_observe", "autopilot_decide",
+    "autopilot_act", "autopilot_verdict",
+})
+
+
+def _emitted_kinds(ctx: ControlCtx) -> Dict[str, str]:
+    """kind -> "file:line" of one emission site, over every emitter."""
+    out: Dict[str, str] = {}
+    for fname in _EVENT_EMITTERS:
+        tree = ctx.tree(fname)
+        if tree is None:
+            continue
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _EMIT_ATTRS and node.args):
+                continue
+            kind = _const_str(node.args[0])
+            if kind is not None:
+                out.setdefault(kind, f"{fname}:{node.lineno}")
+    return out
+
+
+def _consumed_strings(ctx: ControlCtx) -> Tuple[Set[str], Set[str]]:
+    """(string constants, startswith prefixes) over the consumers."""
+    consts: Set[str] = set()
+    prefixes: Set[str] = set()
+    for fname in _EVENT_CONSUMERS:
+        tree = ctx.tree(fname)
+        if tree is None:
+            continue
+        for node in _non_docstrings(tree):
+            consts.add(node.value)
+        for node in ast.walk(tree):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "startswith" and node.args):
+                p = _const_str(node.args[0])
+                if p is not None:
+                    prefixes.add(p)
+    return consts, prefixes
+
+
+def _unattributed_allowlist(ctx: ControlCtx) -> Optional[Dict[str, str]]:
+    tree = ctx.tree("observability/trace.py")
+    if tree is None:
+        return None
+    for node in tree.body:
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == "TRACE_UNATTRIBUTED_KINDS"
+                and isinstance(node.value, ast.Dict)):
+            out = {}
+            for k, v in zip(node.value.keys, node.value.values):
+                ks = _const_str(k)
+                if ks is not None:
+                    out[ks] = _const_str(v) or ""
+            return out
+    return {}
+
+
+@register("APX302", tier="control", title="event-schema-closure",
+          catches="a timeline event kind no consumer attributes (or a "
+                  "stale unattributed allowlist entry); an autopilot "
+                  "decision record missing its observe/decide/act/"
+                  "verdict closure or its decision_id stamp",
+          motivation="PR 15/18: trace merge and autopilot verdicts only "
+                     "work if every emitted kind lands in a consumer "
+                     "bucket — a typo'd kind silently vanishes from "
+                     "every report")
+def _apx302(ctx: ControlCtx):
+    emitted = _emitted_kinds(ctx)
+    if not emitted or not any(ctx.tree(f) is not None
+                              for f in _EVENT_CONSUMERS):
+        return
+    consts, prefixes = _consumed_strings(ctx)
+    allow = _unattributed_allowlist(ctx) or {}
+
+    for kind, loc in sorted(emitted.items()):
+        if kind in consts or kind in allow:
+            continue
+        if any(kind.startswith(p) for p in prefixes):
+            continue
+        yield Finding(
+            rule="APX302", severity=ERROR, location=loc,
+            message=f"timeline kind {kind!r} is emitted but no consumer "
+                    "(trace merge / goodput attribution) references it",
+            remediation="attribute it in trace.py/goodput.py, or list it "
+                        "in trace.TRACE_UNATTRIBUTED_KINDS with the "
+                        "reason it is a marker, not an interval")
+    for kind in sorted(allow):
+        if kind not in emitted:
+            yield Finding(
+                rule="APX302", severity=ERROR,
+                location="observability/trace.py:TRACE_UNATTRIBUTED_KINDS",
+                message=f"allowlist entry {kind!r} names a kind nothing "
+                        "emits — the allowlist has gone stale",
+                remediation="delete the entry (or restore the emission it "
+                            "documented)")
+
+    ap_tree = ctx.tree("serving/autopilot.py")
+    if ap_tree is not None:
+        ap_kinds = {k for k in emitted if k.startswith("autopilot_")}
+        missing = _DECISION_KINDS - ap_kinds
+        extra = ap_kinds - _DECISION_KINDS
+        if missing:
+            yield Finding(
+                rule="APX302", severity=ERROR,
+                location="serving/autopilot.py",
+                message="decision schema is not closed: "
+                        f"{sorted(missing)} never emitted — every "
+                        "decision must reach observe/decide/act/verdict",
+                remediation="emit the missing leg(s) with the shared "
+                            "decision_id")
+        for k in sorted(extra):
+            yield Finding(
+                rule="APX302", severity=ERROR,
+                location=emitted[k],
+                message=f"unknown decision kind {k!r} outside the "
+                        "observe/decide/act/verdict schema",
+                remediation="fold it into the 4-event schema (the docs "
+                            "table and collect_decisions key on it)")
+        emit_fn = next((n for n in ast.walk(ap_tree)
+                        if isinstance(n, ast.FunctionDef)
+                        and n.name == "_emit"), None)
+        if emit_fn is not None:
+            argnames = [a.arg for a in emit_fn.args.args]
+            if "decision_id" not in argnames:
+                yield Finding(
+                    rule="APX302", severity=ERROR,
+                    location=f"serving/autopilot.py:{emit_fn.lineno}",
+                    message="_emit does not take a decision_id — decision "
+                            "events can no longer be stitched into one "
+                            "record",
+                    remediation="every decision event carries the shared "
+                                "decision_id (docs/observability.md "
+                                "schema table)")
+
+
+# --------------------------------------------------------------------------
+# APX303 — metric-catalog drift
+# --------------------------------------------------------------------------
+
+_METRIC_FACTORIES = ("counter", "gauge", "histogram")
+_METRIC_PREFIXES = ("serving/", "fleet/")
+_CODE_SPAN = re.compile(r"`([^`]+)`")
+
+
+def _wrapper_templates(tree: ast.Module) -> Dict[str, Tuple[str, str]]:
+    """Functions that forward a parameter into a metric-factory name
+    (``def _count(self, name): ...counter(f"fleet/autopilot/{name}")``,
+    ``def _slo_hist(self, name): ...histogram(name, ...)``) mapped to
+    their (prefix, suffix) template around the forwarded parameter."""
+    out: Dict[str, Tuple[str, str]] = {}
+    for fn in ast.walk(tree):
+        if not isinstance(fn, ast.FunctionDef):
+            continue
+        params = {a.arg for a in fn.args.args}
+        for node in ast.walk(fn):
+            if not (isinstance(node, ast.Call) and node.args):
+                continue
+            callee = node.func
+            name = callee.attr if isinstance(callee, ast.Attribute) else \
+                callee.id if isinstance(callee, ast.Name) else None
+            if name not in _METRIC_FACTORIES:
+                continue
+            arg = node.args[0]
+            if isinstance(arg, ast.Name) and arg.id in params:
+                out[fn.name] = ("", "")
+            elif isinstance(arg, ast.JoinedStr):
+                interp = [p for p in arg.values
+                          if isinstance(p, ast.FormattedValue)]
+                if (len(interp) == 1 and isinstance(interp[0].value, ast.Name)
+                        and interp[0].value.id in params):
+                    pre, post, seen = [], [], False
+                    for p in arg.values:
+                        if isinstance(p, ast.FormattedValue):
+                            seen = True
+                        elif not seen:
+                            pre.append(str(p.value))
+                        else:
+                            post.append(str(p.value))
+                    out[fn.name] = ("".join(pre), "".join(post))
+    return out
+
+
+def _emitted_metrics(ctx: ControlCtx) -> Dict[str, str]:
+    """metric-name pattern (``*`` = one interpolated segment piece) ->
+    one "file:line" emission site."""
+    out: Dict[str, str] = {}
+    for fname in _METRIC_EMITTERS:
+        tree = ctx.tree(fname)
+        if tree is None:
+            continue
+        wrappers = _wrapper_templates(tree)
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call) and node.args):
+                continue
+            callee = node.func
+            cname = callee.attr if isinstance(callee, ast.Attribute) else \
+                callee.id if isinstance(callee, ast.Name) else None
+            if cname in _METRIC_FACTORIES:
+                pat = _fstring_pattern(node.args[0])
+            elif cname in wrappers:
+                inner = _fstring_pattern(node.args[0])
+                if inner is None:
+                    continue
+                pre, post = wrappers[cname]
+                pat = f"{pre}{inner}{post}"
+            else:
+                continue
+            if pat is not None and pat.startswith(_METRIC_PREFIXES):
+                out.setdefault(pat, f"{fname}:{node.lineno}")
+    return out
+
+
+def _doc_metric_rows(ctx: ControlCtx) -> Dict[str, str]:
+    """Catalog rows: first-cell code spans of every markdown table row,
+    ``<var>`` placeholders normalized to ``*``, ``.../suffix``
+    continuations resolved against the previous span in the cell.
+    Two-segment pure-family rows (``fleet/*`` in the prefix-family
+    table) are not catalog entries and are skipped."""
+    out: Dict[str, str] = {}
+    for fname, text in ctx.docs.items():
+        for ln, line in enumerate(text.splitlines(), 1):
+            if not line.lstrip().startswith("|"):
+                continue
+            cells = line.split("|")
+            if len(cells) < 3 or set(cells[1].strip()) <= {"-", " ", ":"}:
+                continue
+            prev = None
+            for span in _CODE_SPAN.findall(cells[1]):
+                name = span.strip()
+                if name.startswith(".../") and prev is not None:
+                    name = prev.rsplit("/", 1)[0] + name[3:]
+                if not name.startswith(_METRIC_PREFIXES):
+                    continue
+                name = re.sub(r"<[^>]+>", "*", name)
+                prev = name
+                if name.count("/") == 1 and name.endswith("/*"):
+                    continue  # prefix-family row, not a catalog entry
+                out.setdefault(name, f"{fname}:{ln}")
+    return out
+
+
+def _patterns_match(a: str, b: str) -> bool:
+    sa, sb = a.split("/"), b.split("/")
+    if len(sa) != len(sb):
+        return False
+    return all(x == y or x == "*" or y == "*" for x, y in zip(sa, sb))
+
+
+@register("APX303", tier="control", title="metric-catalog-drift",
+          catches="a serving/fleet metric flushed in code but missing "
+                  "from the docs catalog tables, or a catalog row whose "
+                  "metric nothing emits",
+          motivation="PR 16/17 grew the fleet metric surface faster than "
+                     "docs/serving.md; an uncatalogued counter is "
+                     "invisible to dashboards and a stale row debugs a "
+                     "metric that does not exist")
+def _apx303(ctx: ControlCtx):
+    emitted = _emitted_metrics(ctx)
+    docs = _doc_metric_rows(ctx)
+    if not emitted or not docs:
+        return
+    for pat, loc in sorted(emitted.items()):
+        if not any(_patterns_match(pat, d) for d in docs):
+            yield Finding(
+                rule="APX303", severity=ERROR, location=loc,
+                message=f"metric {pat!r} is emitted but has no row in "
+                        "the docs catalog tables "
+                        f"({', '.join(_METRIC_DOCS)})",
+                remediation="add the catalog row (name / type / meaning) "
+                            "in docs/serving.md")
+    for pat, loc in sorted(docs.items()):
+        if not any(_patterns_match(pat, e) for e in emitted):
+            yield Finding(
+                rule="APX303", severity=ERROR, location=loc,
+                message=f"catalog row {pat!r} names a metric nothing in "
+                        "the serving/fleet/autopilot code emits",
+                remediation="delete the stale row (or restore the "
+                            "emission it documented)")
+
+
+# --------------------------------------------------------------------------
+# APX304 — lock/teardown discipline
+# --------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class _Write:
+    attr: str
+    method: str
+    lineno: int
+    locked: bool
+
+
+def _self_calls(fn: ast.FunctionDef) -> Set[str]:
+    out = set()
+    for node in ast.walk(fn):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and _is_self_attr(node.func)):
+            out.add(node.func.attr)
+    return out
+
+
+def _collect_writes(fn: ast.FunctionDef) -> List[_Write]:
+    """``self.x = / += ...`` sites in one method, each tagged with
+    whether an enclosing ``with self.<...lock...>:`` guards it."""
+    writes: List[_Write] = []
+
+    def visit(node: ast.AST, locked: bool) -> None:
+        if isinstance(node, ast.With):
+            has_lock = any(
+                isinstance(item.context_expr, ast.Attribute)
+                and _is_self_attr(item.context_expr)
+                and "lock" in item.context_expr.attr.lower()
+                for item in node.items)
+            locked = locked or has_lock
+        targets: List[ast.AST] = []
+        if isinstance(node, ast.Assign):
+            targets = list(node.targets)
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            targets = [node.target]
+        for t in targets:
+            for el in (t.elts if isinstance(t, (ast.Tuple, ast.List))
+                       else [t]):
+                if _is_self_attr(el):
+                    writes.append(_Write(el.attr, fn.name,
+                                         node.lineno, locked))
+        for child in ast.iter_child_nodes(node):
+            visit(child, locked)
+
+    visit(fn, False)
+    return writes
+
+
+def _thread_targets(cls: ast.ClassDef, methods: Dict[str, ast.FunctionDef],
+                    ) -> Set[str]:
+    out = set()
+    for node in ast.walk(cls):
+        if not isinstance(node, ast.Call):
+            continue
+        callee = node.func
+        cname = callee.attr if isinstance(callee, ast.Attribute) else \
+            callee.id if isinstance(callee, ast.Name) else None
+        if cname != "Thread":
+            continue
+        for kw in node.keywords:
+            if kw.arg == "target" and _is_self_attr(kw.value) \
+                    and kw.value.attr in methods:
+                out.add(kw.value.attr)
+    return out
+
+
+def _reach(entries: Set[str], graph: Dict[str, Set[str]]) -> Set[str]:
+    seen, stack = set(), list(entries)
+    while stack:
+        m = stack.pop()
+        if m in seen:
+            continue
+        seen.add(m)
+        stack.extend(graph.get(m, ()))
+    return seen
+
+
+@register("APX304", tier="control", title="lock-teardown-discipline",
+          catches="an attribute written from more than one thread domain "
+                  "(a Thread target's call graph vs. the main-thread "
+                  "methods) without the object's lock",
+          motivation="PR 18: the _producer teardown race — a stop flag "
+                     "and queue rewind mutated from both the consumer "
+                     "and a competing __iter__ outside the lock")
+def _apx304(ctx: ControlCtx):
+    for fname in _THREAD_FILES:
+        tree = ctx.tree(fname)
+        if tree is None:
+            continue
+        for cname, cls in _class_defs(tree).items():
+            methods = {n.name: n for n in cls.body
+                       if isinstance(n, ast.FunctionDef)}
+            targets = _thread_targets(cls, methods)
+            if not targets:
+                continue
+            graph = {m: _self_calls(fn) & set(methods)
+                     for m, fn in methods.items()}
+            thread_reach = _reach(targets, graph)
+            main_entries = {m for m in methods
+                            if m not in thread_reach and m != "__init__"}
+            main_reach = _reach(main_entries, graph)
+
+            by_attr: Dict[str, List[_Write]] = {}
+            for m, fn in methods.items():
+                if m == "__init__":
+                    continue  # Thread.start() is the publication barrier
+                for w in _collect_writes(fn):
+                    by_attr.setdefault(w.attr, []).append(w)
+
+            for attr, writes in sorted(by_attr.items()):
+                domains = set()
+                for w in writes:
+                    if w.method in thread_reach:
+                        domains.add("thread")
+                    if w.method in main_reach:
+                        domains.add("main")
+                if len(domains) < 2 or len(writes) == 1:
+                    continue  # single-domain or single-assignment
+                for w in writes:
+                    if not w.locked:
+                        yield Finding(
+                            rule="APX304", severity=ERROR,
+                            location=f"{fname}:{w.lineno} "
+                                     f"({cname}.{w.method})",
+                            message=f"self.{attr} is written from both "
+                                    "the worker-thread and main-thread "
+                                    "call graphs, and this write is not "
+                                    "under the object's lock",
+                            remediation="guard every cross-domain write "
+                                        "with the lock (or make the "
+                                        "field single-assignment)")
+
+
+# --------------------------------------------------------------------------
+# entry point
+# --------------------------------------------------------------------------
+
+def run_control_plane(ctx: Optional[ControlCtx] = None,
+                      ) -> Tuple[Report, int]:
+    """Run every control-tier rule over ``ctx`` (default: the live
+    tree).  Returns ``(report, files_scanned)`` — the pseudo-entry
+    contract ``cli.py`` shares with :func:`entries.run_entry`."""
+    ctx = ctx if ctx is not None else ControlCtx.default()
+    report = Report()
+    for rule in rules_for("control"):
+        report.extend(rule.fn(ctx))
+    return report, len(ctx.sources) + len(ctx.docs)

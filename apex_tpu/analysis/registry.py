@@ -8,7 +8,13 @@ an analysis context to an iterable of findings:
 - jaxpr-tier rules receive a :class:`~apex_tpu.analysis.jaxpr_tier.JaxprCtx`
   (closed jaxpr + the program's declared intent);
 - HLO-tier rules receive an :class:`~apex_tpu.analysis.hlo_rules.HloCtx`
-  (parsed :class:`~apex_tpu.analysis.hlo.HloModule` + expectations).
+  (parsed :class:`~apex_tpu.analysis.hlo.HloModule` + expectations);
+- control-tier rules receive a
+  :class:`~apex_tpu.analysis.control_plane.ControlCtx` (parsed ASTs of the
+  serving/observability sources + the docs catalog text);
+- stability-tier rules receive a
+  :class:`~apex_tpu.analysis.stability.StabilityCtx` (the traced jaxprs of
+  one serving program at every churn configuration).
 
 Rules must be *total*: they skip silently (no findings) when their
 precondition is absent — e.g. the conditional-survival rule only applies
@@ -27,7 +33,7 @@ __all__ = ["Rule", "RULEBOOK", "register", "rules_for"]
 @dataclasses.dataclass(frozen=True)
 class Rule:
     id: str
-    tier: str          # "jaxpr" | "hlo"
+    tier: str          # "jaxpr" | "hlo" | "control" | "stability"
     title: str         # short name (kebab-case)
     catches: str       # one line: what bug class this detects
     motivation: str    # which PR's postmortem mechanized into this rule
@@ -40,7 +46,7 @@ RULEBOOK: Dict[str, Rule] = {}
 def register(rule_id: str, *, tier: str, title: str, catches: str,
              motivation: str):
     """Decorator: add a rule function to the rulebook."""
-    if tier not in ("jaxpr", "hlo"):
+    if tier not in ("jaxpr", "hlo", "control", "stability"):
         raise ValueError(f"unknown tier {tier!r}")
 
     def deco(fn):

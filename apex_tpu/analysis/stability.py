@@ -1,0 +1,314 @@
+"""APX305 — jit-stability lint: churn is data, not shape.
+
+The serving engine's central contract is that request churn — slot
+occupancy, adapter mix, per-slot draft counts, sampling policies, block
+tables — rides through the compiled decode/prefill programs as *data*,
+so the compile count stays 1 for the life of the server (the
+``decode_compile_count()`` pins scattered through the suite).  The
+failure mode is silent: a churn knob leaking into static/python land (a
+scalar baked as a constant, a shape derived from occupancy, a dtype/
+weak-type drift from a python literal) retraces cleanly and only shows
+up as a recompile storm in production.
+
+This tier gates the invariant structurally: each registered serving
+program (``decode``, ``prefill``, ``speculative``, ``lora``) is traced
+with :func:`jax.make_jaxpr` at N *distinct* churn configurations and the
+canonical jaxpr structure hash — primitives, avals (shape/dtype/
+weak-type), literal values, nested sub-jaxprs — must be identical across
+all of them.  Tracing is abstract (no XLA compile), so the whole sweep
+is cheap enough for the fast tier.
+
+``run_stability()`` is the ``stability`` pseudo-entry of
+``python -m apex_tpu.analysis``; tests inject a shape-varying fixture
+through :func:`trace_hash` + :class:`StabilityCtx` directly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+from typing import Dict, List, Optional, Tuple
+
+from apex_tpu.analysis.findings import ERROR, Finding, Report
+from apex_tpu.analysis.registry import register, rules_for
+
+__all__ = ["StabilityCtx", "structure_hash", "trace_hash",
+           "check_hashes", "run_stability", "STABILITY_PROGRAMS"]
+
+
+@dataclasses.dataclass
+class StabilityCtx:
+    """One serving program's trace sweep: ``hashes`` is the ordered
+    ``(churn-config label, structure hash)`` list the rule compares."""
+
+    program: str
+    hashes: List[Tuple[str, str]]
+
+
+# --------------------------------------------------------------------------
+# canonical structure hash
+# --------------------------------------------------------------------------
+
+def _aval_sig(v) -> str:
+    a = getattr(v, "aval", None)
+    return (f"{getattr(a, 'shape', '?')}:{getattr(a, 'dtype', '?')}"
+            f":{getattr(a, 'weak_type', False)}")
+
+
+def _atom_sig(v) -> str:
+    # a Literal carries a baked value: include it, so a python scalar
+    # knob turned into a constant changes the hash even at fixed aval
+    if hasattr(v, "val"):
+        return f"lit[{v.val!r}]{_aval_sig(v)}"
+    return _aval_sig(v)
+
+
+def _param_sig(v, lines: List[str]) -> str:
+    if hasattr(v, "eqns") and hasattr(v, "invars"):        # Jaxpr
+        _canon(v, lines)
+        return "<jaxpr>"
+    if hasattr(v, "jaxpr") and hasattr(v, "consts"):       # ClosedJaxpr
+        _canon(v.jaxpr, lines)
+        return "<closed-jaxpr>"
+    if isinstance(v, (tuple, list)):
+        return "(" + ",".join(_param_sig(x, lines) for x in v) + ")"
+    if isinstance(v, dict):
+        return "{" + ",".join(
+            f"{k}:{_param_sig(v[k], lines)}" for k in sorted(v, key=str)
+        ) + "}"
+    if isinstance(v, (str, int, float, bool, complex, bytes, type(None))):
+        return repr(v)
+    # meshes, dtypes, effects, shardings: their str() is stable; bare
+    # functions/objects are reduced to their type so id()s never leak in
+    s = str(v)
+    return s if "0x" not in s else type(v).__name__
+
+
+def _canon(jaxpr, lines: List[str]) -> None:
+    lines.append("in:" + ",".join(_aval_sig(v) for v in jaxpr.invars))
+    lines.append("const:" + ",".join(_aval_sig(v)
+                                     for v in jaxpr.constvars))
+    for eqn in jaxpr.eqns:
+        lines.append(
+            f"eqn:{eqn.primitive.name}"
+            f"({','.join(_atom_sig(v) for v in eqn.invars)})"
+            f"->({','.join(_aval_sig(v) for v in eqn.outvars)})")
+        for k in sorted(eqn.params):
+            lines.append(f"  {k}={_param_sig(eqn.params[k], lines)}")
+    lines.append("out:" + ",".join(_atom_sig(v) for v in jaxpr.outvars))
+
+
+def structure_hash(jaxpr) -> str:
+    """Canonical sha256 of a (Closed)Jaxpr's structure: primitive
+    sequence, in/out avals (shape/dtype/weak-type), literal values, and
+    every nested sub-jaxpr — but never buffer contents, so two traces
+    differ exactly when the *program* differs."""
+    if hasattr(jaxpr, "jaxpr"):
+        jaxpr = jaxpr.jaxpr
+    lines: List[str] = []
+    _canon(jaxpr, lines)
+    return hashlib.sha256("\n".join(lines).encode()).hexdigest()
+
+
+def trace_hash(fn, args) -> str:
+    """Abstractly trace ``fn(*args)`` and hash the jaxpr structure."""
+    import jax
+
+    return structure_hash(jax.make_jaxpr(fn)(*args))
+
+
+# --------------------------------------------------------------------------
+# the rule
+# --------------------------------------------------------------------------
+
+@register("APX305", tier="stability", title="jit-stability",
+          catches="a serving program whose traced structure varies with "
+                  "request churn (slot/adapter/draft/sampling mix) — a "
+                  "knob leaked from data into shape/static land",
+          motivation="PR 16: one uncompiled decode variant crossing the "
+                     "heartbeat window marked a healthy replica DOWN "
+                     "fleet-wide; the zero-recompile contract was only "
+                     "pinned per-suite, never as lint")
+def _apx305(ctx: StabilityCtx):
+    buckets: Dict[str, List[str]] = {}
+    for label, h in ctx.hashes:
+        buckets.setdefault(h, []).append(label)
+    if len(buckets) <= 1:
+        return
+    detail = "; ".join(
+        f"{h[:12]}… <- {', '.join(labels)}"
+        for h, labels in sorted(buckets.items(), key=lambda kv: kv[1]))
+    yield Finding(
+        rule="APX305", severity=ERROR,
+        location=f"stability:{ctx.program}",
+        message=f"jaxpr structure hash differs across churn configs "
+                f"({len(buckets)} variants over {len(ctx.hashes)} "
+                f"configs): {detail}",
+        remediation="every churn knob must ride as array data at a "
+                    "fixed aval — no python-scalar bake-in, no "
+                    "occupancy-derived shapes (docs/serving.md, the "
+                    "zero-recompile contract)")
+
+
+def check_hashes(program: str,
+                 hashes: List[Tuple[str, str]]) -> Report:
+    """Run the stability rulebook over one program's trace sweep."""
+    report = Report()
+    ctx = StabilityCtx(program=program, hashes=hashes)
+    for rule in rules_for("stability"):
+        report.extend(rule.fn(ctx))
+    return report
+
+
+# --------------------------------------------------------------------------
+# the registered serving programs and their churn sweeps
+# --------------------------------------------------------------------------
+
+def _sampling(r, b):
+    import numpy as np
+
+    return (r.uniform(0.0, 1.5, b).astype(np.float32),       # temperature
+            r.randint(0, 8, b).astype(np.int32),              # top_k
+            r.uniform(0.5, 1.0, b).astype(np.float32),        # top_p
+            r.randint(0, 2**31, b).astype(np.uint32),         # seeds
+            r.randint(0, 16, b).astype(np.int32))             # steps
+
+
+def _decode_args(eng, i: int):
+    """One churn configuration of the decode step: config 0 is the cold
+    all-zeros baseline (the analyzer entry's shape), later configs mix
+    occupancy, draft counts, adapter slots, block tables and sampling —
+    all at the same avals."""
+    import numpy as np
+
+    b, S = eng.serving.max_batch, eng.spec_width
+    mb = eng.cache.max_blocks_per_request
+    r = np.random.RandomState(1000 + i)
+    if i == 0:
+        tokens = np.zeros((b, S), np.int32)
+        active = np.zeros((b,), bool)
+        n_draft = np.zeros((b,), np.int32)
+        tables = np.zeros((b, mb), np.int32)
+        positions = np.zeros((b,), np.int32)
+        sampling = (np.zeros((b,), np.float32), np.zeros((b,), np.int32),
+                    np.ones((b,), np.float32), np.zeros((b,), np.uint32),
+                    np.zeros((b,), np.int32))
+    else:
+        tokens = r.randint(0, 64, (b, S)).astype(np.int32)
+        active = r.rand(b) < (0.3 + 0.4 * (i % 2))
+        n_draft = r.randint(0, S, b).astype(np.int32)
+        tables = r.randint(0, mb, (b, mb)).astype(np.int32)
+        positions = r.randint(0, eng.serving.max_seq, b).astype(np.int32)
+        sampling = _sampling(r, b)
+    core = (tokens, positions, tables, active, n_draft)
+    if eng.lora is not None:
+        slots = (np.zeros((b,), np.int32) if i == 0
+                 else r.randint(0, eng.lora.max_adapters, b)
+                 .astype(np.int32))
+        return ((eng.arenas, eng.adapters, eng.params)
+                + core + (slots,) + sampling)
+    return (eng.arenas, eng.params) + core + sampling
+
+
+def _prefill_args(eng, i: int):
+    import numpy as np
+
+    b = eng.serving.max_batch
+    T = eng.prefill_len
+    mb = eng.cache.max_blocks_per_request
+    r = np.random.RandomState(2000 + i)
+    if i == 0:
+        grids = [np.zeros((b, T), np.int32) for _ in range(5)]
+        lengths = np.zeros((b,), np.int32)
+        tables = np.zeros((b, mb), np.int32)
+        sample_index = np.full((b,), T, np.int32)
+        sampling = (np.zeros((b,), np.float32), np.zeros((b,), np.int32),
+                    np.ones((b,), np.float32), np.zeros((b,), np.uint32),
+                    np.zeros((b,), np.int32))
+    else:
+        grids = [r.randint(0, 64, (b, T)).astype(np.int32)
+                 for _ in range(5)]
+        lengths = r.randint(0, T + 1, b).astype(np.int32)
+        tables = r.randint(0, mb, (b, mb)).astype(np.int32)
+        sample_index = r.randint(0, T + 1, b).astype(np.int32)
+        sampling = _sampling(r, b)
+    tokens, position_ids, limits, dest_blocks, dest_offsets = grids
+    core = (tokens, position_ids, tables, lengths, limits,
+            dest_blocks, dest_offsets, sample_index)
+    if eng.lora is not None:
+        slots = (np.zeros((b,), np.int32) if i == 0
+                 else r.randint(0, eng.lora.max_adapters, b)
+                 .astype(np.int32))
+        return ((eng.arenas, eng.adapters, eng.params)
+                + core + (slots,) + sampling)
+    return (eng.arenas, eng.params) + core + sampling
+
+
+# program name -> (engine flavour, step attr, churn-args builder)
+STABILITY_PROGRAMS = {
+    "decode": ("plain", "_decode", _decode_args),
+    "prefill": ("plain", "_prefill", _prefill_args),
+    "speculative": ("spec", "_decode", _decode_args),
+    "lora": ("lora", "_decode", _decode_args),
+}
+
+
+def _build_engine(flavour: str, cfg, params, mesh):
+    from apex_tpu.serving import (
+        LoRAConfig, ServingConfig, ServingEngine, SpeculativeConfig)
+
+    serving = ServingConfig(
+        max_batch=2, block_size=4, max_seq=16, prefill_len=16,
+        speculative=SpeculativeConfig(k=2) if flavour == "spec" else None,
+        lora=(LoRAConfig(rank=4, max_adapters=2)
+              if flavour == "lora" else None))
+    return ServingEngine(cfg, serving, params, mesh=mesh)
+
+
+def run_stability(programs: Optional[List[str]] = None,
+                  n_configs: int = 3) -> Tuple[Report, int]:
+    """Trace each registered serving program at ``n_configs`` churn
+    configurations and run the stability rulebook over the hashes.
+    Returns ``(report, program_count)`` — the pseudo-entry contract
+    ``cli.py`` shares with :func:`entries.run_entry`."""
+    import jax
+    import jax.numpy as jnp
+
+    from apex_tpu import parallel
+    from apex_tpu.parallel import mesh as mesh_lib
+    from apex_tpu.transformer.testing import TransformerConfig
+    from apex_tpu.transformer.testing.gpt_parallel_train import build_gpt_3d
+
+    names = list(STABILITY_PROGRAMS) if programs is None else list(programs)
+    unknown = [n for n in names if n not in STABILITY_PROGRAMS]
+    if unknown:
+        raise ValueError(f"unknown stability programs {unknown} "
+                         f"(known: {list(STABILITY_PROGRAMS)})")
+
+    report = Report()
+    try:
+        mesh = parallel.initialize_model_parallel(
+            tensor_model_parallel_size=2)
+        cfg = TransformerConfig(
+            hidden_size=32, num_layers=2, num_attention_heads=4,
+            padded_vocab_size=64, max_position_embeddings=32,
+            hidden_dropout=0.0, attention_dropout=0.0, tensor_axis="tp",
+            use_flash_attention=True)
+        init_fn, _, _ = build_gpt_3d(cfg, num_chunks=2,
+                                     num_microbatches=1, mesh=mesh)
+        params, _ = init_fn(jax.random.PRNGKey(0),
+                            jnp.zeros((2, 4), jnp.int32))
+        engines: Dict[str, object] = {}
+        for name in names:
+            flavour, step_attr, make_args = STABILITY_PROGRAMS[name]
+            if flavour not in engines:
+                engines[flavour] = _build_engine(flavour, cfg, params,
+                                                 mesh)
+            eng = engines[flavour]
+            fn = getattr(eng, step_attr)
+            hashes = [(f"churn{i}", trace_hash(fn, make_args(eng, i)))
+                      for i in range(n_configs)]
+            report.extend(check_hashes(name, hashes))
+    finally:
+        mesh_lib.destroy_model_parallel()
+    return report, len(names)

@@ -90,6 +90,7 @@ __all__ = [
     "collect_decisions",
     "merge_dir",
     "format_trace_report",
+    "TRACE_UNATTRIBUTED_KINDS",
 ]
 
 TRACE_HOP_BUCKETS = (
@@ -115,6 +116,25 @@ _ROUTER_KINDS = ("fleet_submit", "fleet_dispatch", "fleet_replay",
 _REPLICA_KINDS = ("request_submit", "request_admit",
                   "request_prefilled", "decode_tick", "request_preempt",
                   "request_cancel", "request_reject", "request_finish")
+
+# Marker kinds deliberately outside every attribution bucket, each with
+# the reason it is a point event, not an interval.  The event-schema
+# lint (APX302, apex_tpu.analysis.control_plane) holds every other
+# emitted kind to a consumer in this module or goodput.py, and fails
+# when an entry here goes stale (nothing emits it anymore).
+TRACE_UNATTRIBUTED_KINDS = {
+    "preemption": "guard-trip marker; the drain cost it starts is "
+                  "attributed by the 'drain' scope / 'preempted' hop",
+    "sentinel_skip": "forensic marker; goodput charges skipped time via "
+                     "the 'step' event's skipped flag, not this point",
+    "request_export": "KV-handoff forensics on the prefill side; the "
+                      "migration interval is the 'kv_migrate' hop "
+                      "(fleet_migrate_start -> commit dispatch)",
+    "adapter_load": "registration forensics; load latency is router-"
+                    "side (fleet/adapter_loads + ack pump), not a "
+                    "request interval",
+    "adapter_unload": "registration forensics, same as adapter_load",
+}
 
 
 # --------------------------------------------------------------- arming

@@ -254,13 +254,17 @@ def main() -> int:
         cli = subprocess.run(
             [sys.executable,
              os.path.join(REPO, "scripts", "trace_report.py"),
-             trace_dir],
+             trace_dir, "--check"],
             capture_output=True, timeout=120)
         if cli.returncode != 0:
             log(f"FAIL: trace_report.py rc={cli.returncode}: "
                 f"{cli.stderr.decode(errors='replace')[-500:]}")
             return 1
-        log("trace_report.py output:\n"
+        if b"check ok" not in cli.stderr:
+            log("FAIL: trace_report.py --check printed no verdict: "
+                f"{cli.stderr.decode(errors='replace')[-500:]}")
+            return 1
+        log("trace_report.py output (--check passed):\n"
             + cli.stdout.decode(errors="replace"))
 
         # ---- tier gating (ISSUE 17 satellite) -----------------------

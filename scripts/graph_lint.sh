@@ -1,15 +1,19 @@
 #!/usr/bin/env bash
-# Graph lint (ISSUE 4): run the static-analysis rulebook over every
-# registered entry config (3D GPT trainer, ZeRO train steps, dryrun MoE
-# config, overlap rings, reshard restore, serving decode) on the CPU
-# mesh.  Exit 0 = no ERROR finding.
+# Graph lint (ISSUE 4, control tier ISSUE 19): run the static-analysis
+# rulebook over every registered entry config (3D GPT trainer, ZeRO
+# train steps, dryrun MoE config, overlap rings, reshard restore,
+# serving decode) on the CPU mesh, plus the two whole-tier
+# pseudo-entries: control_plane (APX301-304 AST lint over the serving
+# control-plane sources) and stability (APX305 churn-sweep structure
+# hashes of the serving programs).  Exit 0 = no ERROR finding.
 #
 # This is the CI face of apex_tpu.analysis: the rules that mechanize the
 # repo's mesh-correctness invariants (docs/analysis.md has the rulebook).
-# The fast tier runs the identical check in-process
-# (tests/test_analysis.py::test_graph_lint_all_entries_exits_zero), so a
-# red finding fails the suite; this script is for shells, pre-push hooks
-# and bench boxes.
+# The fast tier runs the same check in-process
+# (tests/test_analysis.py::test_graph_lint_all_entries_exits_zero covers
+# the graph entries + control tier; tests/test_aux_subsystems.py gates
+# the stability sweep), so a red finding fails the suite; this script is
+# for shells, pre-push hooks and bench boxes.
 #
 # Usage: scripts/graph_lint.sh [extra apex_tpu.analysis args]
 #   e.g. scripts/graph_lint.sh --entries overlap,zero_flat

@@ -35,6 +35,7 @@ Usage::
     python scripts/trace_report.py <spill-dir> --json     # full JSON
     python scripts/trace_report.py <spill-dir> --trace <id>  # one tree
     python scripts/trace_report.py <spill-dir> --tail-pct 95
+    python scripts/trace_report.py <spill-dir> --check    # CI gate
 
 Exit status: 0 on a clean merge, 1 when any trace carries overcommit
 (double-counted time — an instrumentation bug, never hidden), 2 on
@@ -42,6 +43,12 @@ usage/IO errors.  ``--no-strict`` tolerates interior JSONL corruption
 (the default is strict: a torn *tail* is always tolerated — that is
 the expected SIGKILL artifact — but a torn interior line fails the
 merge).
+
+``--check`` makes the trace plane's own invariant CI-checkable instead
+of merely printable: beyond the overcommit gate it also fails (exit 1)
+when the merge left more than ``--max-unattributed-pct`` (default 5%)
+of total request wall time in no hop bucket — attribution rotting
+quietly is exactly how a tail regression hides.
 """
 
 from __future__ import annotations
@@ -69,6 +76,13 @@ def main(argv=None) -> int:
                          "(default 99)")
     ap.add_argument("--no-strict", action="store_true",
                     help="tolerate interior JSONL corruption")
+    ap.add_argument("--check", action="store_true",
+                    help="CI gate: exit 1 on any overcommit OR on "
+                         "unattributed time above --max-unattributed-pct")
+    ap.add_argument("--max-unattributed-pct", type=float, default=5.0,
+                    help="--check threshold: max unattributed share of "
+                         "total request wall time, in percent "
+                         "(default 5)")
     args = ap.parse_args(argv)
 
     from apex_tpu.observability.trace import (
@@ -92,11 +106,26 @@ def main(argv=None) -> int:
         print(json.dumps(report, indent=1))
     else:
         print(format_trace_report(report))
-    overcommit = report["summary"]["overcommit_s"]
+    summary = report["summary"]
+    overcommit = summary["overcommit_s"]
     if overcommit > 0:
         print(f"trace_report: OVERCOMMIT {overcommit:.6f}s (double-"
               "counted time — instrumentation bug)", file=sys.stderr)
         return 1
+    if args.check:
+        unattributed = summary.get("unattributed_s", 0.0)
+        wall = sum(summary.get("hop_totals_s", {}).values()) \
+            + unattributed
+        pct = 100.0 * unattributed / wall if wall > 0 else 0.0
+        if pct > args.max_unattributed_pct:
+            print(f"trace_report: UNATTRIBUTED {unattributed:.6f}s "
+                  f"({pct:.2f}% of wall > "
+                  f"{args.max_unattributed_pct:g}% budget) — hop "
+                  "attribution is rotting", file=sys.stderr)
+            return 1
+        print(f"trace_report: check ok ({summary['requests']} "
+              f"request(s), 0 overcommit, {pct:.2f}% unattributed)",
+              file=sys.stderr)
     return 0
 
 

@@ -379,13 +379,18 @@ class TestGraphLintFixture:
 
 
 def test_graph_lint_all_entries_exits_zero():
-    """The suite gate (ISSUE 4 acceptance): the full rulebook over every
-    registered entry config — the same invocation as
-    ``scripts/graph_lint.sh`` — must be green on HEAD.  Any ERROR
-    finding fails the fast tier right here."""
+    """The suite gate (ISSUE 4 acceptance, ISSUE 19 control tier): the
+    full rulebook over every registered graph entry plus the
+    control-plane AST tier — the same invocation as
+    ``scripts/graph_lint.sh`` minus the stability pseudo-entry, whose
+    churn-sweep traces are gated separately in test_aux_subsystems
+    (fast: one cached program; slow: the full sweep) to keep the
+    fast-tier budget.  Any ERROR finding fails the fast tier here."""
     from apex_tpu.analysis import cli
+    from apex_tpu.analysis.entries import ENTRIES
 
-    assert cli.main(["--all-entries"]) == 0
+    names = ",".join(list(ENTRIES) + ["control_plane"])
+    assert cli.main(["--entries", names]) == 0
 
 
 def test_graph_lint_script_lists_rules():
@@ -397,3 +402,4 @@ def test_graph_lint_script_lists_rules():
         capture_output=True, timeout=120, cwd=repo)
     assert proc.returncode == 0, proc.stderr.decode(errors="replace")
     assert b"APX101" in proc.stdout and b"APX204" in proc.stdout
+    assert b"APX301" in proc.stdout and b"APX305" in proc.stdout

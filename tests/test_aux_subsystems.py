@@ -1,8 +1,9 @@
 """Aux subsystems: timers export, autoresume protocol, rank logger
 (SURVEY §5 tracing / failure-detection / observability rows), the
 input-pipeline smoke script (ISSUE 8 CI satellite), the serving smoke
-script (ISSUE 9 CI satellite), and the fleet-serving smoke script
-(ISSUE 11 CI satellite)."""
+script (ISSUE 9 CI satellite), the fleet-serving smoke script
+(ISSUE 11 CI satellite), and the APX305 jit-stability sweep over the
+registered serving programs (ISSUE 19 tier gate)."""
 
 import json
 import logging
@@ -297,3 +298,29 @@ def test_obs_smoke_script(tmp_path):
         f"stdout: {proc.stdout.decode(errors='replace')[-2000:]}\n"
         f"stderr tail:\n{proc.stderr.decode(errors='replace')[-2000:]}")
     assert b"obs_smoke OK" in proc.stdout
+
+
+def test_stability_lint_decode_fast():
+    """APX305 over the flagship program (ISSUE 19 tier gate, fast
+    tier): the no-LoRA decode step traced at 3 distinct churn configs
+    — the all-zeros entry shape plus two randomized live mixes — must
+    hash to one jaxpr structure.  One engine build, trace-only (no XLA
+    compile), so this rides the fast tier; the slow twin below sweeps
+    every registered program at 4 configs."""
+    from apex_tpu.analysis.stability import run_stability
+
+    report, n = run_stability(programs=["decode"], n_configs=3)
+    assert n == 1
+    assert report.ok and not report.findings, report.format()
+
+
+@pytest.mark.slow
+def test_stability_lint_full_sweep_slow():
+    """APX305 full sweep (ISSUE 19 acceptance): every registered
+    serving program — decode, prefill, speculative, LoRA — at 4 churn
+    configs each, identical structure hash across all of them."""
+    from apex_tpu.analysis.stability import run_stability
+
+    report, n = run_stability(n_configs=4)
+    assert n == 4
+    assert report.ok and not report.findings, report.format()

@@ -1,0 +1,469 @@
+"""The APX3xx control-plane analyzer tier (ISSUE 19).
+
+Red-fixture coverage: every rule gets a deliberately-broken injected
+source (an orphan wire command, a transport arity drift, an unconsumed
+event kind, a stale allowlist entry, an undocumented counter, a stale
+catalog row, an unlocked cross-thread write, a shape-varying churn
+knob) that must trip *exactly* its rule — and a clean twin that stays
+silent.  The rules are total, so a :class:`ControlCtx` carrying only
+the files one rule reads exercises that rule in isolation.
+
+The live-tree gates (the control tier green over HEAD, the stability
+sweep green over the registered serving programs) live in
+``tests/test_aux_subsystems.py`` next to the other subsystem smokes.
+"""
+
+import json
+import textwrap
+
+import numpy as np
+
+from apex_tpu.analysis.control_plane import ControlCtx, run_control_plane
+from apex_tpu.analysis.stability import (
+    check_hashes,
+    structure_hash,
+    trace_hash,
+)
+
+
+def _only_rule(report, rule_id):
+    assert report.findings, f"expected {rule_id} findings, got none"
+    rules = {f.rule for f in report.findings}
+    assert rules == {rule_id}, (
+        f"expected only {rule_id}, got {rules}:\n{report.format()}")
+    return report.findings
+
+
+def _ctx(sources=None, docs=None):
+    return ControlCtx(sources=dict(sources or {}), docs=dict(docs or {}))
+
+
+def _run(sources=None, docs=None):
+    report, _ = run_control_plane(_ctx(sources, docs))
+    return report
+
+
+# ---------------------------------------------------------------------------
+# APX301 — wire-protocol completeness
+# ---------------------------------------------------------------------------
+
+_SOCK = textwrap.dedent("""\
+    class SocketTransport:
+        def submit(self, frid, prompt):
+            self._send_cmd(("submit", frid, prompt, 0))
+
+        def drain(self):
+            self._send_cmd(("drain",))
+
+        def close(self):
+            self._stage(encode_frame(
+                ("cmd", self._cmd_seq + 1, ("stop",))))
+    """)
+
+_REPL = textwrap.dedent("""\
+    class ReplicaProcess:
+        def submit(self, frid, prompt):
+            self._cmd.put(("submit", frid, prompt, 0))
+
+        def drain(self):
+            self._cmd.put(("drain",))
+
+        def stop(self):
+            self._cmd.put_nowait(("stop",))
+
+
+    def _replica_worker(cmd_q):
+        while True:
+            cmd = cmd_q.get()
+            if cmd[0] == "submit":
+                pass
+            elif cmd[0] == "drain":
+                pass
+            elif cmd[0] == "stop":
+                return
+    """)
+
+_WIRE_KEYS = ("serving/transport.py", "serving/replica.py")
+
+
+def test_apx301_clean_protocol_is_silent():
+    report = _run(dict(zip(_WIRE_KEYS, (_SOCK, _REPL))))
+    assert report.ok and not report.findings, report.format()
+
+
+def test_apx301_orphan_command_fires():
+    """A command both clients send but no worker arm handles."""
+    sock = _SOCK + "\n    def frob(self):\n" \
+                   "        self._send_cmd((\"frob\", 1))\n"
+    repl = _REPL.replace(
+        "    def stop(self):",
+        "    def frob(self):\n"
+        "        self._cmd.put((\"frob\", 1))\n\n"
+        "    def stop(self):")
+    findings = _only_rule(
+        _run(dict(zip(_WIRE_KEYS, (sock, repl)))), "APX301")
+    assert any("'frob'" in f.message and "no _replica_worker handler"
+               in f.message for f in findings)
+
+
+def test_apx301_arity_drift_fires():
+    """The PR 15 class: one transport's submit tuple grew an element."""
+    sock = _SOCK.replace('("submit", frid, prompt, 0)',
+                         '("submit", frid, prompt, 0, "grew")')
+    findings = _only_rule(
+        _run(dict(zip(_WIRE_KEYS, (sock, _REPL)))), "APX301")
+    assert any("'submit'" in f.message and "arity drift" in f.message
+               for f in findings)
+
+
+def test_apx301_one_sided_command_fires():
+    sock = _SOCK + "\n    def frob(self):\n" \
+                   "        self._send_cmd((\"frob\", 1))\n"
+    findings = _only_rule(
+        _run(dict(zip(_WIRE_KEYS, (sock, _REPL)))), "APX301")
+    msgs = "\n".join(f.message for f in findings)
+    assert "socket transport only" in msgs      # set drift
+    assert "no _replica_worker handler" in msgs  # and unhandled
+
+
+def test_apx301_dead_handler_fires():
+    repl = _REPL.replace(
+        '        elif cmd[0] == "stop":',
+        '        elif cmd[0] == "ghost":\n'
+        '            pass\n'
+        '        elif cmd[0] == "stop":')
+    findings = _only_rule(
+        _run(dict(zip(_WIRE_KEYS, (_SOCK, repl)))), "APX301")
+    assert any("'ghost'" in f.message and "dead" in f.message
+               for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# APX302 — event-schema closure
+# ---------------------------------------------------------------------------
+
+_EMITTER = textwrap.dedent("""\
+    def submit(req):
+        timeline.emit("request_submit", rid=req.rid)
+    """)
+
+_CONSUMER = textwrap.dedent("""\
+    _KIND_RANK = {"request_submit": 0}
+
+    TRACE_UNATTRIBUTED_KINDS = {}
+    """)
+
+
+def test_apx302_clean_schema_is_silent():
+    report = _run({"serving/engine.py": _EMITTER,
+                   "observability/trace.py": _CONSUMER})
+    assert report.ok and not report.findings, report.format()
+
+
+def test_apx302_unconsumed_kind_fires():
+    emitter = _EMITTER + "    timeline.emit(\"mystery_evt\", x=1)\n"
+    findings = _only_rule(
+        _run({"serving/engine.py": emitter,
+              "observability/trace.py": _CONSUMER}), "APX302")
+    assert any("'mystery_evt'" in f.message for f in findings)
+
+
+def test_apx302_allowlisted_kind_is_silent():
+    emitter = _EMITTER + "    timeline.emit(\"mystery_evt\", x=1)\n"
+    consumer = _CONSUMER.replace(
+        "TRACE_UNATTRIBUTED_KINDS = {}",
+        'TRACE_UNATTRIBUTED_KINDS = {"mystery_evt": "a marker"}')
+    report = _run({"serving/engine.py": emitter,
+                   "observability/trace.py": consumer})
+    assert report.ok and not report.findings, report.format()
+
+
+def test_apx302_stale_allowlist_fires():
+    consumer = _CONSUMER.replace(
+        "TRACE_UNATTRIBUTED_KINDS = {}",
+        'TRACE_UNATTRIBUTED_KINDS = {"ghost_kind": "gone"}')
+    findings = _only_rule(
+        _run({"serving/engine.py": _EMITTER,
+              "observability/trace.py": consumer}), "APX302")
+    assert any("'ghost_kind'" in f.message and "stale" in f.message
+               for f in findings)
+
+
+_AUTOPILOT_OK = textwrap.dedent("""\
+    class Autopilot:
+        def _emit(self, kind, decision_id, **fields):
+            timeline.emit(kind, decision_id=decision_id, **fields)
+
+        def decide(self, did):
+            self._emit("autopilot_observe", did)
+            self._emit("autopilot_decide", did)
+            self._emit("autopilot_act", did)
+            self._emit("autopilot_verdict", did)
+    """)
+
+_AP_CONSUMER = _CONSUMER + textwrap.dedent("""\
+
+
+    def classify(kind):
+        return kind.startswith("autopilot_")
+    """)
+
+
+def test_apx302_decision_schema_closure():
+    report = _run({"serving/autopilot.py": _AUTOPILOT_OK,
+                   "observability/trace.py": _AP_CONSUMER})
+    assert report.ok, report.format()
+
+    broken = _AUTOPILOT_OK.replace(
+        '        self._emit("autopilot_verdict", did)\n', "")
+    findings = _only_rule(
+        _run({"serving/autopilot.py": broken,
+              "observability/trace.py": _AP_CONSUMER}), "APX302")
+    assert any("autopilot_verdict" in f.message for f in findings)
+
+    no_did = _AUTOPILOT_OK.replace(
+        "def _emit(self, kind, decision_id, **fields):",
+        "def _emit(self, kind, **fields):").replace(
+        "timeline.emit(kind, decision_id=decision_id, **fields)",
+        "timeline.emit(kind, **fields)")
+    findings = _only_rule(
+        _run({"serving/autopilot.py": no_did,
+              "observability/trace.py": _AP_CONSUMER}), "APX302")
+    assert any("decision_id" in f.message for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# APX303 — metric-catalog drift
+# ---------------------------------------------------------------------------
+
+_METRIC_SRC = textwrap.dedent("""\
+    class Engine:
+        def tick(self):
+            self.registry.counter("serving/good_counter").inc()
+            self.registry.histogram(
+                f"fleet/tenant/{self.tenant}/ttft_ms").observe(1.0)
+    """)
+
+_CATALOG = textwrap.dedent("""\
+    | metric | type | meaning |
+    |---|---|---|
+    | `serving/good_counter` | counter | a documented counter |
+    | `fleet/tenant/<t>/ttft_ms` | histogram | per-tenant TTFT |
+    """)
+
+
+def test_apx303_clean_catalog_is_silent():
+    report = _run({"serving/engine.py": _METRIC_SRC},
+                  {"docs/serving.md": _CATALOG})
+    assert report.ok and not report.findings, report.format()
+
+
+def test_apx303_undocumented_counter_fires():
+    src = _METRIC_SRC.replace(
+        '"serving/good_counter"',
+        '"serving/good_counter").inc()\n'
+        '        self.registry.counter("serving/ghost_counter"')
+    findings = _only_rule(
+        _run({"serving/engine.py": src},
+             {"docs/serving.md": _CATALOG}), "APX303")
+    assert any("'serving/ghost_counter'" in f.message
+               and "no row" in f.message for f in findings)
+
+
+def test_apx303_stale_doc_row_fires():
+    docs = _CATALOG + \
+        "| `serving/stale_row` | gauge | nothing emits this |\n"
+    findings = _only_rule(
+        _run({"serving/engine.py": _METRIC_SRC},
+             {"docs/serving.md": docs}), "APX303")
+    assert any("'serving/stale_row'" in f.message
+               and "nothing" in f.message for f in findings)
+
+
+def test_apx303_wrapper_resolution():
+    """A ``_count``-style wrapper (name templated around a parameter)
+    resolves to concrete names, so an undocumented wrapped counter is
+    still caught."""
+    src = textwrap.dedent("""\
+        class Pilot:
+            def _count(self, name):
+                self.registry.counter(f"fleet/autopilot/{name}").inc()
+
+            def act(self):
+                self._count("decisions")
+                self._count("mystery_knob")
+        """)
+    docs = _CATALOG + \
+        "| `fleet/autopilot/decisions` | counter | decisions taken |\n"
+    findings = _only_rule(
+        _run({"serving/engine.py": _METRIC_SRC,
+              "serving/autopilot.py": src},
+             {"docs/serving.md": docs}), "APX303")
+    msgs = "\n".join(f.message for f in findings)
+    assert "fleet/autopilot/mystery_knob" in msgs
+    assert "fleet/autopilot/decisions" not in msgs
+
+
+# ---------------------------------------------------------------------------
+# APX304 — lock/teardown discipline
+# ---------------------------------------------------------------------------
+
+_LOCKED = textwrap.dedent("""\
+    import threading
+
+
+    class Worker:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._count = 0
+            self._thread = threading.Thread(target=self._run)
+
+        def _run(self):
+            with self._lock:
+                self._count += 1
+
+        def poke(self):
+            with self._lock:
+                self._count += 1
+    """)
+
+
+def test_apx304_locked_writes_are_silent():
+    report = _run({"data/_producer.py": _LOCKED})
+    assert report.ok and not report.findings, report.format()
+
+
+def test_apx304_unlocked_cross_thread_write_fires():
+    """The PR 18 class: a field both the producer thread and the main
+    thread mutate, with the main-thread write outside the lock."""
+    src = _LOCKED.replace(
+        "    def poke(self):\n"
+        "        with self._lock:\n"
+        "            self._count += 1",
+        "    def poke(self):\n"
+        "        self._count += 1")
+    findings = _only_rule(_run({"data/_producer.py": src}), "APX304")
+    assert any("self._count" in f.message and "poke" in f.location
+               for f in findings)
+
+
+def test_apx304_single_assignment_is_exempt():
+    """One write site total (post-init) is publication, not a race."""
+    src = _LOCKED + "\n    def finish(self):\n        self._done = True\n"
+    report = _run({"data/_producer.py": src})
+    assert report.ok and not report.findings, report.format()
+
+
+def test_apx304_thread_reached_helper_counts_as_thread_domain():
+    """A write inside a helper only the thread target calls is in the
+    thread domain; an unlocked main-thread write to the same field
+    fires even though neither write is in ``_run`` itself."""
+    src = textwrap.dedent("""\
+        import threading
+
+
+        class Worker:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._state = 0
+                self._thread = threading.Thread(target=self._run)
+
+            def _run(self):
+                self._step()
+
+            def _step(self):
+                self._state = 1
+
+            def reset(self):
+                self._state = 0
+        """)
+    findings = _only_rule(_run({"data/_producer.py": src}), "APX304")
+    assert any("self._state" in f.message for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# APX305 — jit-stability (shape-varying churn knob fixture)
+# ---------------------------------------------------------------------------
+
+def _slicer(k):
+    def fn(x):
+        return x[:k] * 2.0
+    return fn
+
+
+def test_apx305_stable_program_is_silent():
+    x = np.ones((8,), np.float32)
+    hashes = [(f"churn{i}", trace_hash(_slicer(4), (x,)))
+              for i in range(3)]
+    report = check_hashes("toy", hashes)
+    assert report.ok and not report.findings, report.format()
+
+
+def test_apx305_shape_varying_knob_fires():
+    """A churn knob consumed as a python int changes the sliced shape —
+    the traced structure differs between configs."""
+    x = np.ones((8,), np.float32)
+    hashes = [("k=4", trace_hash(_slicer(4), (x,))),
+              ("k=6", trace_hash(_slicer(6), (x,)))]
+    findings = _only_rule(check_hashes("toy", hashes), "APX305")
+    assert "toy" in findings[0].location
+    assert "k=4" in findings[0].message and "k=6" in findings[0].message
+
+
+def test_apx305_baked_literal_fires_at_fixed_shape():
+    """Same avals, different baked constant: a scalar knob folded into
+    the trace as a literal still changes the structure hash."""
+    x = np.ones((8,), np.float32)
+    hashes = [("t=0.5", trace_hash(lambda v: v * 0.5, (x,))),
+              ("t=0.9", trace_hash(lambda v: v * 0.9, (x,)))]
+    _only_rule(check_hashes("toy", hashes), "APX305")
+
+
+def test_structure_hash_ignores_values_at_fixed_structure():
+    import jax
+
+    a = structure_hash(jax.make_jaxpr(lambda v: v + 1.0)(
+        np.zeros((4,), np.float32)))
+    b = structure_hash(jax.make_jaxpr(lambda v: v + 1.0)(
+        np.ones((4,), np.float32) * 7))
+    assert a == b
+
+
+# ---------------------------------------------------------------------------
+# CLI wiring: pseudo-entries + structured --json
+# ---------------------------------------------------------------------------
+
+def test_cli_lists_pseudo_entries(capsys):
+    from apex_tpu.analysis import cli
+
+    assert cli.main(["--list-entries"]) == 0
+    out = capsys.readouterr().out.split()
+    assert "control_plane" in out and "stability" in out
+    assert "serving_decode" in out
+
+
+def test_cli_json_is_structured(capsys):
+    """--json emits one machine-readable object (satellite: CI consumes
+    verdicts without parsing human text) — stdout is pure JSON, the
+    human verdict line goes to stderr."""
+    from apex_tpu.analysis import cli
+
+    rc = cli.main(["--entries", "control_plane", "--json"])
+    captured = capsys.readouterr()
+    assert rc == 0
+    doc = json.loads(captured.out)
+    assert doc["verdict"] == "PASS"
+    assert doc["counts"]["errors"] == 0
+    assert doc["entries"][0]["name"] == "control_plane"
+    assert isinstance(doc["findings"], list)
+    assert "APX305" in captured.err or "apex_tpu.analysis" in captured.err
+
+
+def test_control_rules_registered():
+    from apex_tpu.analysis.registry import RULEBOOK, rules_for
+
+    assert {"APX301", "APX302", "APX303", "APX304"} <= set(RULEBOOK)
+    assert {r.id for r in rules_for("stability")} == {"APX305"}
+    for rid in ("APX301", "APX302", "APX303", "APX304", "APX305"):
+        rule = RULEBOOK[rid]
+        assert rule.catches and rule.motivation and rule.title

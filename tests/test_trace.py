@@ -632,3 +632,26 @@ def test_replica_process_poll_unpacks_batches():
                       ("token", 0, 2), ("finished", 0)]
     assert rp.relay_batches == 2
     assert rp.relay_batched_events == 3
+
+
+def test_trace_report_check_gate(tmp_path, capsys):
+    """scripts/trace_report.py --check (ISSUE 19 satellite): the trace
+    plane's invariants as an exit code.  The synthetic failover spill
+    closes its books exactly (0 overcommit, 0 unattributed), so the
+    default budget passes; forcing the unattributed budget below zero
+    proves the gate actually fires instead of always printing ok."""
+    import importlib.util
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "trace_report", os.path.join(repo, "scripts", "trace_report.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    _build_failover_spills(tmp_path)
+    assert mod.main([str(tmp_path), "--check"]) == 0
+    assert "check ok" in capsys.readouterr().err
+    assert mod.main(
+        [str(tmp_path), "--check", "--max-unattributed-pct=-1"]) == 1
+    assert "UNATTRIBUTED" in capsys.readouterr().err
