@@ -57,6 +57,7 @@ _EVENT_EMITTERS = (
     "serving/engine.py", "serving/fleet.py", "serving/autopilot.py",
     "serving/scheduler.py", "serving/replica.py", "data/prefetch.py",
     "resilience/manager.py", "observability/timeline.py",
+    "observability/slo.py",
 )
 _EVENT_CONSUMERS = ("observability/trace.py", "observability/goodput.py")
 _METRIC_EMITTERS = (

@@ -38,11 +38,22 @@ layers the production JAX/PyTorch trainers treat as first-class
   HTTP :class:`DebugServer` (``/metrics`` Prometheus text,
   ``/statusz`` live timeline tail + goodput + engine state).
 
+- :mod:`.timeseries` + :mod:`.slo` — the **longitudinal** layer
+  (ISSUE 20): a jax-free fixed-memory multi-resolution ring-buffer
+  :class:`MetricHistory` snapshotting a registry on an injected-clock
+  cadence (counter→rate with reset handling, compacted deltas over the
+  fleet's state heartbeats), plus :class:`SLOPolicy` /
+  :class:`SLOEvaluator` — Google-SRE multi-window burn-rate alerting
+  with hysteresis, typed ``slo_burn_alert`` / ``slo_burn_clear``
+  timeline events, error-budget accounting, and the predictive signals
+  the fleet autopilot scales on; OpenMetrics exposition at
+  ``/metrics.prom`` (:func:`render_openmetrics`).
+
 Catalog, span map, timeline schema, goodput cookbook, and the
 profiler-capture cookbook: ``docs/observability.md``.
 """
 
-from apex_tpu.observability.debug_server import DebugServer
+from apex_tpu.observability.debug_server import DebugServer, render_openmetrics
 from apex_tpu.observability.goodput import (
     format_report,
     goodput_report,
@@ -59,9 +70,12 @@ from apex_tpu.observability.metrics import (
     peak_flops_for,
     peak_flops_reason,
 )
+from apex_tpu.observability.slo import SLOEvaluator, SLOPolicy
 from apex_tpu.observability.timeline import FlightRecorder
+from apex_tpu.observability.timeseries import MetricHistory, match_series
 from apex_tpu.observability.trace import (
     TRACE_HOP_BUCKETS,
+    collect_slo_events,
     estimate_offset,
     format_trace_report,
     merge_dir,
@@ -126,4 +140,10 @@ __all__ = [
     "summarize_traces",
     "merge_dir",
     "format_trace_report",
+    "MetricHistory",
+    "match_series",
+    "SLOPolicy",
+    "SLOEvaluator",
+    "render_openmetrics",
+    "collect_slo_events",
 ]

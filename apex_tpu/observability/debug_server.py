@@ -12,6 +12,11 @@ without any agent, sidecar, or dependency the container doesn't have:
   ``apex_serving_ttft_ms``); every series carries a ``rank`` label so
   multi-host scrapes stay distinguishable (the host-local/global split,
   docs/observability.md).
+- ``GET /metrics.prom`` — the same snapshot in strict OpenMetrics 1.0
+  text (ISSUE 20): paired ``# HELP``/``# TYPE`` per family, counter
+  samples suffixed ``_total``, terminated by ``# EOF`` — for scrapers
+  that negotiate the OpenMetrics content type and reject the laxer
+  Prometheus 0.0.4 body.
 - ``GET /statusz`` — JSON for a human mid-incident: the flight
   recorder's timeline tail and goodput-so-far, plus the serving
   engine's live state (active slots, free blocks, queue depth,
@@ -43,7 +48,7 @@ import re
 import threading
 from typing import Optional
 
-__all__ = ["DebugServer"]
+__all__ = ["DebugServer", "render_prometheus", "render_openmetrics"]
 
 logger = logging.getLogger(__name__)
 
@@ -95,6 +100,49 @@ def render_prometheus(registry) -> str:
     return "\n".join(lines) + "\n"
 
 
+def render_openmetrics(registry) -> str:
+    """OpenMetrics 1.0 text exposition of one registry snapshot
+    (ISSUE 20: ``/metrics.prom``) — the stricter sibling of
+    :func:`render_prometheus` for scrapers that negotiate the
+    OpenMetrics content type: every metric family carries a paired
+    ``# HELP``/``# TYPE`` preamble, counter *samples* take the
+    mandatory ``_total`` suffix (the family name stays suffix-free),
+    histograms expose as summaries (``_count``/``_sum`` + ``quantile``
+    labels), and the body terminates with the required ``# EOF``.  The
+    format-lint test in ``tests/test_slo.py`` parses this line by line
+    so the scrape surface cannot silently drift."""
+    lines = []
+    label = f'{{rank="{registry.rank}"}}'
+    typed = registry.snapshot_typed()
+
+    def meta(pn: str, mtype: str, name: str) -> None:
+        lines.append(f"# HELP {pn} apex_tpu metric {name}")
+        lines.append(f"# TYPE {pn} {mtype}")
+
+    for name, value in sorted(typed["counters"].items()):
+        pn = _prom_name(name)
+        meta(pn, "counter", name)
+        lines.append(f"{pn}_total{label} {_prom_value(value)}")
+    for name, value in sorted(typed["gauges"].items()):
+        if value is None:
+            continue
+        pn = _prom_name(name)
+        meta(pn, "gauge", name)
+        lines.append(f"{pn}{label} {_prom_value(value)}")
+    for name, s in sorted(typed["histograms"].items()):
+        pn = _prom_name(name)
+        meta(pn, "summary", name)
+        lines.append(f"{pn}_count{label} {_prom_value(s['count'])}")
+        lines.append(f"{pn}_sum{label} {_prom_value(s['total'])}")
+        for key, q in (("p50", "0.5"), ("p99", "0.99")):
+            if s.get(key) is not None:
+                lines.append(
+                    f'{pn}{{rank="{registry.rank}",quantile="{q}"}} '
+                    f"{_prom_value(s[key])}")
+    lines.append("# EOF")
+    return "\n".join(lines) + "\n"
+
+
 class DebugServer:
     """Background HTTP thread serving ``/metrics`` and ``/statusz``.
 
@@ -123,6 +171,9 @@ class DebugServer:
 
     def metrics_text(self) -> str:
         return render_prometheus(self.registry)
+
+    def metrics_prom_text(self) -> str:
+        return render_openmetrics(self.registry)
 
     def statusz(self) -> dict:
         rec = self.recorder
@@ -203,6 +254,11 @@ class DebugServer:
                     if self.path.split("?")[0] == "/metrics":
                         self._send(200, server.metrics_text().encode(),
                                    "text/plain; version=0.0.4")
+                    elif self.path.split("?")[0] == "/metrics.prom":
+                        self._send(200,
+                                   server.metrics_prom_text().encode(),
+                                   "application/openmetrics-text; "
+                                   "version=1.0.0; charset=utf-8")
                     elif self.path.split("?")[0] == "/statusz":
                         self._send(200,
                                    json.dumps(server.statusz(),
@@ -224,8 +280,8 @@ class DebugServer:
                                    "application/json")
                     elif self.path.split("?")[0] == "/":
                         self._send(200, b"apex_tpu debug server: "
-                                   b"/metrics /statusz /healthz "
-                                   b"/fleet/statusz\n",
+                                   b"/metrics /metrics.prom /statusz "
+                                   b"/healthz /fleet/statusz\n",
                                    "text/plain")
                     else:
                         self._send(404, b"not found\n", "text/plain")

@@ -73,6 +73,7 @@ import bisect
 import glob
 import itertools
 import os
+import re
 from typing import Dict, List, Optional, Tuple
 
 from apex_tpu.observability.goodput import split_runs
@@ -88,6 +89,7 @@ __all__ = [
     "stitch_traces",
     "summarize_traces",
     "collect_decisions",
+    "collect_slo_events",
     "merge_dir",
     "format_trace_report",
     "TRACE_UNATTRIBUTED_KINDS",
@@ -206,18 +208,45 @@ def _run_meta(run: List[dict]) -> dict:
     return head
 
 
+_ROTATED_RE = re.compile(r"\.rot-(\d+)\.jsonl$")
+
+
+def _spill_groups(timeline_dir: str) -> List[List[str]]:
+    """Group a spill directory's files into logical streams: a
+    ``JsonlWriter(rotate_bytes=...)`` leaves ``<stem>.rot-NNNNNN.jsonl``
+    segments beside the live ``<stem>.jsonl`` (ISSUE 20); each group is
+    its segments in rotation order with the live file last, so
+    concatenating a group replays the stream's append order exactly."""
+    groups: Dict[str, List[Tuple[int, str]]] = {}
+    for path in sorted(glob.glob(
+            os.path.join(timeline_dir, "timeline*.jsonl"))):
+        m = _ROTATED_RE.search(path)
+        if m:
+            base = path[:m.start()] + ".jsonl"
+            seq = int(m.group(1))
+        else:
+            base, seq = path, 1 << 62
+        groups.setdefault(base, []).append((seq, path))
+    return [[p for _seq, p in sorted(groups[base])]
+            for base in sorted(groups)]
+
+
 def read_fleet_spills(timeline_dir: str, *, strict: bool = True):
     """Discover and load a fleet run's spills: ``(router_run,
     replica_runs)`` where ``replica_runs`` maps replica name → list of
     runs (a rolled replica leaves one spill per incarnation, each its
-    own pid).  Newest run per file (`split_runs` — a reused spill path
-    appends).  Files whose ``run_begin`` carries no fleet role are
-    ignored (a plain PR 9 timeline can share the directory)."""
+    own pid).  Rotated segments of one stream are concatenated back in
+    order first; then the newest run per stream (`split_runs` — a
+    reused spill path appends).  Files whose ``run_begin`` carries no
+    fleet role are ignored (a plain PR 9 timeline can share the
+    directory)."""
     router_run: Optional[List[dict]] = None
     replica_runs: Dict[str, List[List[dict]]] = {}
-    for path in sorted(glob.glob(
-            os.path.join(timeline_dir, "timeline*.jsonl"))):
-        runs = split_runs(read_jsonl(path, strict=strict))
+    for group in _spill_groups(timeline_dir):
+        events: List[dict] = []
+        for path in group:
+            events.extend(read_jsonl(path, strict=strict))
+        runs = split_runs(events)
         if not runs:
             continue
         run = runs[-1]
@@ -528,6 +557,34 @@ def collect_decisions(router_run: Optional[List[dict]]) -> List[dict]:
     return sorted(by_id.values(),
                   key=lambda r: (r["t"] if r["t"] is not None else 0.0,
                                  str(r["decision_id"])))
+
+
+def collect_slo_events(events: Optional[List[dict]]) -> dict:
+    """(ISSUE 20) Reconstruct the SLO plane's story from a spill: the
+    burn-rate transition events and the periodic budget-table snapshots
+    the evaluator emitted.  ``{"alerts": [...], "clears": [...],
+    "states": [...], "open": [...]}`` — ``open`` lists the
+    ``(policy, metric)`` pairs whose newest transition is an alert with
+    no later clear (an incident still burning at end of spill).  This
+    is the consumption side of the ``slo_burn_alert`` /
+    ``slo_burn_clear`` / ``slo_state`` vocabulary (APX302) and the raw
+    material of ``scripts/slo_report.py``."""
+    alerts: List[dict] = []
+    clears: List[dict] = []
+    states: List[dict] = []
+    last: Dict[Tuple[str, str], str] = {}
+    for ev in events or []:
+        kind = ev.get("kind")
+        if kind == "slo_burn_alert":
+            alerts.append(dict(ev))
+            last[(str(ev.get("policy")), str(ev.get("metric")))] = "alert"
+        elif kind == "slo_burn_clear":
+            clears.append(dict(ev))
+            last[(str(ev.get("policy")), str(ev.get("metric")))] = "clear"
+        elif kind == "slo_state":
+            states.append(dict(ev))
+    return {"alerts": alerts, "clears": clears, "states": states,
+            "open": sorted(k for k, v in last.items() if v == "alert")}
 
 
 def merge_dir(timeline_dir: str, *, strict: bool = True,

@@ -48,11 +48,27 @@ class JsonlWriter:
     """
 
     def __init__(self, path: str, *, fsync: bool = True, retries: int = 3,
-                 backoff_s: float = 0.05, keep_open: bool = False):
+                 backoff_s: float = 0.05, keep_open: bool = False,
+                 rotate_bytes: Optional[int] = None):
         self.path = path
         self.fsync = fsync
         self.retries = retries
         self.backoff_s = backoff_s
+        # rotate_bytes (default off, ISSUE 20): when appending the next
+        # record would push the live file past the bound, the file is
+        # first renamed to a `<stem>.rot-NNNNNN.jsonl` segment and the
+        # append opens a fresh file.  Rotation happens strictly BETWEEN
+        # records (a frame boundary), so every segment keeps the
+        # torn-tail-only durability contract: the single-write line
+        # atomicity is untouched, only the file the O_APPEND descriptor
+        # points at changes.  Segment names keep the `.jsonl` suffix so
+        # spill readers glob them up; ``trace.read_fleet_spills`` groups
+        # segments back into one logical stream in rotation order.
+        if rotate_bytes is not None and rotate_bytes <= 0:
+            raise ValueError("rotate_bytes must be positive (or None)")
+        self.rotate_bytes = rotate_bytes
+        self.rotations = 0
+        self._size: Optional[int] = None   # live-file bytes, lazy stat
         # keep_open=True holds one O_APPEND descriptor across records
         # instead of an open→write→close cycle per record.  Durability
         # is IDENTICAL (each record is still a single O_APPEND
@@ -82,6 +98,8 @@ class JsonlWriter:
         line)."""
         data = (json.dumps(record, separators=(",", ":"),
                            default=_json_fallback) + "\n").encode()
+        if self.rotate_bytes is not None:
+            self._maybe_rotate(len(data))
         sent = 0
         for attempt in range(self.retries + 1):
             try:
@@ -111,6 +129,8 @@ class JsonlWriter:
                     finally:
                         os.close(fd)
                 self.records_written += 1
+                if self._size is not None:
+                    self._size += len(data)
                 return
             except OSError as e:
                 # a kept descriptor that errored is suspect (stale NFS
@@ -124,6 +144,37 @@ class JsonlWriter:
                     "metrics append to %s failed (%r), retry %d/%d in "
                     "%.2fs", self.path, e, attempt + 1, self.retries, delay)
                 time.sleep(delay)
+
+    def _rotated_name(self, seq: int) -> str:
+        stem, ext = os.path.splitext(self.path)
+        if ext != ".jsonl":
+            stem, ext = self.path, ""
+        return f"{stem}.rot-{seq:06d}{ext}"
+
+    def _maybe_rotate(self, incoming: int) -> None:
+        """Rename the live file aside when the next append would cross
+        ``rotate_bytes`` — between records only, so every segment ends
+        on a whole line.  Rotation is best-effort: a failed rename logs
+        and keeps appending (durability beats the size bound)."""
+        if self._size is None:
+            try:
+                self._size = os.stat(self.path).st_size
+            except OSError:
+                self._size = 0
+        if self._size <= 0 or self._size + incoming <= self.rotate_bytes:
+            return
+        seq = self.rotations + 1
+        while os.path.exists(self._rotated_name(seq)):
+            seq += 1          # a restarted writer never clobbers history
+        try:
+            self.close()      # the kept descriptor must follow the file
+            os.rename(self.path, self._rotated_name(seq))
+        except OSError as e:
+            logger.warning("JSONL rotation of %s failed (%r); appending "
+                           "past rotate_bytes", self.path, e)
+            return
+        self.rotations = seq
+        self._size = 0
 
     def close(self) -> None:
         """Release the kept descriptor (keep_open mode); a later write

@@ -152,6 +152,20 @@ class AutopilotConfig:
     canary_rounds: int = 5
     canary_min_rounds: int = 3
     canary_regress_ratio: float = 1.2
+    # -- predictive scale (ISSUE 20): when the router's longitudinal
+    # history is armed, project the fleet TTFT p99 forward by the
+    # regression slope over ``predictive_window_s`` of real buckets; a
+    # projected breach of the objective within ``predictive_horizon_s``
+    # (or a slow-window SLO burn at/over ``predictive_burn``) triggers
+    # scale-up BEFORE the queue-depth threshold trips.
+    # ``predictive_objective_ms`` 0.0 derives the objective from the
+    # router's own TTFT SLO policies (the tightest one).  A disarmed
+    # router (history=None) makes the whole path a no-op: the observe
+    # payload and every decision stay byte-identical to PR 19.
+    predictive_horizon_s: float = 10.0
+    predictive_window_s: float = 10.0
+    predictive_objective_ms: float = 0.0
+    predictive_burn: float = 6.0
 
     def __post_init__(self):
         if self.min_replicas < 1:
@@ -174,6 +188,14 @@ class AutopilotConfig:
             raise ValueError(
                 f"queue_bound_step must be > 1, got "
                 f"{self.queue_bound_step}")
+        if self.predictive_horizon_s < 0 or self.predictive_window_s <= 0:
+            raise ValueError(
+                "predictive_horizon_s must be >= 0 and "
+                "predictive_window_s > 0")
+        if self.predictive_burn <= 0:
+            raise ValueError(
+                f"predictive_burn must be positive, got "
+                f"{self.predictive_burn}")
 
 
 class FleetAutopilot:
@@ -427,6 +449,41 @@ class FleetAutopilot:
         self._count("actions")
         return True
 
+    def _predict(self, now: float):
+        """Predictive scale signal off the router's longitudinal
+        history (ISSUE 20): project the fleet TTFT p99 forward by its
+        regression slope; a projected objective breach within the
+        horizon — or a slow-window SLO burn over ``predictive_burn`` —
+        is a scale-up trigger that fires BEFORE queue depth does.
+        Returns ``(predictive, extra_observe)``; ``(False, None)`` when
+        the history plane is disarmed, so the PR 19 observe payload and
+        decision stream stay byte-identical."""
+        cfg = self.config
+        history = getattr(self.router, "history", None)
+        if history is None or cfg.predictive_horizon_s <= 0:
+            return False, None
+        series = "fleet/ttft_ms:p99"
+        slope = history.slope(series, cfg.predictive_window_s, now=now)
+        last = history.latest(series)
+        objective = cfg.predictive_objective_ms
+        slo = getattr(self.router, "slo", None)
+        if objective <= 0 and slo is not None:
+            objs = [p.objective for p in slo.policies
+                    if p.metric.startswith("fleet/ttft_ms")]
+            if objs:
+                objective = min(objs)
+        burn = 0.0
+        if slo is not None and slo.last_rows:
+            burn = max(r["burn_slow"] for r in slo.last_rows)
+        extra = {"history_slope_ms_per_s": round(slope, 4),
+                 "history_p99_ms": (None if last is None
+                                    else round(last, 3)),
+                 "burn_slow": round(burn, 4)}
+        breach = bool(
+            last is not None and slope > 0 and objective > 0
+            and last + slope * cfg.predictive_horizon_s >= objective)
+        return breach or burn >= cfg.predictive_burn, extra
+
     def _maybe_scale(self, now: float) -> bool:
         """One load-driven scale action per cool-down window."""
         cfg = self.config
@@ -441,12 +498,15 @@ class FleetAutopilot:
         observe = {"queue_depth": depth,
                    "p99_trend_ms_per_s": round(trend, 4),
                    "live": len(live)}
+        predictive, pred_obs = self._predict(now)
+        if pred_obs is not None:
+            observe.update(pred_obs)
         deep = depth >= cfg.scale_up_queue_depth
         trending = trend >= cfg.scale_up_trend_ms_per_s
-        if (deep or trending) and self.spawn is not None \
+        if (deep or trending or predictive) and self.spawn is not None \
                 and len(live) < cfg.max_replicas:
-            if trending and not deep and any(v.link_degraded
-                                            for v in live):
+            if (trending or predictive) and not deep \
+                    and any(v.link_degraded for v in live):
                 # the slow-link row of the fault matrix: the tail
                 # slope is the wire's, and placement already demotes
                 # the degraded replica — more capacity would not move
@@ -469,6 +529,8 @@ class FleetAutopilot:
             did = self._decide(
                 "scale", "scale_up",
                 ("queue depth over threshold" if deep
+                 else "predicted p99 TTFT breach within horizon"
+                 if predictive and not trending
                  else "p99 TPOT trending up"),
                 observe, replica=name)
             if self._spawn_into(name, did, now):
@@ -476,7 +538,7 @@ class FleetAutopilot:
                 self._last_scale_t = now
             return True
         if depth <= cfg.scale_down_queue_depth and trend <= 0.0 \
-                and len(live) > cfg.min_replicas:
+                and not predictive and len(live) > cfg.min_replicas:
             victim = self._pick_drain_victim(live)
             if victim is None:
                 return False

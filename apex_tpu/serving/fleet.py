@@ -324,6 +324,9 @@ class FleetRouter:
                  migrate_min_remaining: int = 2,
                  migrate_max_inflight: int = 16,
                  trend_window_s: float = 1.0, trend_windows: int = 8,
+                 history_every_s: float = 0.0,
+                 history_max_series: int = 512,
+                 slo_policies=None,
                  registry=None, clock: Callable[[], float] = time.monotonic):
         from apex_tpu.observability.metrics import default_registry
 
@@ -439,6 +442,35 @@ class FleetRouter:
         # so knob rounds are reproducible under injected clocks
         self._knob_acks: Dict[tuple, tuple] = {}
         self._knob_tokens = itertools.count(1)
+        # longitudinal history + SLO burn-rate plane (ISSUE 20): armed
+        # by history_every_s > 0, the pump snapshots the registry into
+        # a fixed-memory MetricHistory on that cadence, merges the
+        # compacted deltas replicas ship on their state heartbeats, and
+        # (when policies are given) evaluates multi-window burn rates
+        # into slo_burn_alert/slo_burn_clear timeline events.  DISARMED
+        # (the default) every touch point below is a single None check:
+        # the PR 19 fleet, byte for byte.
+        self.history_every_s = float(history_every_s)
+        if self.history_every_s > 0:
+            from apex_tpu.observability.slo import SLOEvaluator
+            from apex_tpu.observability.timeseries import MetricHistory
+
+            self.history = MetricHistory(
+                self.registry, clock=clock,
+                max_series=history_max_series,
+                on_overflow=lambda: self.registry.counter(
+                    "fleet/series_overflow").inc())
+            self.slo = SLOEvaluator(self.history, slo_policies or (),
+                                    clock=clock) \
+                if slo_policies else None
+            self._history_last_t: Optional[float] = None
+        else:
+            if slo_policies:
+                raise ValueError(
+                    "slo_policies need the history armed: pass "
+                    "history_every_s > 0")
+            self.history = None
+            self.slo = None
 
     # ----------------------------------------------------------- tenants
 
@@ -528,6 +560,9 @@ class FleetRouter:
         if key in keys:
             return key
         if len(keys) >= self.slo_key_cap:
+            # the overflow is itself observable (ISSUE 20): a fleet
+            # whose tenant cardinality blew the cap should say so
+            self.registry.counter("fleet/series_overflow").inc()
             keys.add("(other)")
             return "(other)"
         keys.add(key)
@@ -598,6 +633,20 @@ class FleetRouter:
         self.registry.gauge("fleet/queue_depth").set(
             self.total_queue_depth())
         self._update_trend()
+        if self.history is not None:
+            self._pump_history()
+
+    def _pump_history(self) -> None:
+        """One history snapshot + SLO evaluation per elapsed cadence
+        window (injected clock) — armed fleets only."""
+        now = self._clock()
+        if self._history_last_t is not None \
+                and now - self._history_last_t < self.history_every_s:
+            return
+        self._history_last_t = now
+        self.history.sample(now)
+        if self.slo is not None:
+            self.slo.evaluate(now)
 
     def _update_trend(self) -> None:
         """One p99 snapshot per elapsed trend window (injected clock)."""
@@ -710,8 +759,17 @@ class FleetRouter:
             view.ready = True
             view.meta = ev[1]
         elif kind == "state":
+            # a history-armed replica (ISSUE 20) attaches its compacted
+            # delta to the ordinary heartbeat — popped here so the raw
+            # buckets never sit in view.state, merged only when this
+            # router keeps a history of its own (prefixed per replica,
+            # bucket stamps rebased onto the router clock at ingest)
+            delta = ev[1].pop("history", None)
             view.state = ev[1]
             view.draining = bool(ev[1].get("draining"))
+            if delta and self.history is not None:
+                self.history.ingest_delta(
+                    delta, prefix=f"replica/{view.name}/")
         elif kind == "token":
             _, frid, token = ev
             req = self.requests.get(frid)
@@ -1909,7 +1967,7 @@ class FleetRouter:
                 agg = spec_acc.setdefault(str(aid), [0, 0])
                 agg[0] += int(row.get("proposed") or 0)
                 agg[1] += int(row.get("accepted") or 0)
-        return {
+        out = {
             "replicas": base["replicas"],
             "queue_depth": base["queue_depth"],
             "pending": base["pending"],
@@ -1961,6 +2019,18 @@ class FleetRouter:
             "fleet_ttft_ms": hist_row("fleet/ttft_ms"),
             "fleet_tpot_ms": hist_row("fleet/tpot_ms", keep=65536),
         }
+        # longitudinal history + burn-rate blocks (ISSUE 20) appear ONLY
+        # when the history plane is armed — a disarmed fleet's statusz
+        # stays byte-for-byte the PR 19 shape
+        if self.history is not None:
+            out["history"] = self.history.introspect()
+            if self.slo is not None:
+                out["slo"]["burn"] = {
+                    "rows": self.slo.last_rows,
+                    "worst": self.slo.worst(),
+                    **self.slo.introspect(),
+                }
+        return out
 
     # ---------------------------------------------------------- lifecycle
 

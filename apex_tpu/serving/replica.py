@@ -253,6 +253,14 @@ class ReplicaSpec:
     timeline_tick_every: int = 8     # decode_tick sampling (1 = every
     #                                  token: the trace smoke's precise
     #                                  hop boundaries)
+    # longitudinal history (ISSUE 20): > 0 arms a child-side
+    # MetricHistory sampled every this-many seconds; each completed
+    # ring bucket ships to the router as a compacted delta riding the
+    # EXISTING state heartbeat (no new command, no new wire frame —
+    # ``snap["history"]``), where it merges under ``replica/<name>/``.
+    # 0.0 (the default) = disarmed: the heartbeat payload is
+    # byte-for-byte the PR 19 shape.
+    history_every_s: float = 0.0
 
     def __post_init__(self):
         if self.role not in ("prefill", "decode", "both"):
@@ -366,6 +374,12 @@ def _replica_worker(spec: ReplicaSpec, name: str, cmd_q, evt_q,
         exported = {}      # frid -> engine rid, pinned until kv_ack
         imports = {}       # frid -> {"meta", "blocks": {idx: payload}}
         last_state = 0.0
+        history = None
+        last_hist = [0.0]
+        if spec.history_every_s > 0:
+            from apex_tpu.observability.timeseries import MetricHistory
+
+            history = MetricHistory(registry)
 
         def flush() -> None:
             # one queue put per relay turn (ISSUE 15 satellite): the
@@ -404,6 +418,18 @@ def _replica_worker(spec: ReplicaSpec, name: str, cmd_q, evt_q,
                 # /fleet/statusz backlog signal
                 snap["kv_pending_imports"] = len(imports)
                 snap["kv_exports_pinned"] = len(exported)
+                # history delta (ISSUE 20): sample the local registry on
+                # its own cadence and piggyback completed ring buckets on
+                # this very heartbeat — the router rebases the bucket
+                # stamps onto its own clock at ingest, so the two hosts'
+                # monotonic epochs never have to agree
+                if history is not None and (
+                        now - last_hist[0] >= spec.history_every_s):
+                    last_hist[0] = now
+                    history.sample(now)
+                    delta = history.export_delta(now)
+                    if delta is not None:
+                        snap["history"] = delta
                 evt_q.put(("state", snap))
                 return now
             return last_state

@@ -93,7 +93,8 @@ def main() -> int:
             serving=ServingConfig(max_batch=3, block_size=4,
                                   max_seq=MAX_SEQ, prefill_len=MAX_SEQ),
             tp=1, ckpt_dir=None, debug_server=False,
-            timeline_dir=trace_dir, timeline_tick_every=1)
+            timeline_dir=trace_dir, timeline_tick_every=1,
+            history_every_s=0.05)
         names = ["s0", "s1", "s2"]
         t0 = time.monotonic()
         started = {n: start_replica_server(spec, n, addr_timeout_s=300)
@@ -107,10 +108,23 @@ def main() -> int:
         log(f"3 traced socket replicas ready in "
             f"{time.monotonic() - t0:.1f}s")
         registry = MetricRegistry(rank=0, world=1)
+        # longitudinal history + a deliberately loose SLO (ISSUE 20):
+        # the real fleet exercises the sample/export/ingest wire and
+        # the evaluator's snapshot cadence; the huge objective keeps
+        # the burn at zero so slo_report's --check gate must pass
+        from apex_tpu.observability.slo import SLOPolicy
+
         router = FleetRouter(clients, max_queue_depth=24,
                              replica_queue_limit=3,
                              heartbeat_timeout_s=2.0, probe_retries=2,
-                             probe_backoff_s=0.25, registry=registry)
+                             probe_backoff_s=0.25, registry=registry,
+                             history_every_s=0.05,
+                             slo_policies=[SLOPolicy(
+                                 name="smoke-ttft",
+                                 metric="fleet/ttft_ms:p99",
+                                 objective=1e9,
+                                 fast_window_s=1.0, slow_window_s=5.0,
+                                 compliance_window_s=60.0)])
 
         # ---- traced wave + SIGKILL mid-decode -----------------------
         waves = [(rng.randint(1, VOCAB - 1,
@@ -266,6 +280,23 @@ def main() -> int:
             return 1
         log("trace_report.py output (--check passed):\n"
             + cli.stdout.decode(errors="replace"))
+
+        # ---- the SLO plane's operator entry point (ISSUE 20) --------
+        slo_cli = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "scripts", "slo_report.py"),
+             trace_dir, "--check"],
+            capture_output=True, timeout=120)
+        if slo_cli.returncode != 0:
+            log(f"FAIL: slo_report.py rc={slo_cli.returncode}: "
+                f"{slo_cli.stderr.decode(errors='replace')[-500:]}")
+            return 1
+        if b"check ok" not in slo_cli.stderr:
+            log("FAIL: slo_report.py --check printed no verdict: "
+                f"{slo_cli.stderr.decode(errors='replace')[-500:]}")
+            return 1
+        log("slo_report.py output (--check passed):\n"
+            + slo_cli.stdout.decode(errors="replace"))
 
         # ---- tier gating (ISSUE 17 satellite) -----------------------
         # Phase D stands up a SECOND fleet (4 more daemons, 4 more

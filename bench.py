@@ -2601,6 +2601,120 @@ def bench_serving_trace_overhead(jax, on_tpu):
         parallel.destroy_model_parallel()
 
 
+def bench_serving_slo_overhead(jax, on_tpu):
+    """Longitudinal history + SLO burn-rate evaluation on the serving
+    hot path (ISSUE 20): the same continuous-batching wave BARE vs
+    ARMED with a :class:`MetricHistory` sampling the engine registry
+    and an :class:`SLOEvaluator` walking its burn-rate state machine
+    every 4th step — a far hotter cadence than the shipped per-second
+    default, so the gate bounds a deliberate worst case.  Both legs
+    drive the engine through an identical manual step loop (only the
+    sample/evaluate calls differ), paired rounds, median-of-ratios —
+    the serving_trace_overhead discipline.  ``vs_bare`` <= 1.05 is the
+    standing free-telemetry acceptance gate (scripts/bench_regress.py):
+    the history plane must ride inside the existing telemetry budget.
+    A disarmed fleet is a single None check and is the bare leg."""
+    import numpy as np
+
+    from apex_tpu import parallel
+    from apex_tpu.observability.metrics import MetricRegistry
+    from apex_tpu.observability.slo import SLOEvaluator, SLOPolicy
+    from apex_tpu.observability.timeseries import MetricHistory
+    from apex_tpu.serving import ServingConfig, ServingEngine
+    from apex_tpu.transformer.testing import TransformerConfig
+    from apex_tpu.transformer.testing.gpt_parallel_train import build_gpt_3d
+
+    mesh = parallel.initialize_model_parallel(
+        tensor_model_parallel_size=1, devices=jax.devices()[:1])
+    try:
+        hidden, layers, heads, vocab = (
+            (512, 4, 8, 2048) if on_tpu else (256, 2, 8, 512))
+        max_batch, prompt_len, gen = 8, 12, 24
+        cfg = TransformerConfig(
+            hidden_size=hidden, num_layers=layers,
+            num_attention_heads=heads, padded_vocab_size=vocab,
+            max_position_embeddings=256, hidden_dropout=0.0,
+            attention_dropout=0.0, tensor_axis="tp",
+            use_flash_attention=True)
+        init_fn, _, _ = build_gpt_3d(cfg, num_chunks=layers,
+                                     num_microbatches=1, mesh=mesh)
+        params, _ = init_fn(jax.random.PRNGKey(0),
+                            jax.numpy.zeros((2, 8), jax.numpy.int32))
+        registry = MetricRegistry(rank=0, world=1)
+        engine = ServingEngine(
+            cfg, ServingConfig(max_batch=max_batch, block_size=16,
+                               max_seq=prompt_len + gen + 8,
+                               prefill_len=128),
+            params, mesh=mesh, registry=registry)
+        rng = np.random.RandomState(0)
+        prompts = [rng.randint(1, vocab - 1, size=prompt_len).tolist()
+                   for _ in range(max_batch)]
+        history = MetricHistory(registry)
+        evaluator = SLOEvaluator(history, [
+            SLOPolicy(name="ttft", metric="serving/ttft_ms:p99",
+                      objective=50.0, fast_window_s=5.0,
+                      slow_window_s=30.0, compliance_window_s=300.0),
+            SLOPolicy(name="tpot", metric="serving/tpot_ms:p99",
+                      objective=20.0, fast_window_s=5.0,
+                      slow_window_s=30.0, compliance_window_s=300.0),
+        ])
+
+        def wave(armed: bool) -> float:
+            t0 = time.perf_counter()
+            for p in prompts:
+                engine.submit(p, gen)
+            steps = 0
+            for _ in range(5000):
+                if engine.scheduler.idle:
+                    break
+                engine.step()
+                steps += 1
+                if armed and steps % 4 == 0:
+                    history.sample()
+                    evaluator.evaluate()
+            return time.perf_counter() - t0
+
+        wave(False)                    # compile + warm both programs
+        import statistics
+
+        pairs = []
+        for r in range(16):
+            if r % 2:
+                b = wave(False)
+                t = wave(True)
+            else:
+                t = wave(True)
+                b = wave(False)
+            pairs.append((t, b))
+        vs_bare = statistics.median(t / b for t, b in pairs)
+        dt_bare = min(b for _, b in pairs)
+        dt_armed = min(t for t, _ in pairs)
+        tokens = max_batch * gen
+        _log(f"serving_slo_overhead: bare {dt_bare * 1e3:.1f}ms armed "
+             f"{dt_armed * 1e3:.1f}ms, paired vs_bare {vs_bare:.3f} "
+             f"over {len(pairs)} rounds "
+             f"({history.introspect()['samples']} history samples, "
+             f"{len(evaluator.last_rows)} slo rows)")
+        return {
+            "value": round(tokens / max(dt_armed, 1e-9), 1),
+            "unit": "tokens/sec",
+            "config": (f"gpt h{hidden} L{layers} c={max_batch} "
+                       f"gen{gen}, sample+evaluate every 4th step"),
+            "bare_tokens_per_sec": round(tokens / max(dt_bare, 1e-9), 1),
+            "vs_bare": round(vs_bare, 3),
+            "history_samples": history.introspect()["samples"],
+            "measured": (
+                "continuous-batching wave A/B: MetricHistory registry "
+                "sampling + SLOEvaluator burn-rate evaluation every "
+                "4th engine step vs the identical bare loop; vs_bare "
+                "(median of per-round paired ratios — host drift "
+                "cancels) is the <= 1.05 hard gate: the longitudinal "
+                "plane rides inside the telemetry budget"),
+        }
+    finally:
+        parallel.destroy_model_parallel()
+
+
 def bench_serving_autopilot(jax, on_tpu):
     """SLO autopilot (ISSUE 18): a tenant burst against a one-replica
     fleet with the autopilot closing the scale loop (warm-standby
@@ -2828,6 +2942,7 @@ BENCHES = {
     "serving_spec": bench_serving_spec,
     "serving_disagg": bench_serving_disagg,
     "serving_trace_overhead": bench_serving_trace_overhead,
+    "serving_slo_overhead": bench_serving_slo_overhead,
     "serving_lora": bench_serving_lora,
     "serving_autopilot": bench_serving_autopilot,
     "input_pipeline": bench_input_pipeline,
@@ -2853,8 +2968,8 @@ BENCH_ORDER = ["resnet50_o2", "gpt_flash", "bert_large",
                "zero_adam_step", "ckpt_save_restore", "ckpt_reshard",
                "telemetry_overhead", "serving", "serving_occupancy",
                "serving_fleet", "serving_spec", "serving_disagg",
-               "serving_trace_overhead", "serving_lora",
-               "serving_autopilot",
+               "serving_trace_overhead", "serving_slo_overhead",
+               "serving_lora", "serving_autopilot",
                "gpt_flash_fp8", "gpt_long_context", "input_pipeline",
                "real_data_rn50", "tp_gpt"]
 
@@ -2935,6 +3050,7 @@ _TPU_BENCH_CAP_S = {"fused_adam_step": 420.0, "zero_adam_step": 420.0,
                     "serving_fleet": 600.0, "serving_spec": 600.0,
                     "serving_disagg": 600.0,
                     "serving_trace_overhead": 600.0,
+                    "serving_slo_overhead": 600.0,
                     "serving_lora": 600.0,
                     "serving_autopilot": 600.0,
                     "tp_gpt": 900.0}

@@ -89,6 +89,9 @@ TOLERANCES = {
     # absolute wave rate on a shared CPU host is noisy; the gated
     # signal is the vs_bare ceiling above, not the rate
     "serving_trace_overhead": 0.6,
+    # same A/B discipline as serving_trace_overhead: the rate is
+    # noise, vs_bare is the gated signal
+    "serving_slo_overhead": 0.6,
     # the delta kernel runs interpret-mode Pallas on CPU, so the
     # absolute rate couples to host load twice over; the gated signal
     # is the vs_bare_1adapter floor below
@@ -106,6 +109,9 @@ GATES = {
     # ISSUE 15: the distributed-tracing plane armed on the serving hot
     # path must ride inside the same free-telemetry budget
     ("serving_trace_overhead", "vs_bare"): 1.05,
+    # ISSUE 20: the longitudinal history + SLO burn-rate plane, armed
+    # at a hotter-than-shipped cadence, rides the same budget
+    ("serving_slo_overhead", "vs_bare"): 1.05,
 }
 
 # Hard floors, same idea in the other direction ((row, field) -> min

@@ -1,0 +1,150 @@
+#!/usr/bin/env python
+"""Reconstruct a fleet run's SLO story from its timeline spills.
+
+The offline half of the ISSUE 20 burn-rate plane: point it at the same
+spill directory ``trace_report.py`` reads and it replays the router's
+``slo_burn_alert`` / ``slo_burn_clear`` transitions and the periodic
+``slo_state`` budget-table snapshots into
+
+- a **budget table** — per (policy, metric): latest fast/slow burn
+  rates, remaining error budget, projected time-to-exhaustion at the
+  current slow burn, alerting flag;
+- the **worst burner** — the row with the highest slow-window burn
+  (the one that exhausts budgets);
+- the **alert timeline** — every transition in spill order with its
+  in-record evidence.
+
+Usage::
+
+    python scripts/slo_report.py <spill-dir>           # human block
+    python scripts/slo_report.py <spill-dir> --json    # full JSON
+    python scripts/slo_report.py <spill-dir> --check   # CI gate
+
+Exit status: 0 clean, 2 on usage/IO errors.  ``--check`` exits 1 when
+the run ended in a bad SLO state: any budget fully exhausted in the
+final snapshot, any alert still open at end of spill, or a
+clear-without-alert imbalance (more clears than alerts for one
+(policy, metric) — an evaluator state-machine bug, never hidden).
+A spill with no SLO events passes trivially: a disarmed fleet has
+nothing to gate.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _counts(rows, key):
+    out = {}
+    for ev in rows:
+        k = (str(ev.get("policy")), str(ev.get("metric")))
+        out[k] = out.get(k, 0) + 1
+    return out
+
+
+def _format(slo: dict) -> str:
+    lines = ["== slo report =="]
+    states = slo["states"]
+    rows = states[-1]["rows"] if states else []
+    if rows:
+        lines.append("budget table (latest snapshot):")
+        lines.append(f"  {'policy':<16} {'metric':<36} {'fast':>8} "
+                     f"{'slow':>8} {'budget':>8} {'exhaust_s':>10}  state")
+        for r in sorted(rows, key=lambda r: (-r["burn_slow"],
+                                             r["policy"], r["metric"])):
+            ex = r.get("exhaustion_s")
+            lines.append(
+                f"  {r['policy']:<16} {r['metric']:<36} "
+                f"{r['burn_fast']:>8.2f} {r['burn_slow']:>8.2f} "
+                f"{r['budget_remaining']:>8.4f} "
+                f"{'-' if ex is None else format(ex, '>10.1f'):>10}  "
+                f"{'ALERT' if r.get('alerting') else 'ok'}")
+        worst = max(rows, key=lambda r: r["burn_slow"])
+        lines.append(f"worst burner: {worst['policy']} on "
+                     f"{worst['metric']} (slow burn "
+                     f"{worst['burn_slow']:.2f}x)")
+    else:
+        lines.append("no slo_state snapshots in spill")
+    timeline = sorted(
+        ([("alert", ev) for ev in slo["alerts"]]
+         + [("clear", ev) for ev in slo["clears"]]),
+        key=lambda kv: kv[1].get("t", 0.0))
+    lines.append(f"alert timeline ({len(slo['alerts'])} alert(s), "
+                 f"{len(slo['clears'])} clear(s)):")
+    for what, ev in timeline:
+        lines.append(
+            f"  t={ev.get('t', 0.0):>10.3f} {what.upper():<5} "
+            f"{ev.get('policy')} on {ev.get('metric')} "
+            f"(fast {ev.get('burn_fast')}x, slow {ev.get('burn_slow')}x, "
+            f"budget {ev.get('budget_remaining')})")
+    if slo["open"]:
+        lines.append("OPEN at end of spill: " + ", ".join(
+            f"{p}:{m}" for p, m in slo["open"]))
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="replay a fleet spill's SLO burn-rate story: budget "
+                    "table, worst burner, alert timeline")
+    ap.add_argument("dir", help="the fleet run's timeline spill dir")
+    ap.add_argument("--json", action="store_true",
+                    help="print the collected SLO events as JSON")
+    ap.add_argument("--no-strict", action="store_true",
+                    help="tolerate interior JSONL corruption")
+    ap.add_argument("--check", action="store_true",
+                    help="CI gate: exit 1 on exhausted budget, open "
+                         "alert at end of spill, or alert/clear "
+                         "imbalance")
+    args = ap.parse_args(argv)
+
+    from apex_tpu.observability.trace import (
+        collect_slo_events, read_fleet_spills)
+
+    try:
+        router_run, _replicas = read_fleet_spills(
+            args.dir, strict=not args.no_strict)
+    except (OSError, ValueError) as e:
+        print(f"slo_report: {e}", file=sys.stderr)
+        return 2
+    slo = collect_slo_events(router_run)
+
+    if args.json:
+        print(json.dumps(
+            dict(slo, open=[list(k) for k in slo["open"]]), indent=1))
+    else:
+        print(_format(slo))
+
+    if args.check:
+        bad = []
+        final_rows = slo["states"][-1]["rows"] if slo["states"] else []
+        for r in final_rows:
+            if r["budget_remaining"] <= 0:
+                bad.append(f"budget exhausted: {r['policy']} on "
+                           f"{r['metric']}")
+        alerts, clears = _counts(slo["alerts"], "a"), \
+            _counts(slo["clears"], "c")
+        for k, n in sorted(clears.items()):
+            if n > alerts.get(k, 0):
+                bad.append(f"clear/alert imbalance: {k[0]} on {k[1]} "
+                           f"({n} clears > {alerts.get(k, 0)} alerts)")
+        for p, m in slo["open"]:
+            bad.append(f"alert still open at end of spill: {p} on {m}")
+        if bad:
+            for msg in bad:
+                print(f"slo_report: {msg}", file=sys.stderr)
+            return 1
+        print(f"slo_report: check ok ({len(slo['alerts'])} alert(s), "
+              f"{len(slo['clears'])} clear(s), "
+              f"{len(slo['states'])} snapshot(s))", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

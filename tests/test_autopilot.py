@@ -735,3 +735,161 @@ def test_config_validation():
         AutopilotConfig(flap_threshold=1)
     with pytest.raises(ValueError, match="queue_bound_step"):
         AutopilotConfig(queue_bound_step=1.0)
+
+
+# ------------------------- ISSUE 20: predictive scale off the history
+
+
+def _armed_kw(objective=100.0):
+    """Router kwargs arming the longitudinal history + one TTFT SLO."""
+    from apex_tpu.observability.slo import SLOPolicy
+
+    return {"history_every_s": 1.0,
+            "slo_policies": [SLOPolicy(
+                name="ttft", metric="fleet/ttft_ms:p99",
+                objective=objective, target=0.9,
+                fast_window_s=5.0, slow_window_s=30.0,
+                compliance_window_s=300.0)]}
+
+
+def _predictive_cfg(**kw):
+    """Depth and trend thresholds parked out of reach: only the
+    predictive signal can trigger a scale here."""
+    base = dict(min_replicas=1, max_replicas=3,
+                scale_up_queue_depth=1000,
+                scale_up_trend_ms_per_s=1e9,
+                scale_cooldown_s=5.0,
+                # the regression window must be COVERED by real fine
+                # buckets before slope() reports (partial coverage
+                # falls to a coarser ring) — keep it inside the few
+                # seconds these scenarios run
+                predictive_window_s=5.0)
+    base.update(kw)
+    return AutopilotConfig(**base)
+
+
+def _run_predictive(values, cfg_kw=None, router_kw=None):
+    clk = FakeClock()
+    spawned = []
+
+    def spawn(name):
+        rep = FakeReplica(name)
+        spawned.append(rep)
+        return rep
+
+    router, ap, reps = make_fleet(
+        ["a"], clock=clk, spawn=spawn, config=_predictive_cfg(
+            **(cfg_kw or {})),
+        router_kw=router_kw if router_kw is not None else _armed_kw())
+    try:
+        for v in values:
+            clk.advance(1.0)
+            router.registry.histogram(
+                "fleet/ttft_ms", keep_samples=512).observe(v)
+            router.pump()        # history sample + SLO eval + joins
+            ap.tick()
+    finally:
+        router.close()
+    return ap, spawned
+
+
+def test_predictive_scale_up_fires_before_depth_threshold():
+    """The tentpole acceptance row: a rising TTFT tail projected over
+    the horizon breaches the SLO objective (derived from the router's
+    own policy — ``predictive_objective_ms`` stays 0) and grows the
+    pool while the queue is EMPTY, long before the depth threshold."""
+    ap, spawned = _run_predictive(
+        [10.0, 20.0, 30.0, 40.0, 50.0, 60.0, 70.0])
+    assert [r.name for r in spawned] == ["auto1"]
+    decide = [d for d in ap.decisions
+              if d["kind"] == "autopilot_decide"
+              and d.get("action") == "scale_up"]
+    assert len(decide) == 1
+    assert decide[0]["reason"] == \
+        "predicted p99 TTFT breach within horizon"
+    obs = [d for d in ap.decisions
+           if d["kind"] == "autopilot_observe"
+           and d["decision_id"] == decide[0]["decision_id"]][0]
+    # the depth signal was nowhere near its threshold: this fired on
+    # the projection alone, and the evidence rode the observe event
+    assert obs["queue_depth"] == 0
+    assert obs["history_slope_ms_per_s"] > 0
+    assert obs["history_p99_ms"] is not None
+    assert obs["burn_slow"] == 0.0       # objective 100: nothing bad yet
+
+
+def test_predictive_burn_trigger_without_slope():
+    """The second predictive leg: a flat-but-bad tail never projects a
+    breach (slope 0), yet the slow-window burn over the policy's
+    objective trips ``predictive_burn``."""
+    from apex_tpu.observability.slo import SLOPolicy
+
+    router_kw = {"history_every_s": 1.0,
+                 "slo_policies": [SLOPolicy(
+                     name="ttft", metric="fleet/ttft_ms:p99",
+                     objective=5.0, target=0.9,
+                     fast_window_s=5.0, slow_window_s=30.0,
+                     compliance_window_s=300.0)]}
+    ap, spawned = _run_predictive(
+        [10.0, 10.0, 10.0],
+        cfg_kw={"predictive_objective_ms": 1e9, "predictive_burn": 1.0},
+        router_kw=router_kw)
+    assert [r.name for r in spawned] == ["auto1"]
+    decide = [d for d in ap.decisions
+              if d.get("action") == "scale_up"]
+    assert decide and decide[0]["reason"] == \
+        "predicted p99 TTFT breach within horizon"
+    obs = [d for d in ap.decisions
+           if d["kind"] == "autopilot_observe"][-1]
+    assert obs["burn_slow"] >= 1.0
+
+
+def test_predictive_decisions_byte_identical_across_runs():
+    """Same scripted signals, same fake clock -> the identical decision
+    stream, record for record (the determinism acceptance row)."""
+    runs = [_run_predictive(
+        [10.0, 20.0, 30.0, 40.0, 50.0, 60.0, 70.0, 80.0])[0]
+        for _ in range(2)]
+    assert runs[0].decisions == runs[1].decisions
+    assert any(d.get("action") == "scale_up"
+               for d in runs[0].decisions)
+
+
+def test_disarmed_observe_payload_unchanged():
+    """No history -> the predictive path is a no-op: the observe event
+    carries exactly the PR 19 fields, nothing more."""
+    clk = FakeClock()
+    spawned = []
+
+    def spawn(name):
+        rep = FakeReplica(name)
+        spawned.append(rep)
+        return rep
+
+    cfg = AutopilotConfig(min_replicas=1, max_replicas=2,
+                          scale_up_queue_depth=4,
+                          scale_down_queue_depth=1,
+                          scale_cooldown_s=5.0)
+    router, ap, reps = make_fleet(["a"], clock=clk, spawn=spawn,
+                                  config=cfg)
+    try:
+        burst(router, 6)
+        router.pump()
+        ap.tick()
+    finally:
+        router.close()
+    assert [r.name for r in spawned] == ["auto1"]
+    obs = [d for d in ap.decisions
+           if d["kind"] == "autopilot_observe"][0]
+    assert set(obs) == {"kind", "decision_id", "t", "loop",
+                        "queue_depth", "p99_trend_ms_per_s", "live"}
+    decide = [d for d in ap.decisions
+              if d.get("action") == "scale_up"][0]
+    assert decide["reason"] == "queue depth over threshold"
+
+
+def test_predictive_config_validation():
+    with pytest.raises(ValueError, match="predictive"):
+        AutopilotConfig(predictive_window_s=0.0)
+    with pytest.raises(ValueError, match="predictive_burn"):
+        AutopilotConfig(predictive_burn=0.0)

@@ -1,0 +1,652 @@
+"""apex_tpu.observability.{timeseries,slo} — the longitudinal metrics
+history and the SLO burn-rate plane, golden (ISSUE 20).
+
+Everything here runs on an injected fake clock: the counter→rate
+arithmetic (monotonic-reset handling: never a negative rate), the
+multi-resolution downsampling invariant (a coarse bucket's mean/max IS
+the mean/max of the fine buckets it spans), the compacted delta wire
+(export on one clock, ingest rebased onto another), the multi-window
+burn thresholds (a fast-window spike alone never pages; both windows
+over → exactly one alert), the clear hysteresis (a relapse inside
+``clear_after_s`` resets the recovery timer), and the budget /
+exhaustion arithmetic — all pinned to hand-computed values.  The
+OpenMetrics exposition is linted line by line, the JSONL size-rotation
+contract is proven record-exact, and the fleet wiring (statusz blocks,
+replica delta ingestion, series-overflow accounting) is exercised over
+the fleet tests' in-memory FakeReplica.
+"""
+
+import glob
+import json
+import os
+import urllib.request
+
+import pytest
+
+from apex_tpu.observability import timeline
+from apex_tpu.observability.debug_server import (DebugServer,
+                                                 render_openmetrics)
+from apex_tpu.observability.metrics import MetricRegistry
+from apex_tpu.observability.slo import SLOEvaluator, SLOPolicy
+from apex_tpu.observability.timeline import FlightRecorder
+from apex_tpu.observability.timeseries import (OVERFLOW_SERIES,
+                                               MetricHistory,
+                                               match_series)
+from apex_tpu.observability.trace import collect_slo_events, \
+    read_fleet_spills
+from apex_tpu.observability.writers import JsonlWriter, read_jsonl
+
+from test_fleet import FakeReplica, drive, make_router
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += float(dt)
+
+
+# ================================================== MetricHistory
+
+
+def test_counter_becomes_rate():
+    clk = FakeClock()
+    reg = MetricRegistry(rank=0, world=1)
+    h = MetricHistory(reg, clock=clk)
+    reg.counter("c").inc(100)
+    h.sample()                       # first sample: no prev, no rate
+    assert h.series_names() == []
+    clk.advance(1.0)
+    reg.counter("c").inc(5)
+    h.sample()
+    assert h.latest("c") == pytest.approx(5.0)
+    clk.advance(2.0)
+    reg.counter("c").inc(8)
+    h.sample()
+    assert h.latest("c") == pytest.approx(4.0)   # 8 over 2 s
+
+
+def test_counter_reset_never_negative():
+    """A replica restart drops its counters to near zero; the
+    post-reset value is the delta, never a negative rate."""
+    clk = FakeClock()
+    reg = MetricRegistry(rank=0, world=1)
+    h = MetricHistory(reg, clock=clk)
+    reg.counter("c").inc(100)
+    h.sample()
+    clk.advance(1.0)
+    reg.counter("c").inc(5)
+    h.sample()
+    clk.advance(1.0)
+    reg.counter("c").value = 3.0     # the restart: 105 -> 3
+    h.sample()
+    assert h.latest("c") == pytest.approx(3.0)
+    pts = h.bucket_points("c", 10.0, now=clk())
+    assert pts and all(v >= 0.0 for _t, v in pts)
+
+
+def test_gauges_and_histograms_sampled():
+    clk = FakeClock()
+    reg = MetricRegistry(rank=0, world=1)
+    h = MetricHistory(reg, clock=clk)
+    reg.gauge("g").set(2.5)
+    reg.gauge("g_unset")             # None: skipped, never a series
+    hist = reg.histogram("lat", keep_samples=64)
+    for v in (1.0, 2.0, 5.0, 9.0):
+        hist.observe(v)
+    h.sample()
+    assert h.latest("g") == pytest.approx(2.5)
+    assert "g_unset" not in h.series_names()
+    assert {"lat:p50", "lat:p99"} <= set(h.series_names())
+    assert "lat:rate" not in h.series_names()    # needs a count delta
+    assert h.latest("lat:p99") >= h.latest("lat:p50")
+    clk.advance(2.0)
+    for v in (3.0, 4.0):
+        hist.observe(v)
+    h.sample()
+    assert h.latest("lat:rate") == pytest.approx(1.0)   # 2 obs / 2 s
+    clk.advance(1.0)
+    reg.gauge("g").set(7.0)
+    h.sample()
+    assert h.latest("g") == pytest.approx(7.0)
+    assert h.introspect()["samples"] == 3
+
+
+def test_downsampling_coarse_equals_fine():
+    """The downsample invariant: after the fine ring has evicted, the
+    coarse bucket still reports the mean/max/min/last of every raw
+    sample that landed in its span."""
+    clk = FakeClock()
+    vals = [3.0, 5.0, 7.0, 11.0, 13.0, 17.0, 19.0, 23.0, 29.0, 31.0]
+    h = MetricHistory(resolutions=((1.0, 4), (10.0, 64)), clock=clk)
+    for i, v in enumerate(vals):
+        h.record("s", v, now=i + 0.5)
+    # fine ring (maxlen 4) kept only t in [6, 10); the 10 s bucket kept
+    # everything — asking for the full window falls through to it
+    pts = h.bucket_points("s", 10.0, now=10.0)
+    assert pts == [(5.0, pytest.approx(sum(vals) / len(vals)))]
+    assert h.bucket_points("s", 10.0, now=10.0, field="max") == \
+        [(5.0, max(vals))]
+    assert h.bucket_points("s", 10.0, now=10.0, field="min") == \
+        [(5.0, min(vals))]
+    w = h.window("s", 10.0, now=10.0)
+    assert w["count"] == len(vals)
+    assert w["mean"] == pytest.approx(sum(vals) / len(vals))
+    assert (w["min"], w["max"], w["last"]) == (3.0, 31.0, 31.0)
+    # a narrow window is still served from the surviving fine buckets
+    fine = h.bucket_points("s", 4.0, now=10.0)
+    assert fine == [(6.5, 19.0), (7.5, 23.0), (8.5, 29.0), (9.5, 31.0)]
+
+
+def test_series_overflow_bounded():
+    clk = FakeClock()
+    fired = []
+    h = MetricHistory(max_series=2, clock=clk,
+                      on_overflow=lambda: fired.append(1))
+    h.record("a", 1.0, now=0.0)
+    h.record("b", 2.0, now=0.0)
+    h.record("c", 3.0, now=0.0)      # past the cap: lands in (other)
+    h.record("d", 4.0, now=0.0)
+    h.record("c", 5.0, now=0.0)
+    assert h.series_names() == [OVERFLOW_SERIES, "a", "b"]
+    assert len(fired) == 3
+    assert h.window(OVERFLOW_SERIES, 10.0, now=0.0)["count"] == 3
+    intro = h.introspect()
+    assert intro["overflowed"] and intro["series"] == 3
+
+
+def test_export_delta_then_ingest_rebases():
+    clk_a = FakeClock()
+    ha = MetricHistory(clock=clk_a)
+    ha.record("x", 1.0, now=0.2)
+    ha.record("x", 3.0, now=1.2)
+    d1 = ha.export_delta(now=2.0)
+    assert d1["v"] == 1 and d1["res"] == 1.0 and d1["now"] == 2.0
+    assert len(d1["series"]["x"]) == 2
+    # nothing new finished -> no payload on the wire
+    assert ha.export_delta(now=2.5) is None
+    ha.record("x", 7.0, now=2.2)
+    assert ha.export_delta(now=2.9) is None      # bucket 2 still open
+    d2 = ha.export_delta(now=3.1)
+    assert len(d2["series"]["x"]) == 1
+    # ingest on a different clock: buckets rebase by the export offset
+    clk_b = FakeClock(100.0)
+    hb = MetricHistory(clock=clk_b)
+    assert hb.ingest_delta(d1, prefix="replica/a/", now=100.0) == 2
+    pts = hb.bucket_points("replica/a/x", 2.0, now=100.0)
+    assert pts == [(98.5, 1.0), (99.5, 3.0)]
+    assert hb.latest("replica/a/x") == 3.0
+    assert hb.ingest_delta({}) == 0
+    assert hb.ingest_delta(None) == 0
+
+
+def test_slope_golden():
+    clk = FakeClock()
+    h = MetricHistory(clock=clk)
+    h.record("s", 2.0, now=1.0)
+    assert h.slope("s", 9.0, now=1.0) == 0.0     # one bucket: no slope
+    for t in range(2, 11):
+        h.record("s", 2.0 * t, now=float(t))
+    # window 9 at t=10 cuts at t=1, exactly where the fine ring starts
+    assert h.slope("s", 9.0, now=10.0) == pytest.approx(2.0)
+    assert h.slope("missing", 9.0, now=10.0) == 0.0
+
+
+def test_match_and_match_series():
+    assert match_series("fleet/tenant/*/ttft_ms:p99",
+                        "fleet/tenant/acme/ttft_ms:p99")
+    assert not match_series("fleet/tenant/*/ttft_ms:p99",
+                            "fleet/tenant/acme/extra/ttft_ms:p99")
+    assert not match_series("*", "a/b")          # one segment exactly
+    clk = FakeClock()
+    h = MetricHistory(clock=clk)
+    for name in ("svc/a/m", "svc/b/m", "svc/a/other", "top"):
+        h.record(name, 1.0, now=0.0)
+    assert h.match("svc/*/m") == ["svc/a/m", "svc/b/m"]
+    assert h.match("svc/a/m") == ["svc/a/m"]
+    assert h.match("svc/zz/m") == []
+    assert h.match("*") == ["top"]
+
+
+def test_history_validation():
+    with pytest.raises(ValueError):
+        MetricHistory(resolutions=())
+    with pytest.raises(ValueError):
+        MetricHistory(resolutions=((1.0, 4), (1.0, 4)))   # not ascending
+    with pytest.raises(ValueError):
+        MetricHistory(resolutions=((0.0, 4),))
+    with pytest.raises(ValueError):
+        MetricHistory(max_series=0)
+    with pytest.raises(ValueError):
+        MetricHistory().sample()     # no registry to snapshot
+
+
+# ================================================ SLO burn rates
+
+
+def _tick(h, ev, clk, value, metric="m"):
+    clk.advance(1.0)
+    h.record(metric, value)
+    ev.evaluate()
+
+
+def test_fast_window_alone_never_pages():
+    """The multi-window rule: a short spike trips the fast window
+    immediately but the alert waits for the slow window — then fires
+    exactly once however long the burn continues."""
+    clk = FakeClock()
+    h = MetricHistory(clock=clk)
+    pol = SLOPolicy(name="p", metric="m", objective=100.0, target=0.9,
+                    fast_window_s=10.0, slow_window_s=50.0,
+                    compliance_window_s=500.0,
+                    fast_burn=1.5, slow_burn=1.0, clear_after_s=1e9)
+    ev = SLOEvaluator(h, [pol], clock=clk)
+    for _ in range(60):
+        _tick(h, ev, clk, 10.0)
+    assert ev.alerts == 0
+    _tick(h, ev, clk, 200.0)                       # t=61: 1 bad bucket
+    _tick(h, ev, clk, 200.0)                       # t=62: 2 bad buckets
+    row = ev.last_rows[0]
+    # fast window holds 11 one-second buckets here, slow holds 51
+    assert row["burn_fast"] == pytest.approx(round(2 / 11 / 0.1, 4))
+    assert row["burn_slow"] == pytest.approx(round(2 / 51 / 0.1, 4))
+    assert row["burn_fast"] >= pol.fast_burn       # fast is over...
+    assert ev.alerts == 0                          # ...but no page yet
+    for _ in range(3):                             # t=63..65
+        _tick(h, ev, clk, 200.0)
+    assert ev.alerts == 0                          # slow still under 1.0
+    _tick(h, ev, clk, 200.0)                       # t=66: 6/51 over budget
+    assert ev.alerts == 1
+    assert ev.last_rows[0]["alerting"] is True
+    for _ in range(4):
+        _tick(h, ev, clk, 200.0)
+    assert ev.alerts == 1                          # fires exactly once
+
+
+def test_hysteresis_relapse_resets_clear_timer():
+    clk = FakeClock()
+    h = MetricHistory(clock=clk)
+    pol = SLOPolicy(name="p", metric="m", objective=100.0, target=0.5,
+                    fast_window_s=2.0, slow_window_s=2.0,
+                    compliance_window_s=100.0,
+                    fast_burn=1.0, slow_burn=1.0, clear_after_s=5.0)
+    ev = SLOEvaluator(h, [pol], clock=clk)
+    rec = timeline.arm(FlightRecorder(None))
+    try:
+        for v in (10.0, 10.0):                     # t=1..2 healthy
+            _tick(h, ev, clk, v)
+        _tick(h, ev, clk, 200.0)                   # t=3: 1/3 bad
+        assert ev.alerts == 0
+        _tick(h, ev, clk, 200.0)                   # t=4: 2/3 bad -> page
+        assert ev.alerts == 1
+        assert ev.introspect()["alerting"] == ["p:m"]
+        _tick(h, ev, clk, 200.0)                   # t=5
+        for v in (10.0, 10.0):                     # t=6..7: recovery opens
+            _tick(h, ev, clk, v)
+        assert ev.last_rows[0]["alerting"] is True  # hysteresis holds
+        _tick(h, ev, clk, 200.0)                   # t=8
+        _tick(h, ev, clk, 200.0)                   # t=9: relapse refires
+        assert ev.alerts == 1 and ev.clears == 0   # no storm either way
+        for _ in range(6):                         # t=10..15: healthy
+            _tick(h, ev, clk, 10.0)
+        assert ev.clears == 0                      # recovery at t=11: 4 s
+        _tick(h, ev, clk, 10.0)                    # t=16: 5 s sustained
+        assert ev.clears == 1
+        assert ev.last_rows[0]["alerting"] is False
+        assert ev.introspect()["alerting"] == []
+    finally:
+        timeline.disarm()
+    events = rec.events()
+    alerts = [e for e in events if e["kind"] == "slo_burn_alert"]
+    clears = [e for e in events if e["kind"] == "slo_burn_clear"]
+    assert len(alerts) == 1 and len(clears) == 1
+    a = alerts[0]
+    assert a["policy"] == "p" and a["metric"] == "m"
+    assert a["objective"] == 100.0
+    assert a["burn_fast"] == pytest.approx(round(2 / 3 / 0.5, 4))
+    assert a["burn_slow"] == a["burn_fast"]        # same window here
+    assert "budget_remaining" in a and "budget_remaining" in clears[0]
+    states = [e for e in events if e["kind"] == "slo_state"]
+    assert len(states) >= 10                       # one per cadence tick
+    assert states[-1]["rows"][0]["alerting"] is False
+    # the offline reducer agrees with the live evaluator
+    slo = collect_slo_events(events)
+    assert len(slo["alerts"]) == 1 and len(slo["clears"]) == 1
+    assert slo["open"] == []
+
+
+def test_budget_and_exhaustion_golden():
+    clk = FakeClock()
+    h = MetricHistory(clock=clk)
+    pol = SLOPolicy(name="p", metric="m", objective=100.0, target=0.9,
+                    fast_window_s=2.0, slow_window_s=10.0,
+                    compliance_window_s=100.0)
+    idle = SLOPolicy(name="idle", metric="fleet/nothing",
+                     objective=1.0, target=0.9,
+                     fast_window_s=2.0, slow_window_s=10.0,
+                     compliance_window_s=100.0)
+    ev = SLOEvaluator(h, [pol, idle], clock=clk)
+    # run LONGER than the compliance window so every window is served
+    # from the fine ring (the multi-resolution fallback would otherwise
+    # re-aggregate the tail into 10 s buckets)
+    for t in range(1, 118):
+        clk.advance(1.0)
+        h.record("m", 10.0)
+    for t in range(3):                             # t=118..120 bad
+        clk.advance(1.0)
+        h.record("m", 200.0)
+    rows = ev.evaluate()
+    row = rows[0]
+    # fast: 3/3 bad over budget 0.1; slow: 3 of 11 buckets;
+    # compliance: 3 of the 101 buckets in (t-101, t]
+    assert row["burn_fast"] == pytest.approx(10.0)
+    assert row["burn_slow"] == pytest.approx(round(3 / 11 / 0.1, 4))
+    remaining = 1.0 - 3 / 101 / 0.1
+    assert row["budget_remaining"] == pytest.approx(remaining, abs=1e-6)
+    assert row["exhaustion_s"] == pytest.approx(
+        remaining * 100.0 / (3 / 11 / 0.1), abs=1e-3)
+    # an explicit series with no data reports idle, burns nothing
+    quiet = rows[1]
+    assert quiet["metric"] == "fleet/nothing"
+    assert quiet["burn_slow"] == 0.0
+    assert quiet["budget_remaining"] == 1.0
+    assert quiet["exhaustion_s"] is None
+    assert ev.worst()["policy"] == "p"
+
+
+def test_wildcard_policy_expands_per_series():
+    clk = FakeClock()
+    h = MetricHistory(clock=clk)
+    pol = SLOPolicy(name="tenants", metric="svc/*/m", objective=100.0,
+                    fast_window_s=2.0, slow_window_s=4.0,
+                    compliance_window_s=60.0)
+    ghost = SLOPolicy(name="ghost", metric="zz/*/m", objective=1.0,
+                      fast_window_s=2.0, slow_window_s=4.0,
+                      compliance_window_s=60.0)
+    ev = SLOEvaluator(h, [pol, ghost], clock=clk)
+    clk.advance(1.0)
+    h.record("svc/a/m", 1.0)
+    h.record("svc/b/m", 1.0)
+    rows = ev.evaluate()
+    # one row per matched series; a matchless wildcard yields no
+    # phantom row for the pattern itself
+    assert [r["metric"] for r in rows] == ["svc/a/m", "svc/b/m"]
+    assert ev.introspect()["series_tracked"] == 2
+
+
+def test_slo_policy_validation():
+    ok = dict(name="p", metric="m", objective=1.0)
+    SLOPolicy(**ok)
+    with pytest.raises(ValueError):
+        SLOPolicy(**dict(ok, target=1.0))
+    with pytest.raises(ValueError):
+        SLOPolicy(**dict(ok, fast_window_s=500.0))   # fast > slow
+    with pytest.raises(ValueError):
+        SLOPolicy(**dict(ok, fast_burn=0.0))
+    with pytest.raises(ValueError):
+        SLOPolicy(**dict(ok, clear_after_s=-1.0))
+    with pytest.raises(ValueError):
+        SLOPolicy(**dict(ok, field="p42"))
+    with pytest.raises(ValueError):
+        SLOPolicy(**dict(ok, name=""))
+
+
+# ============================================ OpenMetrics exposition
+
+
+def test_openmetrics_exposition_lint():
+    reg = MetricRegistry(rank=0, world=1)
+    reg.counter("serving/requests").inc(3)
+    reg.gauge("serving/queue_depth").set(2.5)
+    reg.gauge("serving/unset")       # None gauge: not exposed
+    hist = reg.histogram("serving/latency_ms", keep_samples=32)
+    for v in (1.0, 2.0, 5.0, 9.0):
+        hist.observe(v)
+    text = render_openmetrics(reg)
+    lines = text.splitlines()
+    assert text.endswith("# EOF\n")
+    assert lines.index("# EOF") == len(lines) - 1   # nothing after EOF
+    # every family: # HELP immediately before # TYPE, samples known
+    families = {}
+    for i, ln in enumerate(lines):
+        if ln.startswith("# TYPE "):
+            _h, _t, name, mtype = ln.split()
+            assert lines[i - 1].startswith(f"# HELP {name} ")
+            families[name] = mtype
+        elif ln.startswith("#"):
+            continue
+        else:
+            base = ln.split("{")[0]
+            owners = [n for n in families
+                      if base == n or (base.startswith(n) and
+                                       base[len(n):] in ("_total",
+                                                         "_count",
+                                                         "_sum"))]
+            assert owners, f"sample without a TYPE line: {ln}"
+    assert families["apex_serving_requests"] == "counter"
+    assert families["apex_serving_queue_depth"] == "gauge"
+    assert families["apex_serving_latency_ms"] == "summary"
+    # counter SAMPLES carry the mandatory _total suffix
+    assert 'apex_serving_requests_total{rank="0"} 3.0' in lines
+    assert "apex_serving_requests{" not in text
+    assert 'apex_serving_queue_depth{rank="0"} 2.5' in lines
+    assert "apex_serving_unset" not in text
+    assert 'apex_serving_latency_ms_count{rank="0"} 4.0' in lines
+    assert 'apex_serving_latency_ms_sum{rank="0"} 17.0' in lines
+    assert any('quantile="0.5"' in ln for ln in lines)
+    assert any('quantile="0.99"' in ln for ln in lines)
+
+
+def test_metrics_prom_endpoint():
+    reg = MetricRegistry(rank=0, world=1)
+    reg.counter("serving/requests").inc(2)
+    srv = DebugServer(registry=reg).start()
+    try:
+        with urllib.request.urlopen(srv.url("/metrics.prom"),
+                                    timeout=10) as resp:
+            assert resp.status == 200
+            ctype = resp.headers.get("Content-Type", "")
+            body = resp.read().decode()
+        assert "application/openmetrics-text" in ctype
+        assert "version=1.0.0" in ctype
+        assert body.endswith("# EOF\n")
+        assert 'apex_serving_requests_total{rank="0"} 2.0' in body
+    finally:
+        srv.close()
+
+
+# ============================================== JSONL size rotation
+
+
+def _stream_records(path):
+    """A rotated stream's records in append order: segments by
+    rotation seq, then the live file."""
+    stem = path[:-len(".jsonl")]
+    segs = sorted(glob.glob(stem + ".rot-*.jsonl"))
+    out = []
+    for p in segs + [path]:
+        out.extend(read_jsonl(p, strict=True))
+    return out
+
+
+def test_rotation_preserves_every_record(tmp_path):
+    path = str(tmp_path / "m.jsonl")
+    w = JsonlWriter(path, fsync=False, rotate_bytes=150)
+    for i in range(10):
+        w.write({"i": i, "pad": "x" * 48})
+    w.close()
+    segs = sorted(glob.glob(str(tmp_path / "m.rot-*.jsonl")))
+    assert w.rotations >= 2 and len(segs) == w.rotations
+    # rotation happens BETWEEN records: every segment within bound,
+    # every line intact, nothing lost, order exact
+    for seg in segs:
+        assert os.path.getsize(seg) <= 150
+    assert [r["i"] for r in _stream_records(path)] == list(range(10))
+    # a restarted writer (keep_open leg) seq-scans past history
+    w2 = JsonlWriter(path, fsync=False, rotate_bytes=150, keep_open=True)
+    for i in range(10, 20):
+        w2.write({"i": i, "pad": "x" * 48})
+    w2.close()
+    assert [r["i"] for r in _stream_records(path)] == list(range(20))
+    assert len(glob.glob(str(tmp_path / "m.rot-*.jsonl"))) > len(segs)
+
+
+def test_rotation_off_by_default(tmp_path):
+    path = str(tmp_path / "m.jsonl")
+    w = JsonlWriter(path, fsync=False)
+    for i in range(50):
+        w.write({"i": i, "pad": "x" * 48})
+    assert w.rotations == 0
+    assert glob.glob(str(tmp_path / "m.rot-*.jsonl")) == []
+    assert len(read_jsonl(path)) == 50
+    with pytest.raises(ValueError):
+        JsonlWriter(path, rotate_bytes=0)
+
+
+def test_read_fleet_spills_concatenates_rotated_segments(tmp_path):
+    w = JsonlWriter(str(tmp_path / "timeline.router.router.1.jsonl"),
+                    fsync=False, rotate_bytes=200)
+    w.write({"kind": "run_begin", "t": 0.0, "role": "router",
+             "name": "router", "pid": 1, "mono_t0": 0.0, "wall_ts": 1.0})
+    for i in range(20):
+        w.write({"kind": "fleet_submit", "t": 0.1 * i, "rid": i,
+                 "trace_id": f"t{i:04d}"})
+    w.close()
+    assert w.rotations >= 2
+    rw = JsonlWriter(str(tmp_path / "timeline.replica.a.2.jsonl"),
+                     fsync=False)
+    rw.write({"kind": "run_begin", "t": 0.0, "role": "replica",
+              "name": "a", "pid": 2, "mono_t0": 0.0, "wall_ts": 1.0})
+    rw.write({"kind": "step", "t": 0.5, "step": 1})
+    router_run, replica_runs = read_fleet_spills(str(tmp_path))
+    assert router_run[0]["kind"] == "run_begin"
+    assert [e["rid"] for e in router_run
+            if e["kind"] == "fleet_submit"] == list(range(20))
+    assert list(replica_runs) == ["a"] and len(replica_runs["a"]) == 1
+
+
+# ================================================= fleet wiring
+
+
+def _armed_router(rep, clk, **kw):
+    policies = [SLOPolicy(name="ttft", metric="fleet/ttft_ms:p99",
+                          objective=1e9, fast_window_s=5.0,
+                          slow_window_s=10.0, compliance_window_s=60.0)]
+    return make_router([rep], clock=clk, history_every_s=1.0,
+                       slo_policies=policies, **kw)
+
+
+def test_fleet_statusz_grows_history_and_burn_blocks():
+    clk = FakeClock()
+    rep = FakeReplica("a")
+    router = _armed_router(rep, clk)
+    try:
+        reqs = [router.submit([3, 5, 7], 3), router.submit([2, 4], 3)]
+        for _ in range(12):
+            clk.advance(1.0)
+            router.pump()
+            rep.tick()
+        assert all(r.done for r in reqs)
+        status = router.fleet_statusz()
+        assert status["history"]["samples"] >= 2
+        assert status["history"]["max_series"] == 512
+        burn = status["slo"]["burn"]
+        assert [r["policy"] for r in burn["rows"]] == ["ttft"]
+        assert burn["worst"]["metric"] == "fleet/ttft_ms:p99"
+        assert burn["alerts"] == 0 and burn["alerting"] == []
+        # real longitudinal data accrued from the router's own registry
+        assert router.history.latest("fleet/ttft_ms:p99") is not None
+    finally:
+        router.close()
+
+
+def test_fleet_statusz_disarmed_is_unchanged():
+    rep = FakeReplica("a")
+    router = make_router([rep])
+    try:
+        status = router.fleet_statusz()
+        assert "history" not in status
+        assert "burn" not in status["slo"]
+        assert router.history is None and router.slo is None
+    finally:
+        router.close()
+    with pytest.raises(ValueError):
+        make_router([FakeReplica("b")], slo_policies=[
+            SLOPolicy(name="p", metric="m", objective=1.0)])
+
+
+def test_replica_history_delta_merges_under_prefix():
+    clk = FakeClock()
+    rep = FakeReplica("a")
+    router = _armed_router(rep, clk)
+    try:
+        # a replica-side history exports a compacted delta; the state
+        # heartbeat carries it and the router rebases it under the
+        # replica prefix
+        rh = MetricHistory(clock=FakeClock(50.0))
+        rh.record("serving/tokens_per_s", 42.0, now=50.2)
+        delta = rh.export_delta(now=51.5)
+        assert delta is not None
+        rep._emit_state()
+        rep._events[-1][1]["history"] = delta
+        clk.advance(1.0)
+        router.pump()
+        assert "replica/a/serving/tokens_per_s" in \
+            router.history.series_names()
+        assert router.history.latest(
+            "replica/a/serving/tokens_per_s") == 42.0
+    finally:
+        router.close()
+    # a disarmed router drops the delta without a wobble
+    rep2 = FakeReplica("b")
+    router2 = make_router([rep2])
+    try:
+        rep2._emit_state()
+        rep2._events[-1][1]["history"] = delta
+        router2.pump()
+        assert router2.history is None
+    finally:
+        router2.close()
+
+
+def test_history_series_cap_feeds_overflow_counter():
+    clk = FakeClock()
+    rep = FakeReplica("a")
+    router = make_router([rep], clock=clk, history_every_s=1.0,
+                         history_max_series=1)
+    try:
+        router.registry.gauge("fleet/x1").set(1.0)
+        router.registry.gauge("fleet/x2").set(2.0)
+        for _ in range(3):
+            clk.advance(1.0)
+            router.pump()
+        assert OVERFLOW_SERIES in router.history.series_names()
+        snap = router.registry.snapshot()
+        assert snap["fleet/series_overflow"] >= 1
+        assert router.fleet_statusz()["history"]["overflowed"] is True
+    finally:
+        router.close()
+
+
+def test_collect_slo_events_open_alert():
+    events = [
+        {"kind": "run_begin", "t": 0.0},
+        {"kind": "slo_burn_alert", "t": 1.0, "policy": "p",
+         "metric": "m", "burn_fast": 2.0, "burn_slow": 2.0,
+         "budget_remaining": 0.5, "objective": 10.0},
+        {"kind": "slo_state", "t": 1.0, "rows": []},
+        {"kind": "slo_burn_clear", "t": 5.0, "policy": "p",
+         "metric": "m", "burn_fast": 0.0, "burn_slow": 0.0,
+         "budget_remaining": 0.5},
+        {"kind": "slo_burn_alert", "t": 9.0, "policy": "p",
+         "metric": "m", "burn_fast": 3.0, "burn_slow": 3.0,
+         "budget_remaining": 0.2, "objective": 10.0},
+    ]
+    slo = collect_slo_events(events)
+    assert len(slo["alerts"]) == 2 and len(slo["clears"]) == 1
+    assert len(slo["states"]) == 1
+    assert slo["open"] == [("p", "m")]      # newest transition: alert
